@@ -1,0 +1,18 @@
+"""Typed, JSON-persistable parameter system.
+
+Capability parity with the reference's ``flink-ml-api`` param package
+(``org.apache.flink.ml.api.misc.param``): ``Params`` (Params.java),
+``ParamInfo`` (ParamInfo.java), builder (ParamInfoFactory.java),
+``WithParams`` (WithParams.java), ``ParamValidator`` (ParamValidator.java),
+and ``extract_param_infos`` (util/param/ExtractParamInfosUtil.java).
+"""
+
+from flink_ml_tpu.params.params import (  # noqa: F401
+    ParamInfo,
+    ParamValidator,
+    Params,
+    WithParams,
+    extract_param_infos,
+    param_info,
+)
+from flink_ml_tpu.params import shared  # noqa: F401

@@ -1,0 +1,279 @@
+"""Params / ParamInfo / WithParams — the framework's typed config system.
+
+Semantics match the reference's JSON-string-valued param map
+(``flink-ml-api/.../api/misc/param/Params.java``):
+
+* values are stored JSON-encoded, keyed by the param *name*;
+* ``get`` resolves name **or** any alias, raising on duplicate name/alias hits
+  (Params.java:95-125), falling back to the default value and raising when a
+  non-optional param is unset or an optional one has no default;
+* ``set`` runs the validator hook (Params.java:138-145);
+* ``to_json``/``from_json`` round-trip the whole map (Params.java:177-214);
+* ``merge``/``clone`` (Params.java:222-239).
+
+``ParamInfo`` carries name/alias/description/optional/default/validator
+(ParamInfo.java:46-53); ``param_info`` is the builder
+(ParamInfoFactory.java:41-122).  ``extract_param_infos`` walks a class and its
+bases collecting ``ParamInfo`` class attributes for persistence
+(util/param/ExtractParamInfosUtil.java:42-70).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Generic, Iterable, List, Optional, Sequence, TypeVar
+
+V = TypeVar("V")
+
+# Sentinel distinguishing "no default value" from "default value is None":
+# the reference tracks this with an explicit hasDefaultValue flag
+# (ParamInfo.java:49, ParamInfoFactory.java:75-83).
+_NO_DEFAULT = object()
+
+
+class ParamValidator(Generic[V]):
+    """Validation hook for a param value (ParamValidator.java:31-39).
+
+    Any callable ``value -> bool`` is also accepted wherever a validator is
+    expected; this class exists for subclass-style validators with state.
+    """
+
+    def validate(self, value: V) -> bool:  # pragma: no cover - interface default
+        return True
+
+    def __call__(self, value: V) -> bool:
+        return self.validate(value)
+
+
+class ParamInfo(Generic[V]):
+    """Definition of a parameter: metadata + default (ParamInfo.java)."""
+
+    __slots__ = ("name", "alias", "description", "optional", "_default", "validator", "value_type")
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        *,
+        alias: Sequence[str] = (),
+        optional: bool = True,
+        default: Any = _NO_DEFAULT,
+        validator: Optional[Callable[[V], bool]] = None,
+        value_type: Optional[type] = None,
+    ):
+        if not name:
+            raise ValueError("param name must be non-empty")
+        for a in alias:
+            if not a:
+                raise ValueError("param alias must be non-empty")
+        self.name = name
+        self.alias = tuple(alias)
+        self.description = description
+        self.optional = optional
+        self._default = default
+        self.validator = validator
+        self.value_type = value_type
+
+    @property
+    def has_default(self) -> bool:
+        return self._default is not _NO_DEFAULT
+
+    @property
+    def default(self) -> V:
+        if not self.has_default:
+            raise ValueError(f"param {self.name!r} has no default value")
+        return self._default
+
+    def names(self) -> List[str]:
+        """Name followed by aliases — resolution order used by Params.get."""
+        return [self.name, *self.alias]
+
+    def __repr__(self) -> str:
+        return f"ParamInfo({self.name!r})"
+
+    # ParamInfos are identity-hashed: two infos with the same name are still
+    # distinct definitions, mirroring the reference's object semantics.
+
+
+def param_info(
+    name: str,
+    description: str = "",
+    *,
+    alias: Sequence[str] = (),
+    optional: bool = True,
+    default: Any = _NO_DEFAULT,
+    validator: Optional[Callable[[Any], bool]] = None,
+    value_type: Optional[type] = None,
+) -> ParamInfo:
+    """Builder for ParamInfo (ParamInfoFactory.createParamInfo + builder chain)."""
+    return ParamInfo(
+        name,
+        description,
+        alias=alias,
+        optional=optional,
+        default=default,
+        validator=validator,
+        value_type=value_type,
+    )
+
+
+class Params:
+    """Map-like container of params; values stored as JSON strings."""
+
+    __slots__ = ("_params",)
+
+    def __init__(self) -> None:
+        self._params: Dict[str, str] = {}
+
+    # -- size / emptiness ---------------------------------------------------
+
+    def size(self) -> int:
+        return len(self._params)
+
+    def __len__(self) -> int:
+        return len(self._params)
+
+    def is_empty(self) -> bool:
+        return not self._params
+
+    def clear(self) -> None:
+        self._params.clear()
+
+    # -- typed access -------------------------------------------------------
+
+    def get(self, info: ParamInfo[V]) -> V:
+        """Value for ``info`` or its default (Params.java:95-125).
+
+        Raises ValueError when the same param is set under both its name and
+        an alias, when a non-optional param is unset, or when an optional
+        unset param has no default.
+        """
+        used_name = None
+        value_json = None
+        for name_or_alias in info.names():
+            if name_or_alias in self._params:
+                if used_name is not None:
+                    raise ValueError(
+                        f"Duplicate parameters of {used_name} and {name_or_alias}"
+                    )
+                used_name = name_or_alias
+                value_json = self._params[name_or_alias]
+        if used_name is not None:
+            return self._decode(value_json)
+        if not info.optional:
+            raise ValueError(f"Missing non-optional parameter {info.name}")
+        if not info.has_default:
+            raise ValueError(f"Cannot find default value for optional parameter {info.name}")
+        return info.default
+
+    def set(self, info: ParamInfo[V], value: V) -> "Params":
+        """Set a value, running the validator hook first (Params.java:138-145)."""
+        if info.validator is not None and not info.validator(value):
+            raise ValueError(f"Setting {info.name} as a invalid value:{value}")
+        self._params[info.name] = self._encode(value)
+        return self
+
+    def remove(self, info: ParamInfo[V]) -> None:
+        """Remove under name and every alias (Params.java:154-160)."""
+        self._params.pop(info.name, None)
+        for a in info.alias:
+            self._params.pop(a, None)
+
+    def contains(self, info: ParamInfo[V]) -> bool:
+        return any(n in self._params for n in info.names())
+
+    def __contains__(self, info: ParamInfo) -> bool:
+        return self.contains(info)
+
+    # -- raw access (used by json round-trip and save/load) -----------------
+
+    def set_raw(self, name: str, value: Any) -> "Params":
+        """Set by bare name with no ParamInfo (used to exercise alias logic)."""
+        self._params[name] = self._encode(value)
+        return self
+
+    def keys(self) -> Iterable[str]:
+        return self._params.keys()
+
+    # -- json persistence ---------------------------------------------------
+
+    def to_json(self) -> str:
+        """One JSON object mapping name -> JSON-encoded value (Params.java:177-184)."""
+        return json.dumps(self._params, sort_keys=True)
+
+    def load_json(self, payload: str) -> None:
+        self._params.update(json.loads(payload))
+
+    @staticmethod
+    def from_json(payload: str) -> "Params":
+        p = Params()
+        p.load_json(payload)
+        return p
+
+    # -- merge / clone ------------------------------------------------------
+
+    def merge(self, other: Optional["Params"]) -> "Params":
+        if other is not None:
+            self._params.update(other._params)
+        return self
+
+    def clone(self) -> "Params":
+        p = Params()
+        p._params.update(self._params)
+        return p
+
+    # -- codec --------------------------------------------------------------
+
+    @staticmethod
+    def _encode(value: Any) -> str:
+        return json.dumps(value)
+
+    @staticmethod
+    def _decode(value_json: Optional[str]) -> Any:
+        if value_json is None:
+            return None
+        return json.loads(value_json)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Params) and self._params == other._params
+
+    def __repr__(self) -> str:
+        return f"Params({self._params})"
+
+
+class WithParams:
+    """Mixin giving typed get/set that delegates to get_params() (WithParams.java:44-59).
+
+    Subclasses (stages, mappers, operators) expose their ParamInfos as class
+    attributes; ``extract_param_infos`` finds them for persistence.
+    """
+
+    def get_params(self) -> Params:
+        p = getattr(self, "_params", None)
+        if p is None:
+            p = Params()
+            self._params = p
+        return p
+
+    def set(self, info: ParamInfo[V], value: V) -> "WithParams":
+        self.get_params().set(info, value)
+        return self
+
+    def get(self, info: ParamInfo[V]) -> V:
+        return self.get_params().get(info)
+
+
+def extract_param_infos(obj: Any) -> Dict[str, ParamInfo]:
+    """Collect every ParamInfo reachable as a class attribute of ``obj``'s type.
+
+    Walks the full MRO (class, superclasses, mixin interfaces), mirroring the
+    reflection walk in ExtractParamInfosUtil.java:42-70.  Subclass definitions
+    shadow superclass definitions of the same name.
+    """
+    infos: Dict[str, ParamInfo] = {}
+    cls = obj if isinstance(obj, type) else type(obj)
+    for klass in reversed(cls.__mro__):
+        for attr in vars(klass).values():
+            if isinstance(attr, ParamInfo):
+                infos[attr.name] = attr
+    return infos

@@ -1,0 +1,174 @@
+"""Shared parameter vocabulary — column-selection conventions every algorithm honors.
+
+Capability parity with ``flink-ml-lib/.../params/shared`` (HasMLEnvironmentId.java
+plus the colname family): each mixin contributes one ParamInfo class attribute and
+typed getter/setter, so algorithms compose their vocabulary by inheritance exactly
+as the reference composes interfaces (exemplar: HasSelectedCol.java:33-47).
+
+The convention these encode (select -> compute -> merge, cf. SURVEY.md §2.3.5):
+an op reads `selected_col(s)`, writes `output_col(s)`/`prediction_col`, and the
+result schema keeps `reserved_cols` from the input (OutputColsHelper rules).
+"""
+
+from __future__ import annotations
+
+from flink_ml_tpu.params.params import ParamInfo, WithParams, param_info
+
+
+class HasMLEnvironmentId(WithParams):
+    """Which MLEnvironment a stage runs in (HasMLEnvironmentId.java:28-42)."""
+
+    ML_ENVIRONMENT_ID = param_info(
+        "MLEnvironmentId",
+        "ID of ML environment.",
+        default=0,
+        value_type=int,
+    )
+
+    def get_ml_environment_id(self) -> int:
+        return self.get(self.ML_ENVIRONMENT_ID)
+
+    def set_ml_environment_id(self, value: int):
+        return self.set(self.ML_ENVIRONMENT_ID, value)
+
+
+class HasSelectedCol(WithParams):
+    SELECTED_COL: ParamInfo = param_info(
+        "selectedCol", "Name of the selected column used for processing",
+        optional=False, value_type=str,
+    )
+
+    def get_selected_col(self) -> str:
+        return self.get(self.SELECTED_COL)
+
+    def set_selected_col(self, value: str):
+        return self.set(self.SELECTED_COL, value)
+
+
+class HasSelectedColDefaultAsNull(WithParams):
+    SELECTED_COL: ParamInfo = param_info(
+        "selectedCol", "Name of the selected column used for processing",
+        default=None, value_type=str,
+    )
+
+    def get_selected_col(self):
+        return self.get(self.SELECTED_COL)
+
+    def set_selected_col(self, value: str):
+        return self.set(self.SELECTED_COL, value)
+
+
+class HasSelectedCols(WithParams):
+    SELECTED_COLS: ParamInfo = param_info(
+        "selectedCols", "Names of the columns used for processing",
+        optional=False, value_type=list,
+    )
+
+    def get_selected_cols(self):
+        return self.get(self.SELECTED_COLS)
+
+    def set_selected_cols(self, value):
+        return self.set(self.SELECTED_COLS, list(value))
+
+
+class HasSelectedColsDefaultAsNull(WithParams):
+    SELECTED_COLS: ParamInfo = param_info(
+        "selectedCols", "Names of the columns used for processing",
+        default=None, value_type=list,
+    )
+
+    def get_selected_cols(self):
+        return self.get(self.SELECTED_COLS)
+
+    def set_selected_cols(self, value):
+        return self.set(self.SELECTED_COLS, list(value) if value is not None else None)
+
+
+class HasOutputCol(WithParams):
+    OUTPUT_COL: ParamInfo = param_info(
+        "outputCol", "Name of the output column", optional=False, value_type=str,
+    )
+
+    def get_output_col(self) -> str:
+        return self.get(self.OUTPUT_COL)
+
+    def set_output_col(self, value: str):
+        return self.set(self.OUTPUT_COL, value)
+
+
+class HasOutputColDefaultAsNull(WithParams):
+    OUTPUT_COL: ParamInfo = param_info(
+        "outputCol", "Name of the output column", default=None, value_type=str,
+    )
+
+    def get_output_col(self):
+        return self.get(self.OUTPUT_COL)
+
+    def set_output_col(self, value: str):
+        return self.set(self.OUTPUT_COL, value)
+
+
+class HasOutputCols(WithParams):
+    OUTPUT_COLS: ParamInfo = param_info(
+        "outputCols", "Names of the output columns", optional=False, value_type=list,
+    )
+
+    def get_output_cols(self):
+        return self.get(self.OUTPUT_COLS)
+
+    def set_output_cols(self, value):
+        return self.set(self.OUTPUT_COLS, list(value))
+
+
+class HasOutputColsDefaultAsNull(WithParams):
+    OUTPUT_COLS: ParamInfo = param_info(
+        "outputCols", "Names of the output columns", default=None, value_type=list,
+    )
+
+    def get_output_cols(self):
+        return self.get(self.OUTPUT_COLS)
+
+    def set_output_cols(self, value):
+        return self.set(self.OUTPUT_COLS, list(value) if value is not None else None)
+
+
+class HasPredictionCol(WithParams):
+    """Column name of the prediction output (HasPredictionCol.java:27-41)."""
+
+    PREDICTION_COL: ParamInfo = param_info(
+        "predictionCol", "Column name of prediction.", optional=False, value_type=str,
+    )
+
+    def get_prediction_col(self) -> str:
+        return self.get(self.PREDICTION_COL)
+
+    def set_prediction_col(self, value: str):
+        return self.set(self.PREDICTION_COL, value)
+
+
+class HasPredictionDetailCol(WithParams):
+    PREDICTION_DETAIL_COL: ParamInfo = param_info(
+        "predictionDetailCol",
+        "Column name of prediction detail (e.g. per-class probabilities).",
+        default=None, value_type=str,
+    )
+
+    def get_prediction_detail_col(self):
+        return self.get(self.PREDICTION_DETAIL_COL)
+
+    def set_prediction_detail_col(self, value: str):
+        return self.set(self.PREDICTION_DETAIL_COL, value)
+
+
+class HasReservedCols(WithParams):
+    RESERVED_COLS: ParamInfo = param_info(
+        "reservedCols",
+        "Names of the input columns to keep in the output; None keeps all.",
+        default=None, value_type=list,
+    )
+
+    def get_reserved_cols(self):
+        return self.get(self.RESERVED_COLS)
+
+    def set_reserved_cols(self, value):
+        return self.set(self.RESERVED_COLS, list(value) if value is not None else None)

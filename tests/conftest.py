@@ -1,0 +1,15 @@
+"""Test harness config.
+
+Runs the whole suite on a virtual 8-device CPU mesh so psum/shard_map tests
+exercise real collectives without TPU hardware — the analog of the reference
+running parallel subtasks in Flink's in-JVM mini-cluster (SURVEY.md §4).
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,144 @@
+"""Params system tests — behavior parity with ParamsTest.java:34-153 and
+ExtractParamInfosUtilTest.java:34-101."""
+
+import pytest
+
+from flink_ml_tpu.params import (
+    ParamInfo,
+    Params,
+    WithParams,
+    extract_param_infos,
+    param_info,
+)
+
+
+def test_default_behavior():
+    p = Params()
+    info = param_info("k", "num clusters", default=2)
+    assert p.get(info) == 2
+    p.set(info, 5)
+    assert p.get(info) == 5
+
+
+def test_optional_without_default_raises():
+    p = Params()
+    info = param_info("k", optional=True)
+    with pytest.raises(ValueError, match="default"):
+        p.get(info)
+
+
+def test_required_unset_raises():
+    p = Params()
+    info = param_info("k", optional=False)
+    with pytest.raises(ValueError, match="non-optional"):
+        p.get(info)
+
+
+def test_validator_rejects():
+    p = Params()
+    info = param_info("k", validator=lambda v: v > 0, default=1)
+    p.set(info, 3)
+    with pytest.raises(ValueError, match="invalid"):
+        p.set(info, -1)
+    assert p.get(info) == 3
+
+
+def test_alias_resolution():
+    p = Params()
+    info = param_info("numClusters", alias=["k"], default=2)
+    p.set_raw("k", 7)
+    assert p.get(info) == 7
+
+
+def test_alias_conflict_raises():
+    p = Params()
+    info = param_info("numClusters", alias=["k"], default=2)
+    p.set_raw("numClusters", 3)
+    p.set_raw("k", 7)
+    with pytest.raises(ValueError, match="Duplicate"):
+        p.get(info)
+
+
+def test_remove_clears_aliases():
+    p = Params()
+    info = param_info("numClusters", alias=["k"], default=2)
+    p.set_raw("k", 7)
+    assert p.contains(info)
+    p.remove(info)
+    assert not p.contains(info)
+    assert p.get(info) == 2
+
+
+def test_json_round_trip():
+    p = Params()
+    p.set(param_info("lr"), 0.01)
+    p.set(param_info("cols"), ["a", "b"])
+    p.set(param_info("name"), "model")
+    p.set(param_info("nothing"), None)
+    restored = Params.from_json(p.to_json())
+    assert restored == p
+    assert restored.get(param_info("cols")) == ["a", "b"]
+    assert restored.get(param_info("nothing")) is None
+
+
+def test_merge_and_clone():
+    a = Params().set(param_info("x"), 1)
+    b = Params().set(param_info("x"), 2).set(param_info("y"), 3)
+    c = a.clone()
+    a.merge(b)
+    assert a.get(param_info("x")) == 2
+    assert a.get(param_info("y")) == 3
+    assert c.get(param_info("x")) == 1
+    assert not c.contains(param_info("y"))
+
+
+def test_size_clear_empty():
+    p = Params()
+    assert p.is_empty() and p.size() == 0
+    p.set(param_info("x"), 1)
+    assert len(p) == 1
+    p.clear()
+    assert p.is_empty()
+
+
+class _Base(WithParams):
+    ALPHA = param_info("alpha", default=0.1)
+
+
+class _MixinIface(WithParams):
+    BETA = param_info("beta", default=0.2)
+
+
+class _Derived(_Base, _MixinIface):
+    GAMMA = param_info("gamma", default=0.3)
+
+
+def test_extract_param_infos_walks_mro():
+    infos = extract_param_infos(_Derived())
+    assert set(infos) == {"alpha", "beta", "gamma"}
+    assert all(isinstance(i, ParamInfo) for i in infos.values())
+
+
+def test_with_params_get_set():
+    d = _Derived()
+    assert d.get(_Derived.ALPHA) == 0.1
+    d.set(_Derived.ALPHA, 0.9)
+    assert d.get(_Derived.ALPHA) == 0.9
+    # instance-local params: another instance is untouched
+    assert _Derived().get(_Derived.ALPHA) == 0.1
+
+
+def test_shared_mixins():
+    from flink_ml_tpu.params.shared import HasPredictionCol, HasReservedCols
+
+    class Op(HasPredictionCol, HasReservedCols):
+        pass
+
+    op = Op()
+    op.set_prediction_col("pred")
+    assert op.get_prediction_col() == "pred"
+    assert op.get_reserved_cols() is None
+    op.set_reserved_cols(["a"])
+    assert op.get_reserved_cols() == ["a"]
+    with pytest.raises(ValueError):
+        Op().get_prediction_col()  # required, unset
