@@ -1,0 +1,22 @@
+"""ops — the math kernel.
+
+Replaces the reference's ``flink-ml-lib/common/linalg`` package and its netlib
+BLAS/LAPACK native boundary (BLAS.java, MultivariateGaussian.java:115) with
+XLA-backed computation.  Two tiers, by design (TPU-first, SURVEY.md §7.1):
+
+* **Row tier** (host, numpy): ``DenseVector`` / ``SparseVector`` / ``DenseMatrix``
+  value types with the reference's full method surface — these live in table
+  columns and in the string codec, never in a jit trace.
+* **Batch tier** (device, jnp): batched dense arrays and ``CsrBatch`` sparse
+  batches; ``blas``-surface functions lower to XLA ``dot_general`` etc.  This is
+  what the per-record hot loops of the reference
+  (ModelMapperAdapter.java:58-61, LinearRegression.java:215-231) become.
+"""
+
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector  # noqa: F401
+from flink_ml_tpu.ops.matrix import DenseMatrix  # noqa: F401
+from flink_ml_tpu.ops import blas  # noqa: F401
+from flink_ml_tpu.ops import matvec  # noqa: F401
+from flink_ml_tpu.ops.codec import parse_vector, vector_to_string  # noqa: F401
+from flink_ml_tpu.ops.batch import CsrBatch, dense_batch  # noqa: F401
+from flink_ml_tpu.ops.stats import MultivariateGaussian  # noqa: F401

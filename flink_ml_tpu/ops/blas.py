@@ -1,0 +1,103 @@
+"""BLAS-surface functions — capability parity with BLAS.java.
+
+The reference exposes level-1 via F2J and level-2/3 via native netlib
+(BLAS.java:44-233).  Here every routine is an XLA/numpy expression: level-3
+``gemm`` and level-2 ``gemv`` lower to ``dot_general`` on the MXU when traced
+under jit, and the hand-rolled sparse gemv of BLAS.java:205-233 becomes a
+gather-matmul (see also the batched CSR path in ``flink_ml_tpu.ops.batch``).
+
+Routines accept DenseVector/DenseMatrix value types *or* raw arrays (numpy or
+jnp) — raw-array calls are trace-safe and usable inside jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.ops.matrix import DenseMatrix
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector
+
+
+def _arr(x):
+    if isinstance(x, DenseVector):
+        return x.values
+    if isinstance(x, DenseMatrix):
+        return x.data
+    return x
+
+
+def asum(x) -> float:
+    """sum(|x|) — dasum (BLAS.java:44-52)."""
+    xv = _arr(x)
+    if isinstance(x, SparseVector):
+        xv = x.vals
+    return abs(xv).sum()
+
+
+def axpy(a: float, x, y) -> None:
+    """y += a*x in place — daxpy (BLAS.java:58-86). Dense or sparse x, dense y."""
+    yv = _arr(y)
+    if isinstance(x, SparseVector):
+        np.add.at(yv, x.indices, a * x.vals)
+        return
+    xv = _arr(x)
+    if xv.shape != yv.shape:
+        raise ValueError("axpy size mismatch")
+    yv += a * xv
+
+
+def dot(x, y) -> float:
+    """x . y — ddot (BLAS.java:89-96)."""
+    xv, yv = _arr(x), _arr(y)
+    if isinstance(x, SparseVector) or isinstance(y, SparseVector):
+        sx = x if isinstance(x, SparseVector) else y
+        other = y if sx is x else x
+        return sx.dot(other if isinstance(other, (DenseVector, SparseVector)) else DenseVector(other))
+    if xv.shape != yv.shape:
+        raise ValueError("dot size mismatch")
+    return xv @ yv
+
+
+def scal(a: float, x) -> None:
+    """x *= a in place — dscal (BLAS.java:99-121)."""
+    if isinstance(x, SparseVector):
+        x.vals *= a
+        return
+    xv = _arr(x)
+    xv *= a
+
+
+def gemm(alpha: float, mat_a, trans_a: bool, mat_b, trans_b: bool, beta: float, mat_c) -> None:
+    """C := alpha * op(A) @ op(B) + beta * C, in place on C (BLAS.java:124-172).
+
+    On device this exact contraction is ``alpha * jnp.matmul(opA, opB) + beta*C``
+    — one MXU call; the in-place host form exists for DenseMatrix parity.
+    """
+    a = _arr(mat_a).T if trans_a else _arr(mat_a)
+    b = _arr(mat_b).T if trans_b else _arr(mat_b)
+    c = _arr(mat_c)
+    if a.shape[1] != b.shape[0] or c.shape != (a.shape[0], b.shape[1]):
+        raise ValueError(
+            f"gemm size mismatch: op(A){a.shape} @ op(B){b.shape} -> C{c.shape}"
+        )
+    c[...] = alpha * (a @ b) + beta * c
+
+
+def gemv(alpha: float, mat_a, trans_a: bool, x, beta: float, y) -> None:
+    """y := alpha * op(A) @ x + beta * y, in place on y (BLAS.java:188-233).
+
+    Sparse x takes the gather path that replaces the reference's hand-rolled
+    sparse gemv (BLAS.java:205-233).
+    """
+    a = _arr(mat_a).T if trans_a else _arr(mat_a)
+    yv = _arr(y)
+    if isinstance(x, SparseVector):
+        prod = a[:, x.indices] @ x.vals
+    else:
+        xv = _arr(x)
+        if a.shape[1] != xv.shape[0]:
+            raise ValueError("gemv size mismatch")
+        prod = a @ xv
+    if yv.shape[0] != a.shape[0]:
+        raise ValueError("gemv output size mismatch")
+    yv[...] = alpha * prod + beta * yv

@@ -1,0 +1,92 @@
+"""Vector string codec — VectorUtil.java format parity.
+
+Formats (VectorUtil.java:33-43):
+* dense:          ``"1 2 3"`` (space-separated, also tolerates commas)
+* sparse:         ``"0:1 2:3"`` (index:value pairs)
+* sized sparse:   ``"$4$0:1 2:3"`` (``$size$`` prefix)
+* empty string parses to an empty dense vector
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+
+_SIZE_DELIM = "$"
+_INDEX_VALUE_DELIM = ":"
+
+
+def parse_dense(text: str) -> DenseVector:
+    """Parse the dense format (VectorUtil.parseDense, :64)."""
+    text = text.strip()
+    if not text:
+        return DenseVector(np.zeros(0))
+    parts = text.replace(",", " ").split()
+    try:
+        return DenseVector(np.array([float(p) for p in parts]))
+    except ValueError as e:
+        raise ValueError(f"Fail to parse dense vector from string: {text!r}") from e
+
+
+def parse_sparse(text: str) -> SparseVector:
+    """Parse the sparse format, with optional ``$size$`` prefix (VectorUtil.parseSparse, :136)."""
+    raw = text.strip()
+    size = -1
+    body = raw
+    if raw.startswith(_SIZE_DELIM):
+        end = raw.find(_SIZE_DELIM, 1)
+        if end < 0:
+            raise ValueError(f"Fail to parse sparse vector: unterminated size in {text!r}")
+        size = int(raw[1:end])
+        body = raw[end + 1 :]
+    body = body.strip()
+    if not body:
+        return SparseVector(size)
+    indices, values = [], []
+    for pair in body.replace(",", " ").split():
+        if _INDEX_VALUE_DELIM not in pair:
+            raise ValueError(f"Fail to parse sparse vector from string: {text!r}")
+        i, v = pair.split(_INDEX_VALUE_DELIM, 1)
+        try:
+            indices.append(int(i))
+            values.append(float(v))
+        except ValueError as e:
+            raise ValueError(f"Fail to parse sparse vector from string: {text!r}") from e
+    return SparseVector(size, np.array(indices, dtype=np.int64), np.array(values))
+
+
+def parse_vector(text: str) -> Vector:
+    """Sniff the format and parse (VectorUtil.parse, :44-55)."""
+    t = text.strip()
+    if t.startswith(_SIZE_DELIM) or _INDEX_VALUE_DELIM in t:
+        return parse_sparse(t)
+    return parse_dense(t)
+
+
+def dense_to_string(v: DenseVector) -> str:
+    return " ".join(_fmt(x) for x in v.values)
+
+
+def sparse_to_string(v: SparseVector) -> str:
+    body = " ".join(f"{int(i)}:{_fmt(x)}" for i, x in zip(v.indices, v.vals))
+    if v.n >= 0:
+        return f"${v.n}${body}"
+    return body
+
+
+def vector_to_string(v: Vector) -> str:
+    """Format either kind (VectorUtil.toString, :187-240)."""
+    if isinstance(v, SparseVector):
+        return sparse_to_string(v)
+    if isinstance(v, DenseVector):
+        return dense_to_string(v)
+    raise TypeError(f"not a vector: {type(v)}")
+
+
+def _fmt(x: float) -> str:
+    # integral values print without trailing .0 noise kept minimal: keep repr-style
+    f = float(x)
+    if f == int(f) and abs(f) < 1e16:
+        return str(int(f)) + ".0"
+    return repr(f)

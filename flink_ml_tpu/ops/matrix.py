@@ -1,0 +1,148 @@
+"""DenseMatrix — host-side matrix value type (DenseMatrix.java parity).
+
+The reference stores column-major doubles (DenseMatrix.java:50-52) because
+Fortran BLAS wants that; numpy/XLA prefer row-major, so storage here is a plain
+row-major 2-D float64 array and the *semantics* (shape, factories, sub-matrix,
+multiplies, transpose) are preserved instead of the byte layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+
+
+class DenseMatrix:
+    __slots__ = ("data",)
+
+    def __init__(self, data=None, m: int = None, n: int = None):
+        if data is None:
+            data = np.zeros((m or 0, n or 0), dtype=np.float64)
+        self.data = np.asarray(data, dtype=np.float64)
+        if self.data.ndim != 2:
+            raise ValueError("DenseMatrix requires a 2-D array")
+
+    # factories (DenseMatrix.java:127-204)
+    @staticmethod
+    def eye(m: int, n: int = None) -> "DenseMatrix":
+        return DenseMatrix(np.eye(m, n if n is not None else m))
+
+    @staticmethod
+    def zeros(m: int, n: int) -> "DenseMatrix":
+        return DenseMatrix(np.zeros((m, n)))
+
+    @staticmethod
+    def ones(m: int, n: int) -> "DenseMatrix":
+        return DenseMatrix(np.ones((m, n)))
+
+    @staticmethod
+    def rand(m: int, n: int, rng=None) -> "DenseMatrix":
+        rng = np.random.default_rng() if rng is None else rng
+        return DenseMatrix(rng.random((m, n)))
+
+    @staticmethod
+    def rand_symmetric(n: int, rng=None) -> "DenseMatrix":
+        rng = np.random.default_rng() if rng is None else rng
+        a = rng.random((n, n))
+        return DenseMatrix((a + a.T) / 2.0)
+
+    def num_rows(self) -> int:
+        return int(self.data.shape[0])
+
+    def num_cols(self) -> int:
+        return int(self.data.shape[1])
+
+    def get(self, i: int, j: int) -> float:
+        return float(self.data[i, j])
+
+    def set(self, i: int, j: int, value: float) -> None:
+        self.data[i, j] = value
+
+    def add(self, i: int, j: int, value: float) -> None:
+        self.data[i, j] += value
+
+    def clone(self) -> "DenseMatrix":
+        return DenseMatrix(self.data.copy())
+
+    def select_rows(self, rows) -> "DenseMatrix":
+        """Row subset (DenseMatrix.java:302)."""
+        return DenseMatrix(self.data[np.asarray(rows, dtype=np.int64), :])
+
+    def get_sub_matrix(self, m0: int, m1: int, n0: int, n1: int) -> "DenseMatrix":
+        """Half-open [m0,m1) x [n0,n1) block (DenseMatrix.java:321)."""
+        return DenseMatrix(self.data[m0:m1, n0:n1].copy())
+
+    def set_sub_matrix(self, sub: "DenseMatrix", m0: int, m1: int, n0: int, n1: int) -> None:
+        self.data[m0:m1, n0:n1] = sub.data
+
+    def get_row(self, i: int) -> np.ndarray:
+        return self.data[i, :].copy()
+
+    def get_column(self, j: int) -> np.ndarray:
+        return self.data[:, j].copy()
+
+    def sum(self) -> float:
+        return float(self.data.sum())
+
+    def scale(self, factor: float) -> "DenseMatrix":
+        return DenseMatrix(self.data * factor)
+
+    def scale_equal(self, factor: float) -> None:
+        self.data *= factor
+
+    def plus(self, other) -> "DenseMatrix":
+        if isinstance(other, DenseMatrix):
+            return DenseMatrix(self.data + other.data)
+        return DenseMatrix(self.data + float(other))
+
+    def plus_equals(self, other) -> None:
+        if isinstance(other, DenseMatrix):
+            self.data += other.data
+        else:
+            self.data += float(other)
+
+    def minus(self, other: "DenseMatrix") -> "DenseMatrix":
+        return DenseMatrix(self.data - other.data)
+
+    def minus_equals(self, other: "DenseMatrix") -> None:
+        self.data -= other.data
+
+    def multiplies(self, other):
+        """Matrix @ matrix or matrix @ vector via gemm/gemv (DenseMatrix.java:482-517)."""
+        if isinstance(other, DenseMatrix):
+            if self.num_cols() != other.num_rows():
+                raise ValueError("matrix size mismatch")
+            return DenseMatrix(self.data @ other.data)
+        if isinstance(other, SparseVector):
+            if other.size() >= 0 and self.num_cols() != other.size():
+                raise ValueError("matrix/vector size mismatch")
+            return DenseVector(self.data[:, other.indices] @ other.vals)
+        if isinstance(other, (DenseVector, Vector)):
+            v = other.to_dense().values
+            if self.num_cols() != v.size:
+                raise ValueError("matrix/vector size mismatch")
+            return DenseVector(self.data @ v)
+        raise TypeError(f"cannot multiply DenseMatrix by {type(other)}")
+
+    def transpose(self) -> "DenseMatrix":
+        return DenseMatrix(self.data.T.copy())
+
+    def is_square(self) -> bool:
+        return self.data.shape[0] == self.data.shape[1]
+
+    def is_symmetric(self, tol: float = 1e-6) -> bool:
+        return self.is_square() and bool(np.allclose(self.data, self.data.T, atol=tol))
+
+    def get_array_copy_2d(self) -> np.ndarray:
+        return self.data.copy()
+
+    def get_array_copy_1d(self) -> np.ndarray:
+        """Row-major flattening (reference offers both layouts, :544-560)."""
+        return self.data.reshape(-1).copy()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DenseMatrix) and np.array_equal(self.data, other.data)
+
+    def __repr__(self) -> str:
+        return f"DenseMatrix({self.data.tolist()})"

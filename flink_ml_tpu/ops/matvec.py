@@ -1,0 +1,77 @@
+"""Elementwise functional ops on vectors/matrices — MatVecOp.java parity.
+
+``apply(x, y, func)`` and the reductions generalize the reference's dispatch
+over dense/sparse/matrix operands (MatVecOp.java:88-300).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from flink_ml_tpu.ops.matrix import DenseMatrix
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+
+
+def plus(x: Vector, y: Vector) -> Vector:
+    return x.plus(y)
+
+
+def minus(x: Vector, y: Vector) -> Vector:
+    return x.minus(y)
+
+
+def dot(x: Vector, y: Vector) -> float:
+    return x.dot(y)
+
+
+def sum_abs_diff(x: Vector, y: Vector) -> float:
+    """sum(|x_i - y_i|) across all slots (MatVecOp.java:46-66)."""
+    return float(np.abs(x.to_dense().values - y.to_dense().values).sum())
+
+
+def sum_squared_diff(x: Vector, y: Vector) -> float:
+    """sum((x_i - y_i)^2) (MatVecOp.java:68-86)."""
+    d = x.to_dense().values - y.to_dense().values
+    return float(d @ d)
+
+
+def apply(x, y=None, func: Callable = None):
+    """Elementwise apply, dispatching on operand kinds (MatVecOp.java:88-200).
+
+    ``apply(x, func=f)`` maps f over x's elements; ``apply(x, y, f)`` zips.
+    Sparse inputs with a unary func keep sparsity (f applied to stored values).
+    """
+    if func is None:
+        raise ValueError("func is required")
+    f = np.vectorize(func, otypes=[np.float64])
+    if y is None:
+        if isinstance(x, DenseMatrix):
+            return DenseMatrix(f(x.data))
+        if isinstance(x, DenseVector):
+            return DenseVector(f(x.values))
+        if isinstance(x, SparseVector):
+            return SparseVector(x.n, x.indices.copy(), f(x.vals))
+        return f(np.asarray(x))
+    if isinstance(x, DenseMatrix) and isinstance(y, DenseMatrix):
+        if x.data.shape != y.data.shape:
+            raise ValueError("matrix shape mismatch")
+        return DenseMatrix(f(x.data, y.data))
+    xv = x.to_dense().values if isinstance(x, Vector) else np.asarray(x)
+    yv = y.to_dense().values if isinstance(y, Vector) else np.asarray(y)
+    if xv.shape != yv.shape:
+        raise ValueError("vector size mismatch")
+    return DenseVector(f(xv, yv))
+
+
+def apply_sum(x, y=None, func: Callable = None) -> float:
+    """Reduce func over elements (MatVecOp.java:202-300)."""
+    out = apply(x, y, func)
+    if isinstance(out, DenseMatrix):
+        return float(out.data.sum())
+    if isinstance(out, SparseVector):
+        return float(out.vals.sum())
+    if isinstance(out, DenseVector):
+        return float(out.values.sum())
+    return float(np.sum(out))

@@ -167,7 +167,20 @@ class Params:
         return info.default
 
     def set(self, info: ParamInfo[V], value: V) -> "Params":
-        """Set a value, running the validator hook first (Params.java:138-145)."""
+        """Set a value, checking declared type then the validator hook (Params.java:138-145)."""
+        if info.value_type is not None and value is not None:
+            vt = info.value_type
+            ok = (
+                isinstance(value, vt)
+                # ints are acceptable where floats are declared (but bools are not)
+                or (vt is float and isinstance(value, int) and not isinstance(value, bool))
+                # tuples are acceptable where lists are declared (JSON makes them lists)
+                or (vt is list and isinstance(value, tuple))
+            )
+            if not ok:
+                raise TypeError(
+                    f"Setting {info.name}: expected {vt.__name__}, got {type(value).__name__}"
+                )
         if info.validator is not None and not info.validator(value):
             raise ValueError(f"Setting {info.name} as a invalid value:{value}")
         self._params[info.name] = self._encode(value)

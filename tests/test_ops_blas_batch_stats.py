@@ -1,0 +1,173 @@
+"""BLAS-surface, batch-tier, and statistics tests — parity with BLASTest.java
+(golden values + size-check failures) and MultivariateGaussianTest.java
+(incl. the degenerate singular-covariance case), plus CsrBatch device math
+checked against dense references."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.ops import (
+    CsrBatch,
+    DenseMatrix,
+    DenseVector,
+    MultivariateGaussian,
+    SparseVector,
+    blas,
+    dense_batch,
+)
+
+
+class TestBlas:
+    def test_asum_axpy_scal_dot(self):
+        x = DenseVector([1, -2, 3])
+        assert blas.asum(x) == 6.0
+        y = DenseVector([1, 1, 1])
+        blas.axpy(2.0, x, y)
+        assert y.values.tolist() == [3, -3, 7]
+        blas.scal(0.5, y)
+        assert y.values.tolist() == [1.5, -1.5, 3.5]
+        assert blas.dot(DenseVector([1, 2]), DenseVector([3, 4])) == 11.0
+
+    def test_sparse_axpy_dot(self):
+        y = DenseVector([0, 0, 0])
+        blas.axpy(3.0, SparseVector(3, [1], [2.0]), y)
+        assert y.values.tolist() == [0, 6, 0]
+        assert blas.dot(SparseVector(3, [2], [2.0]), DenseVector([1, 1, 5])) == 10.0
+
+    def test_gemm_golden(self):
+        a = DenseMatrix([[1, 2], [3, 4]])
+        b = DenseMatrix([[5, 6], [7, 8]])
+        c = DenseMatrix([[1, 1], [1, 1]])
+        blas.gemm(1.0, a, False, b, False, 1.0, c)
+        assert c.data.tolist() == [[20, 23], [44, 51]]
+
+    def test_gemm_transposes(self):
+        a = DenseMatrix([[1, 2, 3], [4, 5, 6]])  # 2x3
+        b = DenseMatrix([[1, 0], [0, 1], [1, 1]])  # 3x2
+        c = DenseMatrix.zeros(3, 3)
+        blas.gemm(1.0, a, True, b, True, 0.0, c)  # (3x2)@(2x3)
+        expect = a.data.T @ b.data.T
+        assert np.allclose(c.data, expect)
+
+    def test_gemm_size_check(self):
+        with pytest.raises(ValueError):
+            blas.gemm(1.0, DenseMatrix.ones(2, 3), False, DenseMatrix.ones(2, 3), False,
+                      0.0, DenseMatrix.zeros(2, 3))
+
+    def test_gemv_dense_sparse(self):
+        a = DenseMatrix([[1, 2, 3], [4, 5, 6]])
+        y = DenseVector([1, 1])
+        blas.gemv(1.0, a, False, DenseVector([1, 0, 1]), 2.0, y)
+        assert y.values.tolist() == [6, 12]
+        y2 = DenseVector.zeros(2)
+        blas.gemv(1.0, a, False, SparseVector(3, [0, 2], [1.0, 1.0]), 0.0, y2)
+        assert y2.values.tolist() == [4, 10]
+
+    def test_gemv_transpose(self):
+        a = DenseMatrix([[1, 2], [3, 4], [5, 6]])
+        y = DenseVector.zeros(2)
+        blas.gemv(1.0, a, True, DenseVector([1, 1, 1]), 0.0, y)
+        assert y.values.tolist() == [9, 12]
+
+    def test_gemv_size_check(self):
+        with pytest.raises(ValueError):
+            blas.gemv(1.0, DenseMatrix.ones(2, 3), False, DenseVector([1, 1]), 0.0,
+                      DenseVector.zeros(2))
+
+
+class TestBatchTier:
+    def test_dense_batch_packs_mixed_rows(self):
+        rows = [DenseVector([1, 2, 0]), SparseVector(3, [2], [5.0])]
+        b = dense_batch(rows)
+        assert b.tolist() == [[1, 2, 0], [0, 0, 5]]
+
+    def _random_csr(self, rng, n_rows=16, n_cols=32, density=0.2):
+        vecs = []
+        for _ in range(n_rows):
+            nnz = max(1, int(density * n_cols))
+            idx = rng.choice(n_cols, size=nnz, replace=False)
+            vecs.append(SparseVector(n_cols, idx, rng.standard_normal(nnz)))
+        return vecs
+
+    def test_csr_matvec_matches_dense(self):
+        rng = np.random.default_rng(0)
+        vecs = self._random_csr(rng)
+        batch = CsrBatch.from_vectors(vecs, n_cols=32, pad_multiple=64)
+        dense = dense_batch(vecs, 32)
+        w = rng.standard_normal(32)
+        np.testing.assert_allclose(np.asarray(batch.matvec(jnp.asarray(w, jnp.float32))),
+                                   dense @ w, rtol=1e-4)
+
+    def test_csr_matmul_rmatvec_match_dense(self):
+        rng = np.random.default_rng(1)
+        vecs = self._random_csr(rng, n_rows=8, n_cols=16)
+        batch = CsrBatch.from_vectors(vecs, n_cols=16, pad_multiple=32)
+        dense = dense_batch(vecs, 16)
+        w = rng.standard_normal((16, 4))
+        np.testing.assert_allclose(np.asarray(batch.matmul(jnp.asarray(w, jnp.float32))),
+                                   dense @ w, rtol=1e-4)
+        y = rng.standard_normal(8)
+        np.testing.assert_allclose(np.asarray(batch.rmatvec(jnp.asarray(y, jnp.float32))),
+                                   dense.T @ y, rtol=1e-4)
+
+    def test_csr_to_dense_and_norms(self):
+        vecs = [SparseVector(4, [0, 3], [1.0, 2.0]), SparseVector(4, [1], [3.0])]
+        batch = CsrBatch.from_vectors(vecs, n_cols=4, pad_multiple=8)
+        assert np.asarray(batch.to_dense()).tolist() == [[1, 0, 0, 2], [0, 3, 0, 0]]
+        assert np.asarray(batch.row_norms_l2_square()).tolist() == [5.0, 9.0]
+
+    def test_csr_is_jittable_pytree(self):
+        vecs = [SparseVector(4, [1], [2.0]), SparseVector(4, [2], [3.0])]
+        batch = CsrBatch.from_vectors(vecs, n_cols=4, pad_multiple=8)
+
+        @jax.jit
+        def f(b, w):
+            return b.matvec(w)
+
+        out = f(batch, jnp.ones(4, jnp.float32))
+        assert np.asarray(out).tolist() == [2.0, 3.0]
+
+    def test_pad_rows_contribute_nothing(self):
+        # rmatvec must ignore pad slots even with non-trivial y
+        vecs = [SparseVector(3, [0], [1.0])]
+        batch = CsrBatch.from_vectors(vecs, n_cols=3, pad_multiple=16)
+        out = np.asarray(batch.rmatvec(jnp.full((1,), 7.0, jnp.float32)))
+        assert out.tolist() == [7.0, 0.0, 0.0]
+
+
+class TestMultivariateGaussian:
+    def test_pdf_matches_scipy_formula(self):
+        mean = np.array([0.0, 0.0])
+        cov = np.array([[2.0, 0.3], [0.3, 1.0]])
+        g = MultivariateGaussian(mean, cov)
+        x = np.array([0.5, -0.2])
+        # closed form
+        inv = np.linalg.inv(cov)
+        expect = np.exp(-0.5 * x @ inv @ x) / (2 * np.pi * np.sqrt(np.linalg.det(cov)))
+        assert np.isclose(g.pdf(DenseVector(x)), expect, rtol=1e-10)
+
+    def test_degenerate_covariance_pseudo(self):
+        # rank-1 covariance: density defined on the support via pseudo-determinant
+        # (reference MultivariateGaussianTest degenerate case, tol 1e-5)
+        mean = np.zeros(2)
+        cov = np.array([[1.0, 1.0], [1.0, 1.0]])
+        g = MultivariateGaussian(mean, cov)
+        val = g.pdf(DenseVector([1.0, 1.0]))
+        # reference keeps the full k in (2*pi)^(-k/2) and uses the pseudo-det (=2);
+        # quadratic form along the support direction is 1
+        expect = np.exp(-0.5 * 1.0) / (2 * np.pi * np.sqrt(2.0))
+        assert np.isclose(val, expect, atol=1e-5)
+        # off-support direction gets no penalty (pseudo-inverse null space)
+        assert np.isclose(g.logpdf(DenseVector([1.0, -1.0])), g.logpdf(DenseVector([0.0, 0.0])))
+
+    def test_batch_matches_single(self):
+        rng = np.random.default_rng(2)
+        mean = rng.standard_normal(3)
+        a = rng.standard_normal((3, 3))
+        g = MultivariateGaussian(mean, a @ a.T + np.eye(3))
+        xs = rng.standard_normal((5, 3))
+        singles = [g.logpdf(x) for x in xs]
+        np.testing.assert_allclose(g.logpdf_batch(xs), singles, rtol=1e-12)

@@ -1,0 +1,209 @@
+"""Vector/matrix kernel tests — golden-value parity with the reference's
+DenseVectorTest, SparseVectorTest, DenseMatrixTest, MatVecOpTest, VectorUtilTest."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops import (
+    DenseMatrix,
+    DenseVector,
+    SparseVector,
+    matvec,
+    parse_vector,
+    vector_to_string,
+)
+from flink_ml_tpu.ops.codec import parse_dense, parse_sparse
+
+
+class TestDenseVector:
+    def test_factories(self):
+        assert DenseVector.ones(3).values.tolist() == [1, 1, 1]
+        assert DenseVector.zeros(2).values.tolist() == [0, 0]
+        assert DenseVector.rand(4).size() == 4
+
+    def test_norms(self):
+        v = DenseVector([3.0, -4.0])
+        assert v.norm_l1() == 7.0
+        assert v.norm_l2() == 5.0
+        assert v.norm_l2_square() == 25.0
+        assert v.norm_inf() == 4.0
+
+    def test_plus_minus_dot(self):
+        a, b = DenseVector([1, 2, 3]), DenseVector([4, 5, 6])
+        assert a.plus(b).values.tolist() == [5, 7, 9]
+        assert b.minus(a).values.tolist() == [3, 3, 3]
+        assert a.dot(b) == 32.0
+        with pytest.raises(ValueError):
+            a.dot(DenseVector([1, 2]))
+
+    def test_inplace(self):
+        v = DenseVector([1, 2])
+        v.plus_equal(DenseVector([1, 1]))
+        assert v.values.tolist() == [2, 3]
+        v.minus_equal(DenseVector([1, 1]))
+        assert v.values.tolist() == [1, 2]
+        v.plus_scale_equal(DenseVector([2, 2]), 0.5)
+        assert v.values.tolist() == [2, 3]
+        v.scale_equal(2.0)
+        assert v.values.tolist() == [4, 6]
+
+    def test_prefix_append_slice(self):
+        v = DenseVector([1, 2])
+        assert v.prefix(0).values.tolist() == [0, 1, 2]
+        assert v.append(3).values.tolist() == [1, 2, 3]
+        assert v.slice([1]).values.tolist() == [2]
+
+    def test_normalize_standardize(self):
+        v = DenseVector([3, 4])
+        v.normalize(2)
+        assert np.allclose(v.values, [0.6, 0.8])
+        w = DenseVector([1, 3])
+        w.standardize(2.0, 1.0)
+        assert w.values.tolist() == [-1, 1]
+
+    def test_outer(self):
+        m = DenseVector([1, 2]).outer(DenseVector([3, 4, 5]))
+        assert m.data.tolist() == [[3, 4, 5], [6, 8, 10]]
+
+    def test_iterator(self):
+        assert list(DenseVector([5, 6]).iterator()) == [(0, 5.0), (1, 6.0)]
+
+
+class TestSparseVector:
+    def test_ctor_sorts_and_merges(self):
+        v = SparseVector(5, [3, 1, 3], [1.0, 2.0, 4.0])
+        assert v.indices.tolist() == [1, 3]
+        assert v.vals.tolist() == [2.0, 5.0]
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            SparseVector(2, [0, 5], [1.0, 1.0])
+
+    def test_get_set_add(self):
+        v = SparseVector(6, [1, 4], [1.0, 2.0])
+        assert v.get(4) == 2.0
+        assert v.get(0) == 0.0
+        v.set(2, 9.0)
+        assert v.get(2) == 9.0
+        v.add(4, 1.0)
+        assert v.get(4) == 3.0
+        assert v.indices.tolist() == [1, 2, 4]
+
+    def test_sparse_sparse_dot(self):
+        a = SparseVector(8, [0, 3, 5], [1.0, 2.0, 3.0])
+        b = SparseVector(8, [3, 5, 7], [4.0, 5.0, 6.0])
+        assert a.dot(b) == 2 * 4 + 3 * 5
+
+    def test_sparse_dense_ops(self):
+        s = SparseVector(3, [1], [2.0])
+        d = DenseVector([1, 1, 1])
+        assert s.plus(d).values.tolist() == [1, 3, 1]
+        assert s.dot(d) == 2.0
+        assert s.minus(d).values.tolist() == [-1, 1, -1]
+        assert d.plus(s).values.tolist() == [1, 3, 1]
+
+    def test_to_dense_and_unknown_size(self):
+        v = SparseVector(-1, [2], [7.0])
+        assert v.to_dense().values.tolist() == [0, 0, 7]
+        assert v.size() == -1
+
+    def test_remove_zero_values(self):
+        v = SparseVector(4, [0, 2], [0.0, 5.0])
+        v.remove_zero_values()
+        assert v.indices.tolist() == [2]
+
+    def test_prefix_append(self):
+        v = SparseVector(3, [1], [5.0])
+        p = v.prefix(9.0)
+        assert p.size() == 4 and p.get(0) == 9.0 and p.get(2) == 5.0
+        a = v.append(8.0)
+        assert a.size() == 4 and a.get(3) == 8.0
+
+    def test_outer(self):
+        v = SparseVector(2, [1], [2.0])
+        m = v.outer()
+        assert m.data.tolist() == [[0, 0], [0, 4]]
+
+
+class TestDenseMatrix:
+    def test_factories(self):
+        assert DenseMatrix.eye(2).data.tolist() == [[1, 0], [0, 1]]
+        assert DenseMatrix.ones(1, 2).data.tolist() == [[1, 1]]
+        assert DenseMatrix.rand_symmetric(3).is_symmetric()
+
+    def test_multiplies_matrix(self):
+        a = DenseMatrix([[1, 2], [3, 4]])
+        b = DenseMatrix([[5, 6], [7, 8]])
+        assert a.multiplies(b).data.tolist() == [[19, 22], [43, 50]]
+        with pytest.raises(ValueError):
+            a.multiplies(DenseMatrix.ones(3, 3))
+
+    def test_multiplies_vector(self):
+        a = DenseMatrix([[1, 2], [3, 4]])
+        assert a.multiplies(DenseVector([1, 1])).values.tolist() == [3, 7]
+        assert a.multiplies(SparseVector(2, [1], [2.0])).values.tolist() == [4, 8]
+
+    def test_submatrix_rows(self):
+        a = DenseMatrix([[1, 2, 3], [4, 5, 6], [7, 8, 9]])
+        assert a.select_rows([0, 2]).data.tolist() == [[1, 2, 3], [7, 8, 9]]
+        assert a.get_sub_matrix(0, 2, 1, 3).data.tolist() == [[2, 3], [5, 6]]
+
+    def test_transpose_scale_sum(self):
+        a = DenseMatrix([[1, 2], [3, 4]])
+        assert a.transpose().data.tolist() == [[1, 3], [2, 4]]
+        assert a.scale(2).data.tolist() == [[2, 4], [6, 8]]
+        assert a.sum() == 10.0
+
+
+class TestMatVecOp:
+    def test_sum_diffs(self):
+        a, b = DenseVector([1, 2]), DenseVector([3, 0])
+        assert matvec.sum_abs_diff(a, b) == 4.0
+        assert matvec.sum_squared_diff(a, b) == 8.0
+        s = SparseVector(2, [0], [1.0])
+        assert matvec.sum_abs_diff(s, b) == 2 + 0
+
+    def test_apply(self):
+        v = matvec.apply(DenseVector([1, -2]), func=abs)
+        assert v.values.tolist() == [1, 2]
+        z = matvec.apply(DenseVector([1, 2]), DenseVector([3, 4]), func=lambda x, y: x * y)
+        assert z.values.tolist() == [3, 8]
+        s = matvec.apply(SparseVector(3, [1], [-4.0]), func=abs)
+        assert isinstance(s, SparseVector) and s.vals.tolist() == [4.0]
+
+    def test_apply_sum(self):
+        assert matvec.apply_sum(DenseVector([1, 2]), DenseVector([1, 1]),
+                                func=lambda x, y: (x - y) ** 2) == 1.0
+
+
+class TestCodec:
+    def test_dense_round_trip(self):
+        v = parse_dense("1 2 -3.5")
+        assert v.values.tolist() == [1, 2, -3.5]
+        assert parse_dense(vector_to_string(v)) == v
+
+    def test_dense_commas(self):
+        assert parse_dense("1, 2, 3").values.tolist() == [1, 2, 3]
+
+    def test_sparse_round_trip(self):
+        v = parse_sparse("0:1 2:3")
+        assert v.indices.tolist() == [0, 2] and v.vals.tolist() == [1, 3]
+        assert parse_sparse(vector_to_string(v)) == v
+
+    def test_sized_sparse(self):
+        v = parse_sparse("$4$0:1 2:3")
+        assert v.size() == 4
+        assert vector_to_string(v).startswith("$4$")
+        assert parse_vector(vector_to_string(v)) == v
+
+    def test_parse_sniffs_format(self):
+        assert isinstance(parse_vector("1 2 3"), DenseVector)
+        assert isinstance(parse_vector("0:1 2:3"), SparseVector)
+        assert isinstance(parse_vector("$4$0:1"), SparseVector)
+        assert parse_vector("").size() == 0
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            parse_dense("1 x 3")
+        with pytest.raises(ValueError):
+            parse_sparse("$4 0:1")

@@ -142,3 +142,18 @@ def test_shared_mixins():
     assert op.get_reserved_cols() == ["a"]
     with pytest.raises(ValueError):
         Op().get_prediction_col()  # required, unset
+
+
+def test_value_type_enforced():
+    p = Params()
+    info = param_info("col", value_type=str)
+    with pytest.raises(TypeError, match="expected str"):
+        p.set(info, 123)
+    p.set(info, "ok")
+    finfo = param_info("lr", value_type=float)
+    p.set(finfo, 1)  # int where float declared is fine
+    with pytest.raises(TypeError):
+        p.set(finfo, True)  # bool is not a number here
+    linfo = param_info("cols", value_type=list)
+    p.set(linfo, ("a", "b"))  # tuple ok, becomes list
+    assert Params.from_json(p.to_json()).get(linfo) == ["a", "b"]
