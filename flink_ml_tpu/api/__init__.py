@@ -1,0 +1,18 @@
+"""api — the Pipeline layer (flink-ml-api core parity).
+
+Stage/Estimator/Transformer/Model/AlgoOperator protocols, Pipeline with the
+reference's exact fit-chaining algorithm (Pipeline.java:69-97), PipelineModel
+sequential transform (PipelineModel.java:53-59), and *working* save/load —
+the contract the reference declared (Stage.java:39-43) but left throwing
+(Pipeline.java:100-106, PipelineModel.java:61-68).
+"""
+
+from flink_ml_tpu.api.core import (  # noqa: F401
+    AlgoOperator,
+    Estimator,
+    Model,
+    Stage,
+    Transformer,
+    load_stage,
+)
+from flink_ml_tpu.api.pipeline import Pipeline, PipelineModel  # noqa: F401

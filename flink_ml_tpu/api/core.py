@@ -1,0 +1,134 @@
+"""Core pipeline node hierarchy.
+
+Parity map (flink-ml-api/.../api/core/):
+  Stage.java:37-44            -> Stage (save/load contract, params holder)
+  Estimator.java:31-39        -> Estimator.fit(*tables) -> Model
+  AlgoOperator.java:153-161   -> AlgoOperator.transform(*tables) -> tuple[Table]
+  Transformer.java:70-71      -> Transformer (1-in/1-out marker; transform1)
+  Model.java:102-122          -> Model (set_model_data/get_model_data, default
+                                 unsupported, exactly like the reference)
+
+save/load layout per stage directory:
+  stage.json   {"module": ..., "class": ..., "params": <Params json>}
+  model data   whatever save_model_data writes (tables via utils.persistence)
+
+Loading follows the reference's static-`load`-by-convention (Stage.java:41-43):
+``load_stage(path)`` imports the recorded class and calls its ``load``
+classmethod (the base implementation restores params + model data).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import List, Tuple
+
+from flink_ml_tpu.params import Params, WithParams
+from flink_ml_tpu.table.table import Table
+
+_STAGE_FILE = "stage.json"
+
+
+class Stage(WithParams):
+    """Root of the pipeline node hierarchy; serializable via save/load."""
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "module": type(self).__module__,
+            "class": type(self).__qualname__,
+            "params": self.get_params().to_json(),
+        }
+        with open(os.path.join(path, _STAGE_FILE), "w") as f:
+            json.dump(meta, f, indent=2)
+        self.save_model_data(path)
+
+    @classmethod
+    def load(cls, path: str) -> "Stage":
+        with open(os.path.join(path, _STAGE_FILE)) as f:
+            meta = json.load(f)
+        klass = _resolve_class(meta["module"], meta["class"])
+        if not issubclass(klass, Stage):
+            raise TypeError(f"{klass} is not a Stage")
+        stage = klass.__new__(klass)
+        Stage.__init__(stage)  # params container
+        stage._params = Params.from_json(meta["params"])
+        stage.load_model_data(path)
+        return stage
+
+    # hooks for stages that carry model data
+    def save_model_data(self, path: str) -> None:
+        pass
+
+    def load_model_data(self, path: str) -> None:
+        pass
+
+
+class AlgoOperator(Stage):
+    """Multi-in/multi-out relational compute (AlgoOperator.java:153-161)."""
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        raise NotImplementedError
+
+    def transform1(self, table: Table) -> Table:
+        """Convenience for the ubiquitous 1-in/1-out case."""
+        out = self.transform(table)
+        if len(out) != 1:
+            raise ValueError(f"expected exactly one output table, got {len(out)}")
+        return out[0]
+
+
+class Transformer(AlgoOperator):
+    """Marker: row-wise 1-in/1-out semantics (Transformer.java:70-71)."""
+
+
+class Model(Transformer):
+    """A Transformer with attached model data (Model.java:102-122)."""
+
+    def set_model_data(self, *inputs: Table) -> "Model":
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support set_model_data"
+        )
+
+    def get_model_data(self) -> Tuple[Table, ...]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support get_model_data"
+        )
+
+
+class Estimator(Stage):
+    """fit(*tables) -> Model (Estimator.java:31-39)."""
+
+    def fit(self, *inputs: Table) -> Model:
+        raise NotImplementedError
+
+
+def load_stage(path: str) -> Stage:
+    """Load any saved stage by the recorded class (static-load convention)."""
+    with open(os.path.join(path, _STAGE_FILE)) as f:
+        meta = json.load(f)
+    klass = _resolve_class(meta["module"], meta["class"])
+    loader = getattr(klass, "load", None)
+    if loader is None:
+        raise TypeError(f"{klass} has no load classmethod")
+    return loader(path)
+
+
+def _resolve_class(module: str, qualname: str):
+    try:
+        mod = importlib.import_module(module)
+        obj = mod
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj
+    except (ImportError, AttributeError) as e:
+        hint = (
+            " (the stage class was defined in __main__; define stages in an "
+            "importable module to reload them from another process)"
+            if module == "__main__"
+            else ""
+        )
+        raise ImportError(
+            f"cannot resolve stage class {module}.{qualname}{hint}"
+        ) from e

@@ -1,0 +1,123 @@
+"""Pipeline and PipelineModel.
+
+``Pipeline.fit`` reproduces the reference's chaining algorithm exactly
+(Pipeline.java:69-97): find the last Estimator; walk stages, reusing
+AlgoOperators as-is and fitting Estimators; feed each produced model's
+transform output forward only while an Estimator still lies ahead.
+``PipelineModel.transform`` applies stages sequentially
+(PipelineModel.java:53-59).
+
+save/load — implemented (the reference throws, Pipeline.java:100-106):
+a pipeline directory holds ``pipeline.json`` plus one numbered subdirectory
+per stage, each saved via the Stage contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Sequence, Tuple
+
+from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, load_stage
+from flink_ml_tpu.table.table import Table
+
+_PIPELINE_FILE = "pipeline.json"
+
+
+class Pipeline(Estimator):
+    """An Estimator composed of stages (Estimators / Transformers / AlgoOperators)."""
+
+    def __init__(self, stages: Sequence[Stage] = ()):
+        self.stages: List[Stage] = list(stages)
+
+    def append_stage(self, stage: Stage) -> "Pipeline":
+        self.stages.append(stage)
+        return self
+
+    def fit(self, *inputs: Table) -> "PipelineModel":
+        last_estimator_idx = -1
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                last_estimator_idx = i
+
+        model_stages: List[AlgoOperator] = []
+        last_inputs = inputs
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                model_stage: AlgoOperator = stage.fit(*last_inputs)
+            elif isinstance(stage, AlgoOperator):
+                model_stage = stage
+            else:
+                raise TypeError(
+                    f"stage {i} ({type(stage).__name__}) is neither Estimator nor AlgoOperator"
+                )
+            model_stages.append(model_stage)
+            if i < last_estimator_idx:
+                last_inputs = model_stage.transform(*last_inputs)
+        return PipelineModel(model_stages)
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        _save_stages(self.stages, path, kind="Pipeline")
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        _check_kind(path, "Pipeline")
+        _, stages = _load_stages(path)
+        return Pipeline(stages)
+
+
+class PipelineModel(Model):
+    """A Model composed of stages; sequential transform (PipelineModel.java:53-59)."""
+
+    def __init__(self, stages: Sequence[AlgoOperator] = ()):
+        self.stages: List[AlgoOperator] = list(stages)
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        last_inputs = inputs
+        for stage in self.stages:
+            last_inputs = stage.transform(*last_inputs)
+        return last_inputs
+
+    def save(self, path: str) -> None:
+        _save_stages(self.stages, path, kind="PipelineModel")
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        _check_kind(path, "PipelineModel")
+        _, stages = _load_stages(path)
+        return PipelineModel(stages)
+
+
+def _save_stages(stages: Sequence[Stage], path: str, kind: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _PIPELINE_FILE), "w") as f:
+        json.dump({"kind": kind, "num_stages": len(stages)}, f)
+    # also record the standard stage descriptor so a pipeline nests inside
+    # another pipeline and load_stage() resolves it uniformly
+    container = Pipeline if kind == "Pipeline" else PipelineModel
+    with open(os.path.join(path, "stage.json"), "w") as f:
+        json.dump(
+            {"module": container.__module__, "class": container.__qualname__, "params": "{}"},
+            f,
+        )
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, f"stage_{i:03d}"))
+
+
+def _check_kind(path: str, expected: str) -> None:
+    with open(os.path.join(path, _PIPELINE_FILE)) as f:
+        kind = json.load(f)["kind"]
+    if kind != expected:
+        raise ValueError(f"{path} holds a {kind}, not a {expected}")
+
+
+def _load_stages(path: str) -> Tuple[str, List[Stage]]:
+    with open(os.path.join(path, _PIPELINE_FILE)) as f:
+        meta = json.load(f)
+    stages = [
+        load_stage(os.path.join(path, f"stage_{i:03d}"))
+        for i in range(meta["num_stages"])
+    ]
+    return meta["kind"], stages

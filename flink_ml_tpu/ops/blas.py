@@ -6,8 +6,11 @@ The reference exposes level-1 via F2J and level-2/3 via native netlib
 under jit, and the hand-rolled sparse gemv of BLAS.java:205-233 becomes a
 gather-matmul (see also the batched CSR path in ``flink_ml_tpu.ops.batch``).
 
-Routines accept DenseVector/DenseMatrix value types *or* raw arrays (numpy or
-jnp) — raw-array calls are trace-safe and usable inside jit.
+Routines accept DenseVector/DenseMatrix value types or raw *numpy* arrays.
+The in-place routines (axpy/scal/gemm/gemv) mutate their output operand and
+therefore require mutable numpy-backed buffers; inside jit, write the
+functional jnp expression directly (``y + a*x``, ``jnp.matmul``) — that is the
+idiomatic XLA form of these routines and what the framework's hot paths use.
 """
 
 from __future__ import annotations
@@ -26,6 +29,17 @@ def _arr(x):
     return x
 
 
+def _mutable(x):
+    """Output operand of an in-place routine: must be a numpy buffer."""
+    arr = _arr(x)
+    if not isinstance(arr, np.ndarray):
+        raise TypeError(
+            "in-place BLAS routines require numpy-backed operands; inside jit "
+            "use the functional jnp expression instead (e.g. y + a*x)"
+        )
+    return arr
+
+
 def asum(x) -> float:
     """sum(|x|) — dasum (BLAS.java:44-52)."""
     xv = _arr(x)
@@ -36,7 +50,7 @@ def asum(x) -> float:
 
 def axpy(a: float, x, y) -> None:
     """y += a*x in place — daxpy (BLAS.java:58-86). Dense or sparse x, dense y."""
-    yv = _arr(y)
+    yv = _mutable(y)
     if isinstance(x, SparseVector):
         np.add.at(yv, x.indices, a * x.vals)
         return
@@ -63,7 +77,7 @@ def scal(a: float, x) -> None:
     if isinstance(x, SparseVector):
         x.vals *= a
         return
-    xv = _arr(x)
+    xv = _mutable(x)
     xv *= a
 
 
@@ -75,7 +89,7 @@ def gemm(alpha: float, mat_a, trans_a: bool, mat_b, trans_b: bool, beta: float, 
     """
     a = _arr(mat_a).T if trans_a else _arr(mat_a)
     b = _arr(mat_b).T if trans_b else _arr(mat_b)
-    c = _arr(mat_c)
+    c = _mutable(mat_c)
     if a.shape[1] != b.shape[0] or c.shape != (a.shape[0], b.shape[1]):
         raise ValueError(
             f"gemm size mismatch: op(A){a.shape} @ op(B){b.shape} -> C{c.shape}"
@@ -90,7 +104,7 @@ def gemv(alpha: float, mat_a, trans_a: bool, x, beta: float, y) -> None:
     sparse gemv (BLAS.java:205-233).
     """
     a = _arr(mat_a).T if trans_a else _arr(mat_a)
-    yv = _arr(y)
+    yv = _mutable(y)
     if isinstance(x, SparseVector):
         prod = a[:, x.indices] @ x.vals
     else:

@@ -91,6 +91,12 @@ class Vector:
         return vector_to_string(self)
 
 
+def _check_sizes(a: "Vector", b: "Vector") -> None:
+    """Raise on declared-size mismatch; unknown size (-1) matches anything."""
+    if a.size() >= 0 and b.size() >= 0 and a.size() != b.size():
+        raise ValueError("vector size mismatch")
+
+
 class DenseVector(Vector):
     """Dense vector over a float64 numpy buffer (DenseVector.java).
 
@@ -167,16 +173,18 @@ class DenseVector(Vector):
         return DenseVector(np.concatenate([self.values, [value]]))
 
     def plus(self, other: Vector) -> Vector:
-        if self.size() != other.size():
-            raise ValueError("vector size mismatch")
+        _check_sizes(self, other)
         if isinstance(other, DenseVector):
             return DenseVector(self.values + other.values)
         return other.plus(self)
 
     def minus(self, other: Vector) -> Vector:
-        if self.size() != other.size():
-            raise ValueError("vector size mismatch")
-        return DenseVector(self.values - other.to_dense().values)
+        _check_sizes(self, other)
+        if isinstance(other, DenseVector):
+            return DenseVector(self.values - other.values)
+        out = self.values.copy()
+        np.subtract.at(out, other.indices, other.vals)
+        return DenseVector(out)
 
     # in-place variants (DenseVector.java:279-303)
     def plus_equal(self, other: Vector) -> None:
@@ -201,8 +209,7 @@ class DenseVector(Vector):
             np.add.at(self.values, sv.indices, factor * sv.vals)
 
     def dot(self, other: Vector) -> float:
-        if self.size() != other.size():
-            raise ValueError("vector size mismatch")
+        _check_sizes(self, other)
         if isinstance(other, DenseVector):
             return float(self.values @ other.values)
         return other.dot(self)
@@ -341,18 +348,21 @@ class SparseVector(Vector):
         )
 
     def plus(self, other: Vector) -> Vector:
-        if self.n >= 0 and other.size() >= 0 and self.n != other.size():
-            raise ValueError("vector size mismatch")
+        _check_sizes(self, other)
         if isinstance(other, DenseVector):
             out = other.values.copy()
             np.add.at(out, self.indices, self.vals)
             return DenseVector(out)
-        merged = self.clone()
-        for i, v in zip(other.indices, other.vals):
-            merged.add(int(i), float(v))
-        return merged
+        # duplicate-merging constructor does the sort-and-sum in O(k log k)
+        size = self.n if self.n >= 0 else other.size()
+        return SparseVector(
+            size,
+            np.concatenate([self.indices, other.indices]),
+            np.concatenate([self.vals, other.vals]),
+        )
 
     def minus(self, other: Vector) -> Vector:
+        _check_sizes(self, other)
         if isinstance(other, DenseVector):
             out = -other.values
             np.add.at(out, self.indices, self.vals)
@@ -360,8 +370,7 @@ class SparseVector(Vector):
         return self.plus(other.scale(-1.0))
 
     def dot(self, other: Vector) -> float:
-        if self.n >= 0 and other.size() >= 0 and self.n != other.size():
-            raise ValueError("vector size mismatch")
+        _check_sizes(self, other)
         if isinstance(other, DenseVector):
             return float(self.vals @ other.values[self.indices])
         common, ia, ib = np.intersect1d(self.indices, other.indices, return_indices=True)
@@ -369,7 +378,6 @@ class SparseVector(Vector):
 
     def slice(self, indices) -> "SparseVector":
         indices = np.asarray(indices, dtype=np.int64)
-        out = SparseVector(int(indices.size))
         new_idx, new_val = [], []
         for new_i, old_i in enumerate(indices):
             pos = np.searchsorted(self.indices, old_i)

@@ -170,10 +170,11 @@ class Params:
         """Set a value, checking declared type then the validator hook (Params.java:138-145)."""
         if info.value_type is not None and value is not None:
             vt = info.value_type
+            is_bool = isinstance(value, bool)
             ok = (
-                isinstance(value, vt)
+                (isinstance(value, vt) and not (is_bool and vt is not bool))
                 # ints are acceptable where floats are declared (but bools are not)
-                or (vt is float and isinstance(value, int) and not isinstance(value, bool))
+                or (vt is float and isinstance(value, int) and not is_bool)
                 # tuples are acceptable where lists are declared (JSON makes them lists)
                 or (vt is list and isinstance(value, tuple))
             )

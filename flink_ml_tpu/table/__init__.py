@@ -1,0 +1,23 @@
+"""table — the columnar data plane.
+
+Replaces the reference's Flink ``Table`` substrate plus its schema/conversion
+utilities (``TableUtil.java``, ``OutputColsHelper.java``,
+``DataStreamConversionUtil.java``) with a host-side columnar table designed to
+feed TPU batches: columns are numpy arrays, vector columns pack to dense
+``(batch, dim)`` arrays or ``CsrBatch`` without per-row hops, and unbounded
+sources present the windowed mini-batch protocol the streaming driver consumes
+(IncrementalLearningSkeleton.java:61-83 shape).
+"""
+
+from flink_ml_tpu.table.schema import DataTypes, Schema  # noqa: F401
+from flink_ml_tpu.table.table import Table  # noqa: F401
+from flink_ml_tpu.table.output_cols import OutputColsHelper  # noqa: F401
+from flink_ml_tpu.table import table_util  # noqa: F401
+from flink_ml_tpu.table.sources import (  # noqa: F401
+    BoundedSource,
+    CollectionSource,
+    CsvSource,
+    LibSvmSource,
+    UnboundedSource,
+    GeneratorSource,
+)

@@ -1,0 +1,81 @@
+"""OutputColsHelper — merge operator output into the input table.
+
+Rule-for-rule parity with OutputColsHelper.java:32-52:
+  * reserved cols default to *all* input cols;
+  * reserved cols come ahead of the operator's output cols in the result;
+  * an output col whose name collides with an input col overrides it *in place*
+    (takes the input col's position, with the output type/values);
+  * reserved cols keep their input order.
+
+The reference applies these per-row (getResultRow:179); here the merge is one
+columnar operation over whole batches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+
+
+class OutputColsHelper:
+    def __init__(
+        self,
+        input_schema: Schema,
+        output_col_names: Sequence[str],
+        output_col_types: Sequence[str],
+        reserved_col_names: Optional[Sequence[str]] = None,
+    ):
+        if isinstance(output_col_names, str):
+            raise TypeError("output_col_names must be a sequence of names")
+        if len(output_col_names) != len(output_col_types):
+            raise ValueError("output names/types must align")
+        self.input_schema = input_schema
+        self.output_col_names = list(output_col_names)
+        self.output_col_types = list(output_col_types)
+
+        in_names = input_schema.field_names
+        in_types = input_schema.field_types
+        reserved = set(in_names if reserved_col_names is None else reserved_col_names)
+
+        # walk input order assigning result slots (OutputColsHelper.java:118-135)
+        result_names: List[str] = []
+        result_types: List[str] = []
+        self._reserved_input_cols: List[str] = []
+        out_pos = {}
+        for i, name in enumerate(in_names):
+            if name in self.output_col_names:
+                out_pos[name] = len(result_names)
+                j = self.output_col_names.index(name)
+                result_names.append(name)
+                result_types.append(self.output_col_types[j])
+                continue
+            if name in reserved:
+                self._reserved_input_cols.append(name)
+                result_names.append(name)
+                result_types.append(in_types[i])
+        for j, name in enumerate(self.output_col_names):
+            if name not in out_pos:
+                result_names.append(name)
+                result_types.append(self.output_col_types[j])
+        self._result_schema = Schema(result_names, result_types)
+
+    def get_reserved_cols(self) -> List[str]:
+        return list(self._reserved_input_cols)
+
+    def get_result_schema(self) -> Schema:
+        return self._result_schema
+
+    def get_result_table(self, input_table: Table, output_cols) -> Table:
+        """Columnar analog of getResultRow: merge whole output columns in."""
+        missing = [n for n in self.output_col_names if n not in output_cols]
+        if missing:
+            raise ValueError(f"operator did not produce output cols {missing}")
+        data = {}
+        for name in self._result_schema.field_names:
+            if name in self.output_col_names:
+                data[name] = output_cols[name]
+            else:
+                data[name] = input_table.col(name)
+        return Table.from_columns(self._result_schema, data)

@@ -1,0 +1,137 @@
+"""Schema and data types.
+
+The type vocabulary mirrors what the reference's TableUtil recognizes
+(TableUtil.java:147-182: supported numeric types, string, vector) and the
+Flink TypeInformation constants in VectorTypes.java:28-42.  Column lookup is
+case-insensitive, exactly like TableUtil.findColIndex (TableUtil.java:54-69).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class DataTypes:
+    DOUBLE = "DOUBLE"
+    FLOAT = "FLOAT"
+    INT = "INT"
+    LONG = "LONG"
+    BOOLEAN = "BOOLEAN"
+    STRING = "STRING"
+    VECTOR = "VECTOR"
+    DENSE_VECTOR = "DENSE_VECTOR"
+    SPARSE_VECTOR = "SPARSE_VECTOR"
+
+    _NUMERIC = {DOUBLE, FLOAT, INT, LONG}
+    _VECTOR = {VECTOR, DENSE_VECTOR, SPARSE_VECTOR}
+
+    @classmethod
+    def is_numeric(cls, t: str) -> bool:
+        """TableUtil.isSupportedNumericType analog (TableUtil.java:147-158)."""
+        return t in cls._NUMERIC
+
+    @classmethod
+    def is_string(cls, t: str) -> bool:
+        return t == cls.STRING
+
+    @classmethod
+    def is_vector(cls, t: str) -> bool:
+        return t in cls._VECTOR
+
+    @staticmethod
+    def numpy_dtype(t: str):
+        return {
+            DataTypes.DOUBLE: np.float64,
+            DataTypes.FLOAT: np.float32,
+            DataTypes.INT: np.int32,
+            DataTypes.LONG: np.int64,
+            DataTypes.BOOLEAN: np.bool_,
+        }.get(t, object)
+
+
+class Schema:
+    """Ordered (name, type) fields with case-insensitive name lookup."""
+
+    __slots__ = ("_names", "_types", "_lower_index")
+
+    def __init__(self, names: Sequence[str], types: Sequence[str]):
+        if len(names) != len(types):
+            raise ValueError("names and types must align")
+        self._names = list(names)
+        self._types = list(types)
+        self._lower_index: Dict[str, int] = {}
+        for i, n in enumerate(self._names):
+            low = n.lower()
+            # first occurrence wins on case-insensitive duplicates, matching the
+            # linear scan in TableUtil.findColIndex
+            self._lower_index.setdefault(low, i)
+
+    @staticmethod
+    def of(*fields: Tuple[str, str]) -> "Schema":
+        return Schema([f[0] for f in fields], [f[1] for f in fields])
+
+    @property
+    def field_names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def field_types(self) -> List[str]:
+        return list(self._types)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def find_col_index(self, name: str) -> int:
+        """Case-insensitive index, -1 when absent (TableUtil.java:54-69)."""
+        if name is None:
+            raise ValueError("target col is None")
+        return self._lower_index.get(name.lower(), -1)
+
+    def contains(self, name: str) -> bool:
+        return self.find_col_index(name) >= 0
+
+    def field_name(self, i: int) -> str:
+        return self._names[i]
+
+    def field_type(self, i: int) -> str:
+        return self._types[i]
+
+    def type_of(self, name: str) -> str:
+        i = self.find_col_index(name)
+        if i < 0:
+            raise ValueError(f"column {name!r} not found in schema {self._names}")
+        return self._types[i]
+
+    def resolve(self, name: str) -> str:
+        """Canonical column name (schema spelling) for a case-insensitive match."""
+        i = self.find_col_index(name)
+        if i < 0:
+            raise ValueError(f"column {name!r} not found in schema {self._names}")
+        return self._names[i]
+
+    def select(self, names: Sequence[str]) -> "Schema":
+        idx = [self.find_col_index(n) for n in names]
+        missing = [n for n, i in zip(names, idx) if i < 0]
+        if missing:
+            raise ValueError(f"columns {missing} not found in schema {self._names}")
+        return Schema([self._names[i] for i in idx], [self._types[i] for i in idx])
+
+    def to_dict(self) -> Dict[str, List[str]]:
+        return {"names": list(self._names), "types": list(self._types)}
+
+    @staticmethod
+    def from_dict(d: Dict) -> "Schema":
+        return Schema(d["names"], d["types"])
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Schema)
+            and self._names == other._names
+            and self._types == other._types
+        )
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{t}" for n, t in zip(self._names, self._types))
+        return f"Schema({cols})"

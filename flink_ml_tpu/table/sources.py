@@ -1,0 +1,204 @@
+"""Table sources — bounded and unbounded.
+
+Bounded sources materialize a full columnar Table (the analog of a Flink batch
+source feeding `env.readCsvFile`, LinearRegression.java:91-102).  Unbounded
+sources yield ``(event_time, row)`` pairs for the streaming driver, which
+assigns windows the way IncrementalLearningSkeleton assigns event-time
+tumbling windows (IncrementalLearningSkeleton.java:67-68).
+
+CSV and LibSVM parsing route through the native C++ loader when it is built
+(``flink_ml_tpu.native``), with a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.ops.codec import parse_vector
+from flink_ml_tpu.ops.vector import SparseVector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+
+class BoundedSource:
+    """A source whose ``read()`` returns the complete Table."""
+
+    def read(self) -> Table:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def schema(self) -> Schema:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class CollectionSource(BoundedSource):
+    def __init__(self, rows: Sequence[Sequence], schema: Schema):
+        self._schema = schema
+        self._table = Table.from_rows(rows, schema)
+
+    def read(self) -> Table:
+        return self._table
+
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class CsvSource(BoundedSource):
+    def __init__(
+        self,
+        path: str,
+        schema: Schema,
+        delimiter: str = ",",
+        skip_header: bool = False,
+    ):
+        self.path = path
+        self._schema = schema
+        self.delimiter = delimiter
+        self.skip_header = skip_header
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def read(self) -> Table:
+        names = self._schema.field_names
+        types = self._schema.field_types
+        cells = _read_csv_cells(self.path, self.delimiter, self.skip_header, len(names))
+        cols = {n: [] for n in names}
+        for raw in cells:
+            for name, typ, cell in zip(names, types, raw):
+                cols[name].append(_parse_cell(cell, typ))
+        return Table.from_columns(self._schema, cols)
+
+
+class LibSvmSource(BoundedSource):
+    """LibSVM/SVMlight text: ``label idx:val idx:val ...`` with 1-based or
+    0-based indices; produces (label DOUBLE, features SPARSE_VECTOR)."""
+
+    def __init__(self, path: str, n_features: Optional[int] = None, zero_based: bool = False):
+        self.path = path
+        self.n_features = n_features
+        self.zero_based = zero_based
+        self._schema = Schema(["label", "features"], [DataTypes.DOUBLE, DataTypes.SPARSE_VECTOR])
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def read(self) -> Table:
+        native = _native_lib()
+        if native is not None:
+            labels, vecs = native.read_libsvm(self.path, self.n_features, self.zero_based)
+            return Table.from_columns(self._schema, {"label": labels, "features": vecs})
+        labels: List[float] = []
+        vecs: List[SparseVector] = []
+        max_idx = -1
+        offset = 0 if self.zero_based else 1
+        with open(self.path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0]))
+                idx = np.array([int(p.split(":", 1)[0]) - offset for p in parts[1:]], dtype=np.int64)
+                val = np.array([float(p.split(":", 1)[1]) for p in parts[1:]])
+                if idx.size:
+                    max_idx = max(max_idx, int(idx.max()))
+                vecs.append((idx, val))
+        dim = self.n_features if self.n_features is not None else max_idx + 1
+        sparse = [SparseVector(dim, i, v) for i, v in vecs]
+        return Table.from_columns(self._schema, {"label": labels, "features": sparse})
+
+
+class UnboundedSource:
+    """A source of timestamped records, consumed by the streaming driver.
+
+    ``stream()`` yields ``(event_time_ms, row_tuple)`` in event-time order per
+    producer (the driver handles windowing + watermarks).
+    """
+
+    def stream(self) -> Iterator[Tuple[int, Tuple]]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def schema(self) -> Schema:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class GeneratorSource(UnboundedSource):
+    """Wraps a generator function into an unbounded source.
+
+    ``gen`` is called with no args and must yield ``(event_time_ms, row)``.
+    A ``linear_timestamps`` helper covers the reference's LinearTimestamp
+    assigner (IncrementalLearningSkeleton.java:144-158): record i gets time
+    ``i * interval_ms``.
+    """
+
+    def __init__(self, gen: Callable[[], Iterator[Tuple[int, Tuple]]], schema: Schema):
+        self._gen = gen
+        self._schema = schema
+
+    def stream(self) -> Iterator[Tuple[int, Tuple]]:
+        return self._gen()
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    @staticmethod
+    def linear_timestamps(rows: Sequence[Tuple], interval_ms: int, schema: Schema) -> "GeneratorSource":
+        def gen():
+            for i, row in enumerate(rows):
+                yield i * interval_ms, tuple(row)
+
+        return GeneratorSource(gen, schema)
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _native_lib():
+    try:
+        from flink_ml_tpu import native
+
+        return native if native.available() else None
+    except Exception:
+        return None
+
+
+def _read_csv_cells(path: str, delimiter: str, skip_header: bool, arity: int):
+    native = _native_lib()
+    if native is not None:
+        return native.read_csv(path, delimiter, skip_header, arity)
+    out = []
+    with open(path, newline="") as f:
+        reader = csv.reader(f, delimiter=delimiter)
+        for i, row in enumerate(reader):
+            if skip_header and i == 0:
+                continue
+            if not row:
+                continue
+            if len(row) != arity:
+                raise ValueError(
+                    f"{path}: row {i} has {len(row)} fields, schema expects {arity}"
+                )
+            out.append(row)
+    return out
+
+
+def _parse_cell(cell: str, typ: str):
+    cell = cell.strip()
+    if typ == DataTypes.STRING:
+        return cell
+    if cell == "" or cell.lower() == "null":
+        return None if typ == DataTypes.STRING else _null_numeric(typ)
+    if DataTypes.is_vector(typ):
+        return parse_vector(cell)
+    if typ == DataTypes.BOOLEAN:
+        return cell.lower() in ("true", "1")
+    if typ in (DataTypes.INT, DataTypes.LONG):
+        return int(cell)
+    return float(cell)
+
+
+def _null_numeric(typ: str):
+    return np.nan if typ in (DataTypes.DOUBLE, DataTypes.FLOAT) else 0

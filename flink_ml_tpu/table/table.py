@@ -1,0 +1,180 @@
+"""Columnar Table — the value that flows between pipeline stages.
+
+The reference moves data between stages as Flink ``Table`` objects and crosses
+to per-record DataStreams for compute (DataStreamConversionUtil.java:47-130).
+Here the table *is already columnar*: each column is a numpy array, so the
+device hop is a single ``jnp.asarray`` / ``CsrBatch.from_vectors`` per batch —
+no row-at-a-time boundary anywhere (the TPU-first replacement for the
+row-mapper hot loop, SURVEY.md §3.2).
+
+Tables are immutable values: every transformation returns a new Table sharing
+column buffers where possible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.ops.batch import CsrBatch, dense_batch
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+
+
+class Table:
+    __slots__ = ("_schema", "_cols", "_num_rows")
+
+    def __init__(self, schema: Schema, cols: Dict[str, np.ndarray]):
+        self._schema = schema
+        self._cols = cols
+        lengths = {len(c) for c in cols.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: lengths {lengths}")
+        self._num_rows = lengths.pop() if lengths else 0
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_columns(schema: Schema, cols: Dict[str, Sequence]) -> "Table":
+        data = {}
+        for name, typ in zip(schema.field_names, schema.field_types):
+            if name not in cols:
+                raise ValueError(f"missing column {name!r}")
+            data[name] = _as_column(cols[name], typ)
+        return Table(schema, data)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Sequence], schema: Schema) -> "Table":
+        cols: Dict[str, List] = {n: [] for n in schema.field_names}
+        for row in rows:
+            if len(row) != len(schema):
+                raise ValueError(f"row arity {len(row)} != schema arity {len(schema)}")
+            for name, value in zip(schema.field_names, row):
+                cols[name].append(value)
+        return Table.from_columns(schema, cols)
+
+    # -- basic accessors ----------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def col(self, name: str) -> np.ndarray:
+        """Column buffer by (case-insensitive) name."""
+        return self._cols[self._schema.resolve(name)]
+
+    def to_rows(self) -> List[Tuple]:
+        names = self._schema.field_names
+        columns = [self._cols[n] for n in names]
+        return [tuple(c[i] for c in columns) for i in range(self._num_rows)]
+
+    # -- relational ops ------------------------------------------------------
+
+    def select(self, names: Sequence[str]) -> "Table":
+        sub = self._schema.select(names)
+        return Table(sub, {n: self._cols[n] for n in sub.field_names})
+
+    def with_column(self, name: str, typ: str, values) -> "Table":
+        """Append (or replace) a column, returning a new Table."""
+        values = _as_column(values, typ)
+        if self._cols and len(values) != self._num_rows:
+            raise ValueError("column length mismatch")
+        names, types = self._schema.field_names, self._schema.field_types
+        cols = dict(self._cols)
+        idx = self._schema.find_col_index(name)
+        if idx >= 0:
+            canonical = names[idx]
+            types[idx] = typ
+            cols[canonical] = values
+        else:
+            names.append(name)
+            types.append(typ)
+            cols[name] = values
+        return Table(Schema(names, types), cols)
+
+    def slice_rows(self, start: int, stop: int) -> "Table":
+        return Table(
+            self._schema, {n: c[start:stop] for n, c in self._cols.items()}
+        )
+
+    def take_rows(self, indices) -> "Table":
+        idx = np.asarray(indices, dtype=np.int64)
+        return Table(self._schema, {n: c[idx] for n, c in self._cols.items()})
+
+    def filter_rows(self, mask) -> "Table":
+        mask = np.asarray(mask, dtype=bool)
+        return Table(self._schema, {n: c[mask] for n, c in self._cols.items()})
+
+    @staticmethod
+    def concat(tables: Sequence["Table"]) -> "Table":
+        if not tables:
+            raise ValueError("concat of zero tables")
+        schema = tables[0].schema
+        for t in tables[1:]:
+            if t.schema != schema:
+                raise ValueError("schema mismatch in concat")
+        cols = {
+            n: np.concatenate([t._cols[n] for t in tables]) for n in schema.field_names
+        }
+        return Table(schema, cols)
+
+    def iter_batches(self, batch_size: int) -> Iterator["Table"]:
+        for start in range(0, self._num_rows, batch_size):
+            yield self.slice_rows(start, min(start + batch_size, self._num_rows))
+
+    # -- device bridging -----------------------------------------------------
+
+    def features_dense(self, col: str, dim: Optional[int] = None) -> np.ndarray:
+        """A vector column as a ``(rows, dim)`` float array, ready for jnp.asarray."""
+        typ = self._schema.type_of(col)
+        values = self.col(col)
+        if DataTypes.is_vector(typ):
+            return dense_batch(list(values), dim)
+        return np.asarray(values, dtype=np.float64).reshape(self._num_rows, 1)
+
+    def features_csr(self, col: str, n_cols: int, pad_multiple: int = 1024) -> CsrBatch:
+        """A (sparse-)vector column as a CsrBatch for the device sparse path."""
+        vectors = []
+        for v in self.col(col):
+            if isinstance(v, SparseVector):
+                vectors.append(v)
+            elif isinstance(v, Vector):
+                dv = v.to_dense()
+                nz = np.nonzero(dv.values)[0]
+                vectors.append(SparseVector(dv.size(), nz, dv.values[nz]))
+            else:
+                raise TypeError(f"column {col!r} does not hold vectors")
+        return CsrBatch.from_vectors(vectors, n_cols=n_cols, pad_multiple=pad_multiple)
+
+    def numeric_matrix(self, cols: Sequence[str]) -> np.ndarray:
+        """Numeric columns stacked into a ``(rows, len(cols))`` float array."""
+        arrays = []
+        for c in cols:
+            if not DataTypes.is_numeric(self._schema.type_of(c)):
+                raise ValueError(f"column {c!r} is not numeric")
+            arrays.append(np.asarray(self.col(c), dtype=np.float64))
+        return np.stack(arrays, axis=1) if arrays else np.zeros((self._num_rows, 0))
+
+    def __repr__(self) -> str:
+        return f"Table({self._schema!r}, rows={self._num_rows})"
+
+
+def _as_column(values, typ: str) -> np.ndarray:
+    dtype = DataTypes.numpy_dtype(typ)
+    if dtype is object:
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        if DataTypes.is_vector(typ):
+            for v in arr:
+                if v is not None and not isinstance(v, Vector):
+                    raise TypeError(f"vector column holds non-vector {type(v).__name__}")
+        return arr
+    return np.asarray(values, dtype=dtype)

@@ -1,0 +1,141 @@
+"""Table schema toolbox — TableUtil.java parity.
+
+Temp names (getTempTableName:42-44), column index/type lookup with
+case-insensitive matching (:54-69), type predicates (:147-182), assertion
+helpers (:184-259), typed column selection (:261-371), and the markdown
+pretty-printer (format*:372-424).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Sequence
+
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+
+def get_temp_table_name() -> str:
+    return ("temp_" + uuid.uuid4().hex).lower()
+
+
+def find_col_index(schema_or_cols, target_col: str) -> int:
+    if isinstance(schema_or_cols, Schema):
+        return schema_or_cols.find_col_index(target_col)
+    if target_col is None:
+        raise ValueError("targetCol is null!")
+    for i, c in enumerate(schema_or_cols):
+        if c.lower() == target_col.lower():
+            return i
+    return -1
+
+
+def find_col_indices(schema_or_cols, target_cols: Sequence[str]) -> List[int]:
+    return [find_col_index(schema_or_cols, c) for c in target_cols]
+
+
+def find_col_type(schema: Schema, target_col: str):
+    i = schema.find_col_index(target_col)
+    return None if i < 0 else schema.field_type(i)
+
+
+def is_supported_numeric_type(t: str) -> bool:
+    return DataTypes.is_numeric(t)
+
+
+def is_string(t: str) -> bool:
+    return DataTypes.is_string(t)
+
+
+def is_vector(t: str) -> bool:
+    return DataTypes.is_vector(t)
+
+
+def assert_selected_col_exist(schema_or_cols, *selected_cols: str) -> None:
+    """TableUtil.assertSelectedColExist (:184-205)."""
+    for c in selected_cols:
+        if c is not None and find_col_index(schema_or_cols, c) < 0:
+            raise ValueError(f" col is not exist {c}")
+
+
+def assert_numerical_cols(schema: Schema, *cols: str) -> None:
+    for c in cols:
+        if c is None:
+            continue
+        t = find_col_type(schema, c)
+        if t is None or not DataTypes.is_numeric(t):
+            raise ValueError(f"col type must be number {c}")
+
+
+def assert_string_cols(schema: Schema, *cols: str) -> None:
+    for c in cols:
+        if c is None:
+            continue
+        t = find_col_type(schema, c)
+        if t is None or not DataTypes.is_string(t):
+            raise ValueError(f"col type must be string {c}")
+
+
+def assert_vector_cols(schema: Schema, *cols: str) -> None:
+    for c in cols:
+        if c is None:
+            continue
+        t = find_col_type(schema, c)
+        if t is None or not DataTypes.is_vector(t):
+            raise ValueError(f"col type must be vector {c}")
+
+
+def get_numeric_cols(schema: Schema, exclude_cols: Sequence[str] = ()) -> List[str]:
+    """Names of numeric columns minus exclusions (TableUtil.java:261-295)."""
+    excl = {c.lower() for c in exclude_cols}
+    return [
+        n
+        for n, t in zip(schema.field_names, schema.field_types)
+        if DataTypes.is_numeric(t) and n.lower() not in excl
+    ]
+
+
+def get_string_cols(schema: Schema, exclude_cols: Sequence[str] = ()) -> List[str]:
+    excl = {c.lower() for c in exclude_cols}
+    return [
+        n
+        for n, t in zip(schema.field_names, schema.field_types)
+        if DataTypes.is_string(t) and n.lower() not in excl
+    ]
+
+
+def get_categorical_cols(
+    schema: Schema, feature_cols: Sequence[str], categorical_cols: Sequence[str] = None
+) -> List[str]:
+    """String-typed feature cols plus user-declared categorical cols
+    (TableUtil.getCategoricalCols semantics: declared ones must be features)."""
+    feats = list(feature_cols)
+    declared = list(categorical_cols or [])
+    for c in declared:
+        if find_col_index(feats, c) < 0:
+            raise ValueError(f"categoricalCols must be included in featureCols: {c}")
+    out = []
+    for c in feats:
+        t = find_col_type(schema, c)
+        if (t is not None and DataTypes.is_string(t)) or find_col_index(declared, c) >= 0:
+            out.append(c)
+    return out
+
+
+def format_title(col_names: Sequence[str]) -> str:
+    """Markdown header row (TableUtil.formatTitle:372-395)."""
+    return (
+        "|" + "|".join(col_names) + "|\n" + "|" + "|".join("---" for _ in col_names) + "|"
+    )
+
+
+def format_rows(rows: Sequence[Sequence]) -> str:
+    return "\n".join(
+        "|" + "|".join("null" if v is None else str(v) for v in row) + "|" for row in rows
+    )
+
+
+def format(table: Table, max_rows: int = 20) -> str:
+    """Markdown rendering of a table prefix (TableUtil.format:414-424)."""
+    rows = table.slice_rows(0, max_rows).to_rows()
+    return format_title(table.schema.field_names) + "\n" + format_rows(rows)

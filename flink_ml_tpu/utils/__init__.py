@@ -1,0 +1,8 @@
+"""utils — environment registry, persistence, metrics."""
+
+from flink_ml_tpu.utils.persistence import load_table, save_table  # noqa: F401
+from flink_ml_tpu.utils.environment import (  # noqa: F401
+    MLEnvironment,
+    MLEnvironmentFactory,
+)
+from flink_ml_tpu.utils.metrics import StepMetrics  # noqa: F401

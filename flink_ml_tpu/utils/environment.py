@@ -1,0 +1,82 @@
+"""MLEnvironment — execution context registry.
+
+Parity with MLEnvironment.java:38-89 and MLEnvironmentFactory.java:39-115: a
+process-wide id -> environment registry with a default id 0, monotonically
+assigned ids, synchronized access, and an un-removable default.  On TPU the
+environment owns the device mesh and default batch size instead of Flink
+stream/table environments.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class MLEnvironment:
+    """Holds lazily-created execution context: the device mesh + exec knobs."""
+
+    def __init__(self, mesh=None, default_batch_size: int = 8192):
+        self._mesh = mesh
+        self.default_batch_size = default_batch_size
+
+    def get_mesh(self):
+        """The jax.sharding.Mesh for this environment (lazily built)."""
+        if self._mesh is None:
+            from flink_ml_tpu.parallel.mesh import default_mesh
+
+            self._mesh = default_mesh()
+        return self._mesh
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh = mesh
+
+
+class MLEnvironmentFactory:
+    """Static registry (MLEnvironmentFactory.java semantics)."""
+
+    DEFAULT_ML_ENVIRONMENT_ID = 0
+
+    _lock = threading.RLock()
+    _next_id = 1
+    _map: Dict[int, MLEnvironment] = {}
+
+    @classmethod
+    def get(cls, env_id: int) -> MLEnvironment:
+        with cls._lock:
+            if env_id not in cls._map:
+                if env_id == cls.DEFAULT_ML_ENVIRONMENT_ID:
+                    cls._map[env_id] = MLEnvironment()
+                else:
+                    raise ValueError(
+                        f"Cannot find MLEnvironment of MLEnvironmentId {env_id}. "
+                        "Did you get the MLEnvironmentId by registering a MLEnvironment?"
+                    )
+            return cls._map[env_id]
+
+    @classmethod
+    def get_default(cls) -> MLEnvironment:
+        return cls.get(cls.DEFAULT_ML_ENVIRONMENT_ID)
+
+    @classmethod
+    def get_new_ml_environment_id(cls) -> int:
+        """Register a fresh environment and return its id (monotonic)."""
+        return cls.register_ml_environment(MLEnvironment())
+
+    @classmethod
+    def register_ml_environment(cls, env: MLEnvironment) -> int:
+        with cls._lock:
+            env_id = cls._next_id
+            cls._next_id += 1
+            cls._map[env_id] = env
+            return env_id
+
+    @classmethod
+    def remove(cls, env_id: int) -> Optional[MLEnvironment]:
+        with cls._lock:
+            if env_id is None:
+                raise ValueError("The environment id cannot be null.")
+            # the default env must not be removed (MLEnvironmentFactory.java:109-112)
+            if env_id == cls.DEFAULT_ML_ENVIRONMENT_ID:
+                return cls._map.get(env_id)
+            return cls._map.pop(env_id, None)

@@ -1,0 +1,60 @@
+"""Structured per-step metrics (SURVEY.md §5.5 build decision).
+
+The reference has no in-library metrics at all; Flink's web UI was the only
+observability hook.  The north-star metric here is samples/sec/chip, so step
+timing is first-class from v0: every training driver can record per-step
+wall time, loss, and throughput, and expose a summary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+
+class StepMetrics:
+    def __init__(self, name: str = "train"):
+        self.name = name
+        self.steps: List[Dict] = []
+        self._t0: Optional[float] = None
+
+    def start_step(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_step(self, samples: int = 0, **extra) -> Dict:
+        dt = time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        rec = {
+            "step": len(self.steps),
+            "seconds": dt,
+            "samples": samples,
+            "samples_per_sec": samples / dt if dt > 0 else 0.0,
+        }
+        rec.update({k: _scalar(v) for k, v in extra.items()})
+        self.steps.append(rec)
+        self._t0 = None
+        return rec
+
+    def summary(self, skip_warmup: int = 1) -> Dict:
+        """Aggregate throughput, skipping compile-dominated warmup steps."""
+        steady = self.steps[skip_warmup:] if len(self.steps) > skip_warmup else self.steps
+        total_samples = sum(s["samples"] for s in steady)
+        total_time = sum(s["seconds"] for s in steady)
+        return {
+            "name": self.name,
+            "num_steps": len(self.steps),
+            "steady_steps": len(steady),
+            "total_samples": total_samples,
+            "total_seconds": total_time,
+            "samples_per_sec": total_samples / total_time if total_time > 0 else 0.0,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps({"summary": self.summary(), "steps": self.steps})
+
+
+def _scalar(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)

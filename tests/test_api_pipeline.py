@@ -1,0 +1,209 @@
+"""Pipeline API tests — parity with PipelineTest.java:38-51 (mock stages, no
+device, fit/transform chaining order) plus working save/load coverage the
+reference never implemented."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api import (
+    AlgoOperator,
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Stage,
+    load_stage,
+)
+from flink_ml_tpu.params import param_info
+from flink_ml_tpu.table import DataTypes, Schema, Table
+from flink_ml_tpu.utils import MLEnvironmentFactory, load_table, save_table
+
+
+def _tag_table(tag: str) -> Table:
+    return Table.from_rows([(tag,)], Schema(["tag"], [DataTypes.STRING]))
+
+
+def _tag(table: Table) -> str:
+    return table.col("tag")[0]
+
+
+class MockTransformer(AlgoOperator):
+    """Appends its suffix to the tag — observable chaining order."""
+
+    SUFFIX = param_info("suffix", default="t")
+
+    def transform(self, *inputs):
+        (t,) = inputs
+        return (_tag_table(_tag(t) + "_" + self.get(self.SUFFIX)),)
+
+
+class MockModel(Model):
+    SUFFIX = param_info("suffix", default="m")
+
+    def transform(self, *inputs):
+        (t,) = inputs
+        return (_tag_table(_tag(t) + "_m" + self.get(self.SUFFIX)),)
+
+
+class MockEstimator(Estimator):
+    SUFFIX = param_info("suffix", default="e")
+
+    def fit(self, *inputs):
+        model = MockModel()
+        model.set(MockModel.SUFFIX, self.get(self.SUFFIX))
+        return model
+
+
+class TestPipelineChaining:
+    """The a_b_c_d -> a_mb_mc_d shape of PipelineTest.java:38-51."""
+
+    def test_fit_transform_order(self):
+        # stages: transformer(a) estimator(b) estimator(c) transformer(d)
+        stages = [
+            MockTransformer().set(MockTransformer.SUFFIX, "a"),
+            MockEstimator().set(MockEstimator.SUFFIX, "b"),
+            MockEstimator().set(MockEstimator.SUFFIX, "c"),
+            MockTransformer().set(MockTransformer.SUFFIX, "d"),
+        ]
+        pm = Pipeline(stages).fit(_tag_table("x"))
+        assert isinstance(pm, PipelineModel)
+        (out,) = pm.transform(_tag_table("x"))
+        # fit: transform chains through a, mb (to feed c); d not fit-applied
+        # transform: x -> a -> mb -> mc -> d
+        assert _tag(out) == "x_a_mb_mc_d"
+
+    def test_trailing_estimator_not_applied_during_fit(self):
+        calls = []
+
+        class SpyModel(MockModel):
+            def transform(self, *inputs):
+                calls.append("transform")
+                return super().transform(*inputs)
+
+        class SpyEstimator(MockEstimator):
+            def fit(self, *inputs):
+                m = SpyModel()
+                m.set(MockModel.SUFFIX, self.get(self.SUFFIX))
+                return m
+
+        Pipeline([SpyEstimator()]).fit(_tag_table("x"))
+        # single (last) estimator: its model must NOT be applied during fit
+        assert calls == []
+
+    def test_pipeline_of_only_transformers(self):
+        pm = Pipeline(
+            [MockTransformer().set(MockTransformer.SUFFIX, s) for s in "ab"]
+        ).fit(_tag_table("x"))
+        (out,) = pm.transform(_tag_table("x"))
+        assert _tag(out) == "x_a_b"
+
+    def test_non_stage_rejected(self):
+        with pytest.raises(TypeError, match="neither"):
+            Pipeline([object()]).fit(_tag_table("x"))
+
+    def test_append_stage(self):
+        p = Pipeline().append_stage(MockTransformer())
+        assert len(p.stages) == 1
+
+
+class TestSaveLoad:
+    def test_stage_save_load_round_trip(self, tmp_path):
+        t = MockTransformer().set(MockTransformer.SUFFIX, "z")
+        t.save(str(tmp_path / "s"))
+        restored = load_stage(str(tmp_path / "s"))
+        assert isinstance(restored, MockTransformer)
+        assert restored.get(MockTransformer.SUFFIX) == "z"
+
+    def test_pipeline_save_load(self, tmp_path):
+        p = Pipeline(
+            [
+                MockTransformer().set(MockTransformer.SUFFIX, "a"),
+                MockEstimator().set(MockEstimator.SUFFIX, "b"),
+            ]
+        )
+        p.save(str(tmp_path / "p"))
+        restored = Pipeline.load(str(tmp_path / "p"))
+        pm = restored.fit(_tag_table("x"))
+        (out,) = pm.transform(_tag_table("x"))
+        assert _tag(out) == "x_a_mb"
+
+    def test_pipeline_model_save_load(self, tmp_path):
+        pm = Pipeline(
+            [MockEstimator().set(MockEstimator.SUFFIX, "q")]
+        ).fit(_tag_table("x"))
+        pm.save(str(tmp_path / "pm"))
+        restored = PipelineModel.load(str(tmp_path / "pm"))
+        (out,) = restored.transform(_tag_table("y"))
+        assert _tag(out) == "y_mq"
+
+    def test_nested_pipeline(self, tmp_path):
+        inner = Pipeline([MockTransformer().set(MockTransformer.SUFFIX, "i")])
+        outer = Pipeline([inner, MockEstimator()])
+        outer.save(str(tmp_path / "o"))
+        restored = Pipeline.load(str(tmp_path / "o"))
+        pm = restored.fit(_tag_table("x"))
+        (out,) = pm.transform(_tag_table("x"))
+        assert _tag(out) == "x_i_me"
+
+    def test_kind_mismatch_raises(self, tmp_path):
+        Pipeline([MockTransformer()]).save(str(tmp_path / "p"))
+        with pytest.raises(ValueError, match="not a PipelineModel"):
+            PipelineModel.load(str(tmp_path / "p"))
+
+    def test_model_data_default_unsupported(self):
+        with pytest.raises(NotImplementedError):
+            MockModel().get_model_data()
+        with pytest.raises(NotImplementedError):
+            MockModel().set_model_data()
+
+
+class TestTablePersistence:
+    def test_round_trip_with_vectors(self, tmp_path):
+        from flink_ml_tpu.ops import DenseVector, SparseVector
+
+        s = Schema(
+            ["w", "name", "n"], [DataTypes.VECTOR, DataTypes.STRING, DataTypes.LONG]
+        )
+        t = Table.from_rows(
+            [
+                (DenseVector([1.5, -2.0]), "dense", 1),
+                (SparseVector(4, [1, 3], [2.0, 4.0]), "sparse", 2),
+            ],
+            s,
+        )
+        save_table(t, str(tmp_path / "m" / "data.jsonl"))
+        back = load_table(str(tmp_path / "m" / "data.jsonl"))
+        assert back.schema == s
+        assert back.col("w")[0] == DenseVector([1.5, -2.0])
+        assert back.col("w")[1].indices.tolist() == [1, 3]
+        assert back.col("name").tolist() == ["dense", "sparse"]
+        assert back.col("n").tolist() == [1, 2]
+
+    def test_nan_round_trip(self, tmp_path):
+        s = Schema(["x"], [DataTypes.DOUBLE])
+        t = Table.from_rows([(np.nan,), (1.0,)], s)
+        save_table(t, str(tmp_path / "t.jsonl"))
+        back = load_table(str(tmp_path / "t.jsonl"))
+        assert np.isnan(back.col("x")[0]) and back.col("x")[1] == 1.0
+
+
+class TestMLEnvironment:
+    def test_registry_semantics(self):
+        env_id = MLEnvironmentFactory.get_new_ml_environment_id()
+        env = MLEnvironmentFactory.get(env_id)
+        assert env is MLEnvironmentFactory.get(env_id)
+        assert MLEnvironmentFactory.remove(env_id) is env
+        with pytest.raises(ValueError, match="Cannot find"):
+            MLEnvironmentFactory.get(env_id)
+
+    def test_default_env_unremovable(self):
+        default = MLEnvironmentFactory.get_default()
+        assert MLEnvironmentFactory.remove(0) is default
+        assert MLEnvironmentFactory.get(0) is default
+
+    def test_monotonic_ids(self):
+        a = MLEnvironmentFactory.get_new_ml_environment_id()
+        b = MLEnvironmentFactory.get_new_ml_environment_id()
+        assert b > a
+        MLEnvironmentFactory.remove(a)
+        MLEnvironmentFactory.remove(b)

@@ -1,0 +1,216 @@
+"""Table layer tests — parity with TableUtilTest, OutputColsHelperTest (44-194),
+DataStreamConversionUtilTest failure modes, plus columnar/device-bridge coverage."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops import DenseVector, SparseVector
+from flink_ml_tpu.table import (
+    CollectionSource,
+    CsvSource,
+    DataTypes,
+    GeneratorSource,
+    LibSvmSource,
+    OutputColsHelper,
+    Schema,
+    Table,
+    table_util,
+)
+
+
+def _schema():
+    return Schema(["id", "f1", "f2"], [DataTypes.INT, DataTypes.FLOAT, DataTypes.DOUBLE])
+
+
+class TestSchema:
+    def test_case_insensitive_lookup(self):
+        s = _schema()
+        assert s.find_col_index("F1") == 1
+        assert s.find_col_index("nope") == -1
+        assert s.type_of("ID") == DataTypes.INT
+        assert s.resolve("iD") == "id"
+
+    def test_select_missing_raises(self):
+        with pytest.raises(ValueError, match="not found"):
+            _schema().select(["id", "zz"])
+
+    def test_round_trip_dict(self):
+        s = _schema()
+        assert Schema.from_dict(s.to_dict()) == s
+
+
+class TestTable:
+    def test_from_rows_and_back(self):
+        t = Table.from_rows([(1, 2.0, 3.0), (4, 5.0, 6.0)], _schema())
+        assert t.num_rows() == 2
+        assert t.to_rows()[1][0] == 4
+        assert t.col("F2").tolist() == [3.0, 6.0]
+
+    def test_row_arity_check(self):
+        with pytest.raises(ValueError, match="arity"):
+            Table.from_rows([(1, 2.0)], _schema())
+
+    def test_ragged_columns_raise(self):
+        with pytest.raises(ValueError, match="ragged"):
+            Table(_schema(), {"id": np.zeros(2), "f1": np.zeros(3), "f2": np.zeros(2)})
+
+    def test_select_with_column_slice(self):
+        t = Table.from_rows([(1, 2.0, 3.0), (4, 5.0, 6.0)], _schema())
+        sel = t.select(["id"])
+        assert sel.schema.field_names == ["id"]
+        t2 = t.with_column("pred", DataTypes.DOUBLE, [0.1, 0.9])
+        assert t2.schema.field_names == ["id", "f1", "f2", "pred"]
+        t3 = t2.with_column("f1", DataTypes.DOUBLE, [9.0, 9.0])  # replace keeps position
+        assert t3.schema.field_names == ["id", "f1", "f2", "pred"]
+        assert t3.col("f1").tolist() == [9.0, 9.0]
+        assert t.slice_rows(1, 2).to_rows() == [(4, 5.0, 6.0)]
+
+    def test_concat_and_batches(self):
+        t = Table.from_rows([(1, 2.0, 3.0), (4, 5.0, 6.0), (7, 8.0, 9.0)], _schema())
+        parts = list(t.iter_batches(2))
+        assert [p.num_rows() for p in parts] == [2, 1]
+        back = Table.concat(parts)
+        assert back.to_rows() == t.to_rows()
+
+    def test_vector_column_bridge(self):
+        s = Schema(["features", "label"], [DataTypes.VECTOR, DataTypes.DOUBLE])
+        t = Table.from_rows(
+            [(DenseVector([1, 2]), 1.0), (SparseVector(2, [1], [5.0]), 0.0)], s
+        )
+        dense = t.features_dense("features")
+        assert dense.tolist() == [[1, 2], [0, 5]]
+        csr = t.features_csr("features", n_cols=2, pad_multiple=8)
+        assert np.asarray(csr.to_dense()).tolist() == [[1, 2], [0, 5]]
+
+    def test_vector_column_type_check(self):
+        s = Schema(["features"], [DataTypes.VECTOR])
+        with pytest.raises(TypeError, match="non-vector"):
+            Table.from_rows([("not a vector",)], s)
+
+    def test_numeric_matrix(self):
+        t = Table.from_rows([(1, 2.0, 3.0), (4, 5.0, 6.0)], _schema())
+        m = t.numeric_matrix(["f1", "f2"])
+        assert m.tolist() == [[2, 3], [5, 6]]
+        s2 = Schema(["a"], [DataTypes.STRING])
+        t2 = Table.from_rows([("x",)], s2)
+        with pytest.raises(ValueError, match="numeric"):
+            t2.numeric_matrix(["a"])
+
+
+class TestOutputColsHelper:
+    """Mirrors OutputColsHelperTest.java:44-194 rule coverage."""
+
+    def test_javadoc_example(self):
+        helper = OutputColsHelper(
+            _schema(), ["label"], [DataTypes.STRING], reserved_col_names=["id"]
+        )
+        rs = helper.get_result_schema()
+        assert rs.field_names == ["id", "label"]
+        assert rs.field_types == [DataTypes.INT, DataTypes.STRING]
+
+    def test_reserve_all_default(self):
+        helper = OutputColsHelper(_schema(), ["label"], [DataTypes.STRING])
+        assert helper.get_result_schema().field_names == ["id", "f1", "f2", "label"]
+
+    def test_output_overrides_in_place(self):
+        helper = OutputColsHelper(_schema(), ["f1"], [DataTypes.STRING])
+        rs = helper.get_result_schema()
+        assert rs.field_names == ["id", "f1", "f2"]
+        assert rs.field_types == [DataTypes.INT, DataTypes.STRING, DataTypes.DOUBLE]
+
+    def test_merge_values(self):
+        t = Table.from_rows([(1, 2.0, 3.0), (4, 5.0, 6.0)], _schema())
+        helper = OutputColsHelper(
+            t.schema, ["pred"], [DataTypes.DOUBLE], reserved_col_names=["id", "f2"]
+        )
+        out = helper.get_result_table(t, {"pred": [0.5, 0.7]})
+        assert out.schema.field_names == ["id", "f2", "pred"]
+        assert out.to_rows() == [(1, 3.0, 0.5), (4, 6.0, 0.7)]
+
+    def test_missing_output_col_raises(self):
+        t = Table.from_rows([(1, 2.0, 3.0)], _schema())
+        helper = OutputColsHelper(t.schema, ["pred"], [DataTypes.DOUBLE])
+        with pytest.raises(ValueError, match="did not produce"):
+            helper.get_result_table(t, {"other": [1.0]})
+
+
+class TestTableUtil:
+    def test_temp_table_name_unique(self):
+        assert table_util.get_temp_table_name() != table_util.get_temp_table_name()
+
+    def test_find_col_index_null_raises(self):
+        with pytest.raises(ValueError):
+            table_util.find_col_index(["a"], None)
+        assert table_util.find_col_index(["a", "B"], "b") == 1
+
+    def test_assertions(self):
+        s = Schema(["num", "txt", "vec"], [DataTypes.DOUBLE, DataTypes.STRING, DataTypes.VECTOR])
+        table_util.assert_selected_col_exist(s.field_names, "num")
+        with pytest.raises(ValueError):
+            table_util.assert_selected_col_exist(s.field_names, "zz")
+        table_util.assert_numerical_cols(s, "num")
+        with pytest.raises(ValueError):
+            table_util.assert_numerical_cols(s, "txt")
+        table_util.assert_string_cols(s, "txt")
+        with pytest.raises(ValueError):
+            table_util.assert_string_cols(s, "vec")
+        table_util.assert_vector_cols(s, "vec")
+        with pytest.raises(ValueError):
+            table_util.assert_vector_cols(s, "num")
+
+    def test_typed_col_selection(self):
+        s = Schema(["a", "b", "c"], [DataTypes.DOUBLE, DataTypes.STRING, DataTypes.INT])
+        assert table_util.get_numeric_cols(s) == ["a", "c"]
+        assert table_util.get_numeric_cols(s, exclude_cols=["A"]) == ["c"]
+        assert table_util.get_string_cols(s) == ["b"]
+        assert table_util.get_categorical_cols(s, ["a", "b"], None) == ["b"]
+        assert table_util.get_categorical_cols(s, ["a", "b"], ["a"]) == ["a", "b"]
+        with pytest.raises(ValueError, match="featureCols"):
+            table_util.get_categorical_cols(s, ["a"], ["c"])
+
+    def test_format_markdown(self):
+        t = Table.from_rows([(1, 2.0, None)], Schema(["x", "y", "z"],
+                            [DataTypes.INT, DataTypes.DOUBLE, DataTypes.STRING]))
+        text = table_util.format(t)
+        assert text.splitlines()[0] == "|x|y|z|"
+        assert "null" in text.splitlines()[2]
+
+
+class TestSources:
+    def test_collection_source(self):
+        src = CollectionSource([(1, 2.0, 3.0)], _schema())
+        assert src.read().num_rows() == 1
+
+    def test_csv_source(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("id,f1,vec\n1,2.5,1 2 3\n2,,0:1 4:5\n")
+        s = Schema(["id", "f1", "vec"], [DataTypes.INT, DataTypes.DOUBLE, DataTypes.VECTOR])
+        t = CsvSource(str(p), s, skip_header=True).read()
+        assert t.num_rows() == 2
+        assert t.col("id").tolist() == [1, 2]
+        assert np.isnan(t.col("f1")[1])
+        assert isinstance(t.col("vec")[0], DenseVector)
+        assert isinstance(t.col("vec")[1], SparseVector)
+
+    def test_csv_arity_error(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1,2\n")
+        with pytest.raises(ValueError, match="fields"):
+            CsvSource(str(p), _schema()).read()
+
+    def test_libsvm_source(self, tmp_path):
+        p = tmp_path / "d.svm"
+        p.write_text("1 1:0.5 3:1.5  # comment\n-1 2:2.0\n\n")
+        t = LibSvmSource(str(p)).read()
+        assert t.col("label").tolist() == [1.0, -1.0]
+        v0 = t.col("features")[0]
+        assert v0.indices.tolist() == [0, 2] and v0.vals.tolist() == [0.5, 1.5]
+        assert v0.size() == 3
+
+    def test_generator_source_linear_timestamps(self):
+        s = Schema(["v"], [DataTypes.INT])
+        src = GeneratorSource.linear_timestamps([(1,), (2,), (3,)], 10, s)
+        events = list(src.stream())
+        assert events == [(0, (1,)), (10, (2,)), (20, (3,))]
+        # re-iterable
+        assert len(list(src.stream())) == 3
