@@ -1,0 +1,27 @@
+"""iteration — the runtime the reference only specified.
+
+The reference ships the FLIP-176 iteration API as javadoc + ``return null``
+(Iterations.java:89,112).  This package implements those semantics for real:
+
+* :func:`iterate_bounded` — bounded iteration with replayed/streamed inputs,
+  epoch watermarks, per-epoch listener callbacks, ALL_ROUND/PER_ROUND operator
+  lifecycles, and both termination modes (no feedback records; empty
+  termination-criteria output) plus max-epoch (Iterations.java:38-49,93-96).
+* :func:`iterate_unbounded` / :class:`StreamingDriver` — the unbounded online
+  path: event-time tumbling windows over unbounded sources, per-window model
+  updates, concurrent prediction against the freshest model
+  (IncrementalLearningSkeleton.java:61-83 shape).
+* :mod:`device` — the fast path where an epoch is one compiled step on
+  device (`lax.fori_loop` / `lax.while_loop` with on-device convergence),
+  which is what algorithm Estimators actually use for bounded training.
+"""
+
+from flink_ml_tpu.iteration.config import IterationConfig, OperatorLifeCycle  # noqa: F401
+from flink_ml_tpu.iteration.listener import IterationListener  # noqa: F401
+from flink_ml_tpu.iteration.bounded import (  # noqa: F401
+    IterationBodyResult,
+    ReplayableInputs,
+    iterate_bounded,
+)
+from flink_ml_tpu.iteration.device import train_epochs, train_until  # noqa: F401
+from flink_ml_tpu.iteration.unbounded import StreamingDriver, iterate_unbounded  # noqa: F401

@@ -1,0 +1,163 @@
+"""Bounded iteration runtime — implements Iterations.iterateBoundedStreamsUntilTermination.
+
+Semantics implemented from the reference's spec (the entry point itself is
+``return null``, Iterations.java:107-113):
+
+* **Inputs** (Iterations.java javadoc): ``variables`` — the initial values of
+  the feedback state; ``data`` — bounded inputs, each either *replayed* every
+  epoch or *streamed once* in epoch 0 (ReplayableDataStreamList.java:40-81).
+* **Epoch algebra** (Iterations.java:38-49): the initial variable values carry
+  epoch 0; each pass of the body that emits feedback increments the epoch.
+  Epoch N's watermark fires when the body finishes pass N — listeners receive
+  it via ``on_epoch_watermark_incremented`` (the per-round barrier).
+* **Termination** (Iterations.java:93-96; IterationBodyResult.java:44-48):
+  the iteration stops when (a) the body emits no feedback (None), (b) the
+  termination-criteria output is empty for a round, or (c) ``max_epochs`` is
+  reached.
+* **Lifecycles** (IterationConfig.java:54-61): ALL_ROUND calls one body object
+  every epoch (it may keep state); PER_ROUND re-creates the body from a
+  factory each epoch.
+
+The body is a host-level protocol; algorithm hot loops use
+:mod:`flink_ml_tpu.iteration.device` (one epoch == one compiled device step)
+and surface through this runtime for listener/termination semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from flink_ml_tpu.iteration.config import IterationConfig, OperatorLifeCycle
+from flink_ml_tpu.iteration.listener import IterationListener, ListenerContext
+from flink_ml_tpu.table.table import Table
+
+
+@dataclass
+class ReplayableInputs:
+    """Which bounded inputs are replayed each epoch vs streamed once
+    (ReplayableDataStreamList.java:40-44)."""
+
+    replayed: Dict[str, Any] = field(default_factory=dict)
+    non_replayed: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def replay(**inputs) -> "ReplayableInputs":
+        return ReplayableInputs(replayed=dict(inputs))
+
+    @staticmethod
+    def no_replay(**inputs) -> "ReplayableInputs":
+        return ReplayableInputs(non_replayed=dict(inputs))
+
+    def and_replay(self, **inputs) -> "ReplayableInputs":
+        self.replayed.update(inputs)
+        return self
+
+    def and_no_replay(self, **inputs) -> "ReplayableInputs":
+        self.non_replayed.update(inputs)
+        return self
+
+
+@dataclass
+class IterationBodyResult:
+    """Body output per epoch (IterationBodyResult.java:30-59).
+
+    ``feedback``: next epoch's variable values; None signals natural end.
+    ``outputs``: values surfaced out of the iteration (last one wins per key,
+    or accumulate — the runtime collects them all, keyed by epoch).
+    ``termination_criteria``: when given, an empty value (len()==0 or falsy)
+    terminates the iteration after this epoch.
+    """
+
+    feedback: Optional[Any] = None
+    outputs: Optional[Dict[str, Any]] = None
+    termination_criteria: Optional[Any] = None
+
+
+BodyFn = Callable[[Any, Dict[str, Any], int], IterationBodyResult]
+
+
+@dataclass
+class IterationResult:
+    final_variables: Any
+    epochs_run: int
+    outputs_per_epoch: List[Dict[str, Any]]
+    listener_context: ListenerContext
+
+    def last_output(self, key: str, default=None):
+        for outputs in reversed(self.outputs_per_epoch):
+            if outputs and key in outputs:
+                return outputs[key]
+        return default
+
+
+def _criteria_empty(criteria: Any) -> bool:
+    if criteria is None:
+        return False  # absent criteria stream never terminates
+    if isinstance(criteria, Table):
+        return criteria.num_rows() == 0
+    try:
+        return len(criteria) == 0
+    except TypeError:
+        return not bool(criteria)
+
+
+def iterate_bounded(
+    variables: Any,
+    data: Optional[ReplayableInputs],
+    body: Union[BodyFn, Callable[[], BodyFn]],
+    config: Optional[IterationConfig] = None,
+    listeners: Sequence[IterationListener] = (),
+) -> IterationResult:
+    """Run the bounded iteration to termination.
+
+    ``body(variables, inputs, epoch)`` receives the current variable values,
+    a dict of inputs (replayed inputs every epoch; non-replayed only in epoch
+    0), and the epoch number; it returns an :class:`IterationBodyResult`.
+    Under PER_ROUND, ``body`` must be a zero-arg factory returning a fresh
+    body callable each epoch.
+    """
+    config = config or IterationConfig()
+    data = data or ReplayableInputs()
+    context = ListenerContext()
+    per_round = config.operator_life_cycle == OperatorLifeCycle.PER_ROUND
+    if per_round:
+        body_factory = body
+    else:
+        body_fn = body
+
+    outputs_per_epoch: List[Dict[str, Any]] = []
+    epoch = 0
+    current = variables
+    while True:
+        if config.max_epochs is not None and epoch >= config.max_epochs:
+            break
+        inputs = dict(data.replayed)
+        if epoch == 0:
+            inputs.update(data.non_replayed)
+        fn = body_factory() if per_round else body_fn
+        result = fn(current, inputs, epoch)
+        if not isinstance(result, IterationBodyResult):
+            raise TypeError("iteration body must return IterationBodyResult")
+        outputs_per_epoch.append(result.outputs or {})
+
+        # the epoch watermark for this round: all work of `epoch` is complete
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch, context)
+
+        if result.feedback is None:
+            epoch += 1
+            break
+        current = result.feedback
+        epoch += 1
+        if _criteria_empty(result.termination_criteria):
+            break
+
+    for listener in listeners:
+        listener.on_iteration_terminated(context)
+    return IterationResult(
+        final_variables=current,
+        epochs_run=epoch,
+        outputs_per_epoch=outputs_per_epoch,
+        listener_context=context,
+    )

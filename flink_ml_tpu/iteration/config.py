@@ -1,0 +1,49 @@
+"""Iteration configuration — IterationConfig.java parity plus runtime knobs."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+
+class OperatorLifeCycle(enum.Enum):
+    """IterationConfig.OperatorLifeCycle (IterationConfig.java:54-61).
+
+    ALL_ROUND: body state persists across epochs (operators live the whole
+    iteration).  PER_ROUND: the body is re-created every epoch (the reference
+    re-creates the per-round subgraph, IterationBody.forEachRound).
+    """
+
+    ALL_ROUND = "all_round"
+    PER_ROUND = "per_round"
+
+
+@dataclass
+class IterationConfig:
+    operator_life_cycle: OperatorLifeCycle = OperatorLifeCycle.ALL_ROUND
+    # Safety bound on epochs; None = run until a termination condition fires.
+    max_epochs: Optional[int] = None
+
+    @staticmethod
+    def new_builder() -> "IterationConfigBuilder":
+        return IterationConfigBuilder()
+
+
+class IterationConfigBuilder:
+    """Fluent builder (IterationConfig.java:32-50)."""
+
+    def __init__(self) -> None:
+        self._life_cycle = OperatorLifeCycle.ALL_ROUND
+        self._max_epochs: Optional[int] = None
+
+    def set_operator_life_cycle(self, lc: OperatorLifeCycle) -> "IterationConfigBuilder":
+        self._life_cycle = lc
+        return self
+
+    def set_max_epochs(self, n: Optional[int]) -> "IterationConfigBuilder":
+        self._max_epochs = n
+        return self
+
+    def build(self) -> IterationConfig:
+        return IterationConfig(self._life_cycle, self._max_epochs)

@@ -1,0 +1,64 @@
+"""Device-side epoch loops — the compiled fast path of the iteration runtime.
+
+One epoch ≡ one step of a compiled loop (SURVEY.md §2.2 build implication).
+Two shapes:
+
+* :func:`train_epochs` — fixed epoch count: ``lax.scan``/``fori_loop`` over the
+  epoch body, entirely on device; the epoch watermark degenerates to the
+  implicit barrier of the in-step collective.
+* :func:`train_until` — convergence-tested: ``lax.while_loop`` whose predicate
+  evaluates the termination criterion on device (e.g. parameter delta below
+  tol), realizing the reference's "termination-criteria stream empty in a
+  round" (IterationBodyResult.java:44-48) as a device-friendly scalar test —
+  the criteria count is a psum'd scalar; 0 means stop.
+
+Both take ``step(state, epoch) -> state`` functions that are jit-traceable;
+data must already live in the closure or the state (replayed inputs are
+device-resident across epochs — no host round-trips between rounds).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def train_epochs(
+    step: Callable[[Any, jnp.ndarray], Any],
+    state: Any,
+    num_epochs: int,
+    unroll: int = 1,
+) -> Any:
+    """Run ``step`` for a fixed number of epochs inside one compiled loop."""
+
+    def body(carry, epoch):
+        return step(carry, epoch), None
+
+    final, _ = jax.lax.scan(body, state, jnp.arange(num_epochs), unroll=unroll)
+    return final
+
+
+def train_until(
+    step: Callable[[Any, jnp.ndarray], Any],
+    state: Any,
+    should_continue: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    max_epochs: int,
+) -> Tuple[Any, jnp.ndarray]:
+    """Run ``step`` until ``should_continue(state, epoch)`` is False on device.
+
+    Returns (final_state, epochs_run).  The whole loop is one XLA while_loop:
+    no host sync per epoch, convergence is read back exactly once at the end.
+    """
+
+    def cond(carry):
+        state, epoch = carry
+        return jnp.logical_and(epoch < max_epochs, should_continue(state, epoch))
+
+    def body(carry):
+        state, epoch = carry
+        return step(state, epoch), epoch + 1
+
+    final_state, epochs = jax.lax.while_loop(cond, body, (state, jnp.asarray(0)))
+    return final_state, epochs

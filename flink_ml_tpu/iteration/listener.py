@@ -1,0 +1,38 @@
+"""IterationListener — per-epoch progress callbacks (IterationListener.java).
+
+``on_epoch_watermark_incremented(epoch_watermark, context)`` fires when epoch
+``epoch_watermark`` has fully finished across the (virtual) parallel subtasks
+— on TPU the aligned progress barrier degenerates to the completion of the
+epoch's device step (the ICI collective is the barrier).  The final call uses
+the terminating epoch, then ``on_iteration_terminated(context)`` fires once
+(IterationListener.java:40-59).  ``context.output(tag, value)`` collects
+side outputs per epoch.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+class ListenerContext:
+    """Side-output collector handed to listener callbacks (Context:65-73)."""
+
+    def __init__(self) -> None:
+        self._outputs: Dict[str, List[Any]] = defaultdict(list)
+
+    def output(self, tag: str, value: Any) -> None:
+        self._outputs[tag].append(value)
+
+    def get_outputs(self, tag: str) -> List[Any]:
+        return list(self._outputs[tag])
+
+
+class IterationListener:
+    def on_epoch_watermark_incremented(
+        self, epoch_watermark: int, context: ListenerContext
+    ) -> None:  # pragma: no cover - interface default
+        pass
+
+    def on_iteration_terminated(self, context: ListenerContext) -> None:  # pragma: no cover
+        pass

@@ -1,0 +1,181 @@
+"""Unbounded iteration — the streaming mini-batch driver.
+
+Implements the reference's unbounded topology (Iterations.iterateUnboundedStreams
+spec, Iterations.java:87-90, and the IncrementalLearningSkeleton shape,
+:61-83): a training stream is cut into event-time tumbling windows; each fired
+window updates the model (PartialModelBuilder:161-174); a concurrent
+prediction stream is served by the *freshest* model (Predictor CoMap:182-211).
+
+TPU-first realization: the driver merges the timestamped streams
+deterministically on the host, fires windows when the watermark (max event
+time seen) passes the window end, and batches all prediction records that fall
+between two model updates into one device call — behaviorally identical to
+per-record CoMap (every record sees exactly the model that was current at its
+event time) but executed as batched XLA instead of a per-record hot loop.
+
+Epoch accounting: window N's model update is epoch N; listeners receive epoch
+watermarks exactly as in the bounded runtime.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from flink_ml_tpu.iteration.listener import IterationListener, ListenerContext
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.table.sources import UnboundedSource
+
+
+@dataclass
+class StreamingResult:
+    final_state: Any
+    windows_fired: int
+    predictions: List[Tuple[int, Any]]  # (event_time, predicted value) per record
+    listener_context: ListenerContext
+    model_updates: List[Tuple[int, Any]] = field(default_factory=list)  # (window_end, state)
+
+
+class StreamingDriver:
+    """Event-time tumbling-window trainer with a concurrent prediction path.
+
+    ``update(state, window_table, epoch) -> state`` fires per completed window
+    (the PartialModelBuilder role).  ``predict(state, batch_table) ->
+    sequence`` serves the prediction stream with the current model (the
+    Predictor role); it may return any per-row sequence (list/array).
+    """
+
+    def __init__(
+        self,
+        window_ms: int,
+        keep_model_history: bool = False,
+        prediction_flush_rows: int = 8192,
+    ):
+        if window_ms <= 0:
+            raise ValueError("window_ms must be positive")
+        self.window_ms = int(window_ms)
+        self.keep_model_history = keep_model_history
+        # predictions sharing one model version can flush early in batches of
+        # this size — bounds prediction latency on long-running streams
+        self.prediction_flush_rows = prediction_flush_rows
+
+    def run(
+        self,
+        initial_state: Any,
+        training_source: UnboundedSource,
+        update: Callable[[Any, Table, int], Any],
+        prediction_source: Optional[UnboundedSource] = None,
+        predict: Optional[Callable[[Any, Table], Sequence]] = None,
+        listeners: Sequence[IterationListener] = (),
+        max_windows: Optional[int] = None,
+    ) -> StreamingResult:
+        if (prediction_source is None) != (predict is None):
+            raise ValueError("prediction_source and predict must be given together")
+
+        context = ListenerContext()
+        state = initial_state
+        window_ms = self.window_ms
+        train_schema = training_source.schema()
+
+        # merge the two timestamped streams; training sorts before prediction
+        # at equal timestamps so a model update at time T serves a prediction
+        # at time T (matching connect() delivering the model first)
+        TRAIN, PREDICT = 0, 1
+        streams: List[Iterator] = [
+            ((ts, TRAIN, row) for ts, row in training_source.stream())
+        ]
+        if prediction_source is not None:
+            streams.append(((ts, PREDICT, row) for ts, row in prediction_source.stream()))
+        merged = heapq.merge(*streams, key=lambda e: (e[0], e[1]))
+
+        window_rows: List[Tuple] = []
+        window_end: Optional[int] = None  # current window is [window_end-w, window_end)
+        pending_predictions: List[Tuple[int, Tuple]] = []
+        predictions: List[Tuple[int, Any]] = []
+        model_updates: List[Tuple[int, Any]] = []
+        epoch = 0
+        stopped = False
+
+        def flush_predictions():
+            if not pending_predictions or predict is None:
+                return
+            batch = Table.from_rows(
+                [row for _, row in pending_predictions], prediction_source.schema()
+            )
+            outs = list(predict(state, batch))
+            if len(outs) != len(pending_predictions):
+                raise ValueError(
+                    f"predict returned {len(outs)} values for a batch of "
+                    f"{len(pending_predictions)} rows"
+                )
+            for (ts, _), out in zip(pending_predictions, outs):
+                predictions.append((ts, out))
+            pending_predictions.clear()
+
+        def fire_window(end_ts: int):
+            nonlocal state, epoch, stopped
+            # predictions timestamped before this window's close see the old model
+            flush_predictions()
+            table = Table.from_rows(window_rows, train_schema)
+            window_rows.clear()
+            state = update(state, table, epoch)
+            if self.keep_model_history:
+                model_updates.append((end_ts, state))
+            for listener in listeners:
+                listener.on_epoch_watermark_incremented(epoch, context)
+            epoch += 1
+            if max_windows is not None and epoch >= max_windows:
+                stopped = True
+
+        for ts, kind, row in merged:
+            if window_end is None:
+                window_end = (ts // window_ms + 1) * window_ms
+            # the watermark (= ts, streams are time-ordered) may close windows
+            while ts >= window_end and not stopped:
+                if window_rows:
+                    fire_window(window_end)
+                # empty window: no model update, the watermark still advances
+                window_end += window_ms
+            if stopped:
+                break
+            if kind == TRAIN:
+                window_rows.append(tuple(row))
+            else:
+                pending_predictions.append((ts, tuple(row)))
+                if len(pending_predictions) >= self.prediction_flush_rows:
+                    flush_predictions()
+
+        # end of streams: fire the final partial window, then flush predictions
+        if not stopped and window_rows:
+            fire_window(window_end if window_end is not None else window_ms)
+        flush_predictions()
+
+        for listener in listeners:
+            listener.on_iteration_terminated(context)
+        return StreamingResult(
+            final_state=state,
+            windows_fired=epoch,
+            predictions=predictions,
+            listener_context=context,
+            model_updates=model_updates,
+        )
+
+
+def iterate_unbounded(
+    initial_state: Any,
+    training_source: UnboundedSource,
+    update: Callable[[Any, Table, int], Any],
+    window_ms: int = 5000,
+    keep_model_history: bool = False,
+    prediction_flush_rows: int = 8192,
+    **run_kwargs,
+) -> StreamingResult:
+    """Functional entry point (Iterations.iterateUnboundedStreams analog)."""
+    driver = StreamingDriver(
+        window_ms,
+        keep_model_history=keep_model_history,
+        prediction_flush_rows=prediction_flush_rows,
+    )
+    return driver.run(initial_state, training_source, update, **run_kwargs)

@@ -1,0 +1,22 @@
+"""parallel — device mesh + collectives.
+
+Replaces the role Flink's runtime plays in the reference (SURVEY.md §2.6):
+operator parallelism becomes mesh axes, broadcast variables become replicated
+shardings, the ReduceFunction-shuffle model-averaging becomes an in-step
+``psum``/``pmean`` over ICI, and multi-host scale-out goes through
+``jax.distributed`` + a multi-host Mesh instead of a JobManager.
+"""
+
+from flink_ml_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    default_mesh,
+    initialize_distributed,
+    replicate,
+    shard_batch,
+)
+from flink_ml_tpu.parallel.collectives import (  # noqa: F401
+    all_gather,
+    make_data_parallel_step,
+    pmean,
+    psum,
+)

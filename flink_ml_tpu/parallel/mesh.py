@@ -1,0 +1,78 @@
+"""Device mesh construction and data placement.
+
+The mesh is N-dimensional from the start (SURVEY.md §2.6: keep
+``('data', 'model')`` possible even though the reference only has data
+parallelism) so feature-dimension sharding (TP) can be enabled per-algorithm
+without redesign.  Intra-slice traffic rides ICI; multi-host initialization
+goes through ``jax.distributed`` (DCN for cross-slice).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def default_mesh(axis_names: Sequence[str] = ("data",), devices=None) -> Mesh:
+    """All available devices laid out on the first axis (pure data parallel)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def create_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Mesh from an ordered ``{axis_name: size}`` spec, e.g. {'data': 4, 'model': 2}."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    total = math.prod(axes.values())
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(list(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = "data"):
+    """Place a host batch pytree on the mesh, sharded along ``axis`` on dim 0.
+
+    The device-side analog of Flink distributing row partitions to subtasks.
+    Leading dimensions must divide the axis size (pad at the data-plane level).
+    """
+    def _put(x):
+        ndim = getattr(x, "ndim", 0)
+        spec = P(axis) if ndim >= 1 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_put, batch)
+
+
+def replicate(mesh: Mesh, pytree):
+    """Replicate a pytree to every device — the broadcast-variable analog
+    (BroadcastVariableModelSource.java:44-46 -> one all-devices placement)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), pytree
+    )
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up via jax.distributed (DCN control plane).
+
+    No-op when single-process args are absent — single-host meshes need no
+    initialization.  Call once per host before building a multi-host mesh.
+    """
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

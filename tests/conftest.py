@@ -3,13 +3,25 @@
 Runs the whole suite on a virtual 8-device CPU mesh so psum/shard_map tests
 exercise real collectives without TPU hardware — the analog of the reference
 running parallel subtasks in Flink's in-JVM mini-cluster (SURVEY.md §4).
-Must set env vars before jax is imported anywhere.
+
+Note: this environment pre-imports jax at interpreter startup (sitecustomize)
+and forces the platform list programmatically, so env vars alone are not
+enough — the jax config must be updated before the first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()} on "
+    f"{jax.default_backend()}; backend was initialized before conftest"
+)

@@ -1,0 +1,230 @@
+"""Iteration runtime tests — the FLIP-176 semantics the reference specified
+but never implemented (Iterations.java:38-49,93-96; IterationConfig lifecycles;
+IterationListener callbacks; replay semantics; streaming windows)."""
+
+import jax.numpy as jnp
+import pytest
+
+from flink_ml_tpu.iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    OperatorLifeCycle,
+    ReplayableInputs,
+    StreamingDriver,
+    iterate_bounded,
+    iterate_unbounded,
+    train_epochs,
+    train_until,
+)
+from flink_ml_tpu.table import DataTypes, GeneratorSource, Schema, Table
+
+
+class RecordingListener(IterationListener):
+    def __init__(self):
+        self.epochs = []
+        self.terminated = 0
+
+    def on_epoch_watermark_incremented(self, epoch, context):
+        self.epochs.append(epoch)
+        context.output("epoch_log", epoch)
+
+    def on_iteration_terminated(self, context):
+        self.terminated += 1
+
+
+class TestBounded:
+    def test_max_epochs_termination(self):
+        def body(state, inputs, epoch):
+            return IterationBodyResult(feedback=state + 1)
+
+        listener = RecordingListener()
+        res = iterate_bounded(
+            0, None, body, IterationConfig(max_epochs=5), listeners=[listener]
+        )
+        assert res.final_variables == 5
+        assert res.epochs_run == 5
+        assert listener.epochs == [0, 1, 2, 3, 4]
+        assert listener.terminated == 1
+        assert res.listener_context.get_outputs("epoch_log") == [0, 1, 2, 3, 4]
+
+    def test_no_feedback_terminates(self):
+        def body(state, inputs, epoch):
+            if epoch == 2:
+                return IterationBodyResult(feedback=None, outputs={"final": state})
+            return IterationBodyResult(feedback=state * 2)
+
+        res = iterate_bounded(1, None, body)
+        assert res.epochs_run == 3
+        assert res.last_output("final") == 4
+
+    def test_empty_criteria_terminates(self):
+        """Terminate when the criteria output is empty in a round
+        (IterationBodyResult.java:44-48)."""
+
+        def body(state, inputs, epoch):
+            remaining = 3 - epoch
+            criteria = Table.from_rows(
+                [(i,) for i in range(remaining)], Schema(["c"], [DataTypes.INT])
+            )
+            return IterationBodyResult(feedback=state + 1, termination_criteria=criteria)
+
+        res = iterate_bounded(0, None, body, IterationConfig(max_epochs=100))
+        # epochs 0,1,2 have non-empty criteria; epoch 3's is empty -> stop
+        assert res.epochs_run == 4
+        assert res.final_variables == 4
+
+    def test_replay_vs_no_replay(self):
+        seen = []
+
+        def body(state, inputs, epoch):
+            seen.append(sorted(inputs.keys()))
+            if epoch == 2:
+                return IterationBodyResult(feedback=None)
+            return IterationBodyResult(feedback=state)
+
+        data = ReplayableInputs.replay(train=1).and_no_replay(init=2)
+        iterate_bounded(0, data, body)
+        assert seen[0] == ["init", "train"]  # epoch 0 gets both
+        assert seen[1] == ["train"]  # later epochs only replayed inputs
+        assert seen[2] == ["train"]
+
+    def test_per_round_lifecycle_recreates_body(self):
+        created = []
+
+        def factory():
+            created.append(True)
+
+            def body(state, inputs, epoch):
+                if epoch >= 2:
+                    return IterationBodyResult(feedback=None)
+                return IterationBodyResult(feedback=state)
+
+            return body
+
+        iterate_bounded(
+            0,
+            None,
+            factory,
+            IterationConfig(operator_life_cycle=OperatorLifeCycle.PER_ROUND),
+        )
+        assert len(created) == 3
+
+    def test_bad_body_return_raises(self):
+        with pytest.raises(TypeError, match="IterationBodyResult"):
+            iterate_bounded(0, None, lambda s, i, e: 42)
+
+
+class TestDeviceLoops:
+    def test_train_epochs_scan(self):
+        final = train_epochs(lambda s, e: s + 1.0, jnp.asarray(0.0), 10)
+        assert float(final) == 10.0
+
+    def test_train_until_convergence(self):
+        # halve until below tol; epoch count comes back exact
+        final, epochs = train_until(
+            step=lambda s, e: s * 0.5,
+            state=jnp.asarray(1.0),
+            should_continue=lambda s, e: s > 0.01,
+            max_epochs=100,
+        )
+        assert float(final) < 0.01
+        assert int(epochs) == 7  # 1/2^7 < 0.01
+
+    def test_train_until_respects_max(self):
+        _, epochs = train_until(
+            lambda s, e: s, jnp.asarray(1.0), lambda s, e: jnp.asarray(True), 5
+        )
+        assert int(epochs) == 5
+
+
+def _train_source(rows, interval=1000):
+    return GeneratorSource.linear_timestamps(
+        rows, interval, Schema(["v"], [DataTypes.DOUBLE])
+    )
+
+
+class TestStreaming:
+    def test_windows_fire_on_event_time(self):
+        # 10 records at 1000ms spacing, 5000ms windows -> windows [0,5000),[5000,10000)
+        rows = [(float(i),) for i in range(10)]
+        updates = []
+
+        def update(state, table, epoch):
+            updates.append((epoch, table.col("v").tolist()))
+            return state + table.num_rows()
+
+        res = iterate_unbounded(0, _train_source(rows), update, window_ms=5000)
+        assert res.windows_fired == 2
+        assert updates[0] == (0, [0.0, 1.0, 2.0, 3.0, 4.0])
+        assert updates[1] == (1, [5.0, 6.0, 7.0, 8.0, 9.0])
+        assert res.final_state == 10
+
+    def test_prediction_sees_freshest_model(self):
+        """Predictor semantics (IncrementalLearningSkeleton.java:182-211):
+        a prediction's result reflects the latest completed window."""
+        train = _train_source([(1.0,), (2.0,), (3.0,), (4.0,)], interval=1000)
+        # predictions at t=500 (before any window) and t=4500 (after window 0)
+        pred_schema = Schema(["q"], [DataTypes.DOUBLE])
+
+        def pred_gen():
+            yield 500, (100.0,)
+            yield 4500, (200.0,)
+
+        pred = GeneratorSource(pred_gen, pred_schema)
+
+        def update(state, table, epoch):
+            return state + table.num_rows()
+
+        def predict(state, batch):
+            return [state] * batch.num_rows()
+
+        res = StreamingDriver(window_ms=4000).run(
+            0, train, update, prediction_source=pred, predict=predict
+        )
+        # window [0,4000) fires with 4 records? records at 0,1000,2000,3000 -> 4 rows
+        by_ts = dict(res.predictions)
+        assert by_ts[500] == 0  # before any model update
+        assert by_ts[4500] == 4  # after first window (4 training rows seen)
+
+    def test_empty_windows_skip_updates(self):
+        def gen():
+            yield 0, (1.0,)
+            yield 20000, (2.0,)  # big event-time gap -> empty windows between
+
+        src = GeneratorSource(gen, Schema(["v"], [DataTypes.DOUBLE]))
+        count = []
+        res = iterate_unbounded(
+            0, src, lambda s, t, e: (count.append(e), s)[1], window_ms=5000
+        )
+        assert len(count) == 2  # only two non-empty windows fired
+
+    def test_max_windows_stops(self):
+        rows = [(float(i),) for i in range(100)]
+        res = iterate_unbounded(
+            0,
+            _train_source(rows),
+            lambda s, t, e: s + 1,
+            window_ms=5000,
+            max_windows=3,
+        )
+        assert res.windows_fired == 3
+
+    def test_listener_epochs(self):
+        listener = RecordingListener()
+        rows = [(float(i),) for i in range(10)]
+        iterate_unbounded(
+            0,
+            _train_source(rows),
+            lambda s, t, e: s,
+            window_ms=5000,
+            listeners=[listener],
+        )
+        assert listener.epochs == [0, 1]
+        assert listener.terminated == 1
+
+    def test_mismatched_predict_args_raise(self):
+        with pytest.raises(ValueError, match="together"):
+            StreamingDriver(1000).run(
+                0, _train_source([(1.0,)]), lambda s, t, e: s, predict=lambda s, b: []
+            )
