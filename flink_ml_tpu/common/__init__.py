@@ -1,0 +1,23 @@
+from flink_ml_tpu.common.mapper import (
+    Mapper,
+    MapperAdapter,
+    ModelMapper,
+    ModelMapperAdapter,
+)
+from flink_ml_tpu.common.model_source import (
+    BroadcastModelSource,
+    ModelSource,
+    RowsModelSource,
+    TablesModelSource,
+)
+
+__all__ = [
+    "Mapper",
+    "MapperAdapter",
+    "ModelMapper",
+    "ModelMapperAdapter",
+    "ModelSource",
+    "RowsModelSource",
+    "TablesModelSource",
+    "BroadcastModelSource",
+]

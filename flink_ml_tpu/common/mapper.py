@@ -1,0 +1,133 @@
+"""Batched row-mapper machinery — the inference path pattern.
+
+Parity map (flink-ml-lib/.../common/mapper/):
+  Mapper.java:33-79            -> Mapper (schema + params capture, output schema)
+  ModelMapper.java:31-65       -> ModelMapper (adds model schemas + load_model)
+  MapperAdapter.java:30-46     -> MapperAdapter (mapper as a table->table fn)
+  ModelMapperAdapter.java:53-61 -> ModelMapperAdapter (open(): load model from
+                                   a ModelSource, then apply)
+
+The reference's hot loop is ``map(Row)`` per record with per-record vector math
+(ModelMapperAdapter.java:58-61 — SURVEY.md §3.2).  Here the unit of work is a
+**column batch**: a Mapper declares its output columns once and implements
+``map_batch(Table) -> {col: values}``; the adapter slices the input into
+device-sized batches, runs one (usually jitted) computation per batch, and
+merges results back by the OutputColsHelper rules.  Per-record semantics are
+preserved exactly — every output row depends only on its input row — but the
+math runs as batched XLA on the MXU instead of scalar Java.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flink_ml_tpu.params.params import Params
+from flink_ml_tpu.table.output_cols import OutputColsHelper
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+
+from flink_ml_tpu.common.model_source import ModelSource
+
+
+class Mapper:
+    """Serializable batch transform capturing input schema + params
+    (Mapper.java:33-79)."""
+
+    def __init__(self, data_schema: Schema, params: Optional[Params] = None):
+        self.data_schema = data_schema
+        self.params = params if params is not None else Params()
+        names, types = self.output_cols()
+        self._helper = OutputColsHelper(
+            data_schema, names, types, reserved_col_names=self.reserved_cols()
+        )
+
+    # -- subclass contract ---------------------------------------------------
+
+    def output_cols(self) -> Tuple[List[str], List[str]]:
+        """Names and types of the columns this mapper produces."""
+        raise NotImplementedError
+
+    def reserved_cols(self) -> Optional[List[str]]:
+        """Input columns kept in the result; None keeps all (default rule)."""
+        return None
+
+    def map_batch(self, batch: Table) -> Dict[str, Sequence]:
+        """Compute the output columns for one batch of rows.
+
+        Must be row-aligned with ``batch`` (output i depends only on row i) —
+        the batched statement of the reference's per-record ``map(Row)``.
+        """
+        raise NotImplementedError
+
+    # -- provided machinery --------------------------------------------------
+
+    def get_output_schema(self) -> Schema:
+        """Result schema after the OutputColsHelper merge (getOutputSchema)."""
+        return self._helper.get_result_schema()
+
+    def apply(self, table: Table, batch_size: Optional[int] = None) -> Table:
+        """Map a whole table, batch by batch, and merge columns."""
+        if batch_size is None or table.num_rows() <= batch_size:
+            out = self.map_batch(table)
+            return self._helper.get_result_table(table, out)
+        parts = []
+        for batch in table.iter_batches(batch_size):
+            out = self.map_batch(batch)
+            parts.append(self._helper.get_result_table(batch, out))
+        return Table.concat(parts)
+
+
+class ModelMapper(Mapper):
+    """Mapper that first materializes model data (ModelMapper.java:31-65)."""
+
+    def __init__(
+        self,
+        model_schemas: Sequence[Schema],
+        data_schema: Schema,
+        params: Optional[Params] = None,
+    ):
+        self.model_schemas = list(model_schemas)
+        super().__init__(data_schema, params)
+
+    def load_model(self, *model_tables: Table) -> None:
+        """Materialize model tables into mapper state (ModelMapper.java:65).
+
+        For device mappers this is where columns become replicated jnp arrays.
+        """
+        raise NotImplementedError
+
+
+class MapperAdapter:
+    """Wraps a Mapper as a plain table->table callable (MapperAdapter.java:30-46)."""
+
+    def __init__(self, mapper: Mapper, batch_size: Optional[int] = None):
+        self.mapper = mapper
+        self.batch_size = batch_size
+
+    def __call__(self, table: Table) -> Table:
+        return self.mapper.apply(table, self.batch_size)
+
+
+class ModelMapperAdapter:
+    """Wraps a ModelMapper + ModelSource; model loads once at open
+    (ModelMapperAdapter.java:53-61)."""
+
+    def __init__(
+        self,
+        mapper: ModelMapper,
+        model_source: ModelSource,
+        batch_size: Optional[int] = None,
+    ):
+        self.mapper = mapper
+        self.model_source = model_source
+        self.batch_size = batch_size
+        self._opened = False
+
+    def open(self) -> None:
+        self.mapper.load_model(*self.model_source.get_model_tables())
+        self._opened = True
+
+    def __call__(self, table: Table) -> Table:
+        if not self._opened:
+            self.open()
+        return self.mapper.apply(table, self.batch_size)

@@ -1,0 +1,80 @@
+"""Model delivery strategies — how model data reaches the inference path.
+
+Parity map (flink-ml-lib/.../common/model/):
+  ModelSource.java:33-40                  -> ModelSource.get_model_tables
+  RowsModelSource.java:29-46              -> RowsModelSource / TablesModelSource
+  BroadcastVariableModelSource.java:44-46 -> BroadcastModelSource
+
+The reference ships model data to every parallel task as a broadcast variable
+of rows at task-open time.  The TPU-native equivalent is one placement of the
+model pytree replicated over the mesh (`parallel.mesh.replicate`) — device
+memory is the "broadcast variable"; every shard of a `shard_map`'d apply reads
+the same replicated buffers over ICI-free local HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+
+
+class ModelSource:
+    """Strategy for obtaining the model tables at apply time
+    (ModelSource.java:33-40)."""
+
+    def get_model_tables(self) -> Tuple[Table, ...]:
+        raise NotImplementedError
+
+
+class TablesModelSource(ModelSource):
+    """Model data from in-memory tables (RowsModelSource.java analog)."""
+
+    def __init__(self, *tables: Table):
+        self._tables = tables
+
+    def get_model_tables(self) -> Tuple[Table, ...]:
+        return self._tables
+
+
+class RowsModelSource(ModelSource):
+    """Model data from raw rows + schema — the literal RowsModelSource shape."""
+
+    def __init__(self, rows: Sequence[Sequence], schema: Schema):
+        self._table = Table.from_rows(rows, schema)
+
+    def get_model_tables(self) -> Tuple[Table, ...]:
+        return (self._table,)
+
+
+class BroadcastModelSource(ModelSource):
+    """Model tables + a device-replicated pytree of the packed model.
+
+    The reference's BroadcastVariableModelSource pulls rows from the Flink
+    broadcast at every task's ``open()`` (BroadcastVariableModelSource.java:44-46).
+    Here the broadcast happens once: ``pack`` converts the model tables to a
+    pytree of arrays and :func:`flink_ml_tpu.parallel.mesh.replicate` places it
+    on every device of the mesh; ``get_packed()`` returns the replicated value.
+    """
+
+    def __init__(self, tables: Tuple[Table, ...], pack=None, mesh=None):
+        self._tables = tuple(tables)
+        self._pack = pack
+        self._mesh = mesh
+        self._packed = None
+
+    def get_model_tables(self) -> Tuple[Table, ...]:
+        return self._tables
+
+    def get_packed(self):
+        if self._packed is None:
+            if self._pack is None:
+                raise ValueError("no pack function given")
+            value = self._pack(*self._tables)
+            if self._mesh is not None:
+                from flink_ml_tpu.parallel.mesh import replicate
+
+                value = replicate(self._mesh, value)
+            self._packed = value
+        return self._packed

@@ -19,7 +19,7 @@ device-resident across epochs — no host round-trips between rounds).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp

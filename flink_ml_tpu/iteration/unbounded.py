@@ -21,10 +21,9 @@ from __future__ import annotations
 
 import heapq
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 from flink_ml_tpu.iteration.listener import IterationListener, ListenerContext
-from flink_ml_tpu.table.schema import Schema
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.table.sources import UnboundedSource
 

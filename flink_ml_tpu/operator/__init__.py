@@ -1,0 +1,11 @@
+from flink_ml_tpu.operator.base import AlgoOperator
+from flink_ml_tpu.operator.batch import BatchOperator, TableSourceBatchOp
+from flink_ml_tpu.operator.stream import StreamOperator, TableSourceStreamOp
+
+__all__ = [
+    "AlgoOperator",
+    "BatchOperator",
+    "TableSourceBatchOp",
+    "StreamOperator",
+    "TableSourceStreamOp",
+]

@@ -1,0 +1,97 @@
+"""Alink-heritage operator DAG layer.
+
+Parity map (flink-ml-lib/.../operator/):
+  AlgoOperator.java:44-186  -> AlgoOperator (params + primary output table +
+                               side outputs, schema accessors, arity checks)
+  BatchOperator.java:69-107 -> operator.batch.BatchOperator (link/link_from)
+  StreamOperator.java:70-108 -> operator.stream.StreamOperator
+
+The reference keeps this richer DAG-wiring abstraction alongside the thin
+``api.core.AlgoOperator`` without unifying them (SURVEY.md §1 note).  Here
+they ARE unified: this class extends the api-level Stage/WithParams hierarchy,
+so an operator can be dropped into a Pipeline, and the api-level
+``transform`` is provided in terms of ``link_from``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from flink_ml_tpu.api.core import AlgoOperator as ApiAlgoOperator
+from flink_ml_tpu.params.shared import HasMLEnvironmentId
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.environment import MLEnvironment, MLEnvironmentFactory
+
+
+class AlgoOperator(ApiAlgoOperator, HasMLEnvironmentId):
+    """Abstract operator holding Params + primary output + side outputs
+    (AlgoOperator.java:44-186)."""
+
+    # class-level defaults so instances reconstructed via the Stage.load
+    # convention (klass.__new__ + Stage.__init__, api/core.py) still get the
+    # designed "no output yet" error instead of AttributeError
+    _output: Optional[Table] = None
+    _side_outputs: Sequence[Table] = ()
+
+    def __init__(self, params=None):
+        super().__init__()
+        if params is not None:
+            self.get_params().merge(params)
+        self._output = None
+        self._side_outputs = ()
+
+    # -- outputs (AlgoOperator.java:50-92) -----------------------------------
+
+    def get_output(self) -> Table:
+        if self._output is None:
+            raise RuntimeError(
+                "operator has no output yet; call link_from first"
+            )
+        return self._output
+
+    def get_side_outputs(self) -> Sequence[Table]:
+        return self._side_outputs
+
+    def set_output(self, table: Table) -> None:
+        self._output = table
+
+    def set_side_outputs(self, tables: Sequence[Table]) -> None:
+        self._side_outputs = tuple(tables)
+
+    def get_schema(self) -> Schema:
+        """Schema of the primary output (AlgoOperator.java:149)."""
+        return self.get_output().schema
+
+    def get_col_names(self) -> List[str]:
+        return self.get_schema().field_names
+
+    def get_ml_environment(self) -> MLEnvironment:
+        return MLEnvironmentFactory.get(self.get_ml_environment_id())
+
+    # -- arity checks (AlgoOperator.java:158-173) ----------------------------
+
+    @staticmethod
+    def check_op_size(size: int, inputs: Sequence) -> None:
+        if len(inputs) != size:
+            raise ValueError(
+                f"The size of operators should be equal to {size}, got {len(inputs)}"
+            )
+
+    @staticmethod
+    def check_min_op_size(size: int, inputs: Sequence) -> None:
+        if len(inputs) < size:
+            raise ValueError(
+                f"The size of operators should be equal or greater than {size}, "
+                f"got {len(inputs)}"
+            )
+
+    # -- unification with the api-level AlgoOperator -------------------------
+
+    def transform(self, *inputs: Table):
+        """api.core.AlgoOperator.transform in terms of the DAG layer."""
+        linked = self.link_from_tables(*inputs)
+        return (linked.get_output(), *linked.get_side_outputs())
+
+    def link_from_tables(self, *inputs: Table) -> "AlgoOperator":
+        raise NotImplementedError

@@ -1,0 +1,52 @@
+"""Batch operators — bounded-table DAG nodes.
+
+Parity map:
+  BatchOperator.java:69-107 (link/linkFrom/fromTable) -> BatchOperator
+  TableSourceBatchOp.java:27-39                       -> TableSourceBatchOp
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flink_ml_tpu.operator.base import AlgoOperator
+from flink_ml_tpu.table.table import Table
+
+
+class BatchOperator(AlgoOperator):
+    """Operator over bounded tables with link/linkFrom chaining
+    (BatchOperator.java:69-107)."""
+
+    def link(self, next_op: "BatchOperator") -> "BatchOperator":
+        """``this.link(next)`` == ``next.link_from(this)`` (BatchOperator.java:69-72)."""
+        next_op.link_from(self)
+        return next_op
+
+    def link_from(self, *inputs: "BatchOperator") -> "BatchOperator":
+        """Compute this op's outputs from upstream ops (BatchOperator.java:97)."""
+        raise NotImplementedError
+
+    def link_from_tables(self, *inputs: Table) -> "BatchOperator":
+        return self.link_from(*[TableSourceBatchOp(t) for t in inputs])
+
+    @staticmethod
+    def from_table(table: Table) -> "BatchOperator":
+        """Wrap an existing table as a source op (BatchOperator.java:105-107)."""
+        return TableSourceBatchOp(table)
+
+    def collect(self) -> list:
+        """Materialize the primary output as rows (client-side sink)."""
+        return self.get_output().to_rows()
+
+
+class TableSourceBatchOp(BatchOperator):
+    """Leaf op wrapping an existing bounded table (TableSourceBatchOp.java:27-39)."""
+
+    def __init__(self, table: Table, params=None):
+        super().__init__(params)
+        if table is None:
+            raise ValueError("The table should not be null.")
+        self.set_output(table)
+
+    def link_from(self, *inputs: "BatchOperator") -> "BatchOperator":
+        raise RuntimeError("Table source operator should not have any upstream to link from.")

@@ -1,0 +1,65 @@
+"""Stream operators — unbounded-source DAG nodes.
+
+Parity map:
+  StreamOperator.java:70-108 (link/linkFrom/fromTable) -> StreamOperator
+  TableSourceStreamOp.java:27-39                       -> TableSourceStreamOp
+
+A stream operator's payload is an :class:`UnboundedSource` (timestamped row
+stream) rather than a bounded Table; chaining semantics are identical to the
+batch side.  Compute on streams goes through the
+:mod:`flink_ml_tpu.iteration.unbounded` driver, which is where windows fire
+and models update.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flink_ml_tpu.operator.base import AlgoOperator
+from flink_ml_tpu.table.sources import UnboundedSource
+from flink_ml_tpu.table.table import Table
+
+
+class StreamOperator(AlgoOperator):
+    """Operator over unbounded sources (StreamOperator.java:70-108)."""
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self._stream: Optional[UnboundedSource] = None
+
+    def get_stream(self) -> UnboundedSource:
+        if self._stream is None:
+            raise RuntimeError("operator has no output stream yet; call link_from first")
+        return self._stream
+
+    def set_stream(self, stream: UnboundedSource) -> None:
+        self._stream = stream
+
+    def get_schema(self):
+        if self._stream is not None:
+            return self._stream.schema()
+        return super().get_schema()
+
+    def link(self, next_op: "StreamOperator") -> "StreamOperator":
+        next_op.link_from(self)
+        return next_op
+
+    def link_from(self, *inputs: "StreamOperator") -> "StreamOperator":
+        raise NotImplementedError
+
+    @staticmethod
+    def from_source(source: UnboundedSource) -> "StreamOperator":
+        return TableSourceStreamOp(source)
+
+
+class TableSourceStreamOp(StreamOperator):
+    """Leaf op wrapping an existing unbounded source (TableSourceStreamOp.java:27-39)."""
+
+    def __init__(self, source: UnboundedSource, params=None):
+        super().__init__(params)
+        if source is None:
+            raise ValueError("The source should not be null.")
+        self.set_stream(source)
+
+    def link_from(self, *inputs: "StreamOperator") -> "StreamOperator":
+        raise RuntimeError("Table source operator should not have any upstream to link from.")

@@ -74,8 +74,11 @@ def make_data_parallel_step(
 
 class _BoundedDispatch:
     """Wraps an async-dispatching jitted fn, keeping at most ``max_inflight``
-    results outstanding (blocks on the oldest, not the whole pipeline — no
-    periodic drain bubble)."""
+    results outstanding (blocks on the oldest live output, not the whole
+    pipeline).  Caveat: when the step's aux output holds no arrays and state
+    is donated, every older entry's buffers are gone, so the sync falls back
+    to the newest output and drains the pipeline once per ``max_inflight``
+    calls — return a small aux array (e.g. the loss) to keep full overlap."""
 
     def __init__(self, fn: Callable, max_inflight: int):
         from collections import deque
@@ -88,7 +91,24 @@ class _BoundedDispatch:
         out = self._fn(*args, **kwargs)
         self._pending.append(out)
         if len(self._pending) >= self._max_inflight:
-            jax.block_until_ready(self._pending.popleft())
+            # With donate_state=True the state leaves of a pending output are
+            # deleted the moment the *next* call donates them, so they cannot
+            # be waited on.  Walk from the oldest entry to the first one with
+            # a live (non-donated) leaf — typically the aux part — and block
+            # on that; entries whose every buffer was donated are already
+            # consumed by a later dispatched computation and need no wait.
+            # The newest entry always has live leaves (nothing has donated
+            # them yet), so this terminates having synced the pipeline.
+            while self._pending:
+                oldest = self._pending.popleft()
+                live = [
+                    x
+                    for x in jax.tree_util.tree_leaves(oldest)
+                    if not (hasattr(x, "is_deleted") and x.is_deleted())
+                ]
+                if live:
+                    jax.block_until_ready(live)
+                    break
         return out
 
     @property

@@ -39,16 +39,27 @@ class OutputColsHelper:
         in_types = input_schema.field_types
         reserved = set(in_names if reserved_col_names is None else reserved_col_names)
 
+        # name collision is case-insensitive, matching Schema/Table lookup —
+        # an output col spelled 'Sum' overrides an input col 'sum' in place
+        # rather than silently shadowing behind it
+        out_lower = {}
+        for j, n in enumerate(self.output_col_names):
+            if n.lower() in out_lower:
+                raise ValueError(
+                    f"output col names collide case-insensitively: {n!r}"
+                )
+            out_lower[n.lower()] = j
+
         # walk input order assigning result slots (OutputColsHelper.java:118-135)
         result_names: List[str] = []
         result_types: List[str] = []
         self._reserved_input_cols: List[str] = []
-        out_pos = {}
+        placed = set()
         for i, name in enumerate(in_names):
-            if name in self.output_col_names:
-                out_pos[name] = len(result_names)
-                j = self.output_col_names.index(name)
-                result_names.append(name)
+            j = out_lower.get(name.lower())
+            if j is not None:
+                placed.add(j)
+                result_names.append(self.output_col_names[j])
                 result_types.append(self.output_col_types[j])
                 continue
             if name in reserved:
@@ -56,7 +67,7 @@ class OutputColsHelper:
                 result_names.append(name)
                 result_types.append(in_types[i])
         for j, name in enumerate(self.output_col_names):
-            if name not in out_pos:
+            if j not in placed:
                 result_names.append(name)
                 result_types.append(self.output_col_types[j])
         self._result_schema = Schema(result_names, result_types)

@@ -27,18 +27,28 @@ class DataTypes:
     _NUMERIC = {DOUBLE, FLOAT, INT, LONG}
     _VECTOR = {VECTOR, DENSE_VECTOR, SPARSE_VECTOR}
 
+    _ALL = _NUMERIC | _VECTOR | {BOOLEAN, STRING}
+
+    @classmethod
+    def normalize(cls, t: str) -> str:
+        """Canonical (upper-case) type name; unknown names fail loudly."""
+        canon = t.upper()
+        if canon not in cls._ALL:
+            raise ValueError(f"unknown data type {t!r}; one of {sorted(cls._ALL)}")
+        return canon
+
     @classmethod
     def is_numeric(cls, t: str) -> bool:
         """TableUtil.isSupportedNumericType analog (TableUtil.java:147-158)."""
-        return t in cls._NUMERIC
+        return t.upper() in cls._NUMERIC
 
     @classmethod
     def is_string(cls, t: str) -> bool:
-        return t == cls.STRING
+        return t.upper() == cls.STRING
 
     @classmethod
     def is_vector(cls, t: str) -> bool:
-        return t in cls._VECTOR
+        return t.upper() in cls._VECTOR
 
     @staticmethod
     def numpy_dtype(t: str):
@@ -48,7 +58,7 @@ class DataTypes:
             DataTypes.INT: np.int32,
             DataTypes.LONG: np.int64,
             DataTypes.BOOLEAN: np.bool_,
-        }.get(t, object)
+        }.get(DataTypes.normalize(t), object)
 
 
 class Schema:
@@ -60,7 +70,7 @@ class Schema:
         if len(names) != len(types):
             raise ValueError("names and types must align")
         self._names = list(names)
-        self._types = list(types)
+        self._types = [DataTypes.normalize(t) for t in types]
         self._lower_index: Dict[str, int] = {}
         for i, n in enumerate(self._names):
             low = n.lower()

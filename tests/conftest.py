@@ -19,7 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+jax.config.update(
+    "jax_enable_x64",
+    os.environ["JAX_ENABLE_X64"].lower() not in ("0", "false", "f", "no", "off"),
+)
 
 assert jax.device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.device_count()} on "

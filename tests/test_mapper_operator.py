@@ -1,0 +1,193 @@
+"""Tests for the mapper machinery (SURVEY §2.3.2) and operator DAG layer (§2.3.3).
+
+Mirrors the reference's mapper/adapter tests: mapper output schema merge,
+model loading at open time, link/linkFrom chaining, source-op behavior.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common import (
+    BroadcastModelSource,
+    Mapper,
+    MapperAdapter,
+    ModelMapper,
+    ModelMapperAdapter,
+    RowsModelSource,
+    TablesModelSource,
+)
+from flink_ml_tpu.operator import (
+    BatchOperator,
+    StreamOperator,
+    TableSourceBatchOp,
+    TableSourceStreamOp,
+)
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+
+
+def make_table():
+    schema = Schema.of(("f0", "double"), ("f1", "double"), ("label", "double"))
+    return Table.from_columns(
+        schema,
+        {"f0": [1.0, 2.0, 3.0], "f1": [10.0, 20.0, 30.0], "label": [0.0, 1.0, 0.0]},
+    )
+
+
+class SumMapper(Mapper):
+    """f0 + f1 -> 'sum' column; batched, row-aligned."""
+
+    def output_cols(self):
+        return ["sum"], ["double"]
+
+    def map_batch(self, batch):
+        return {"sum": np.asarray(batch.col("f0")) + np.asarray(batch.col("f1"))}
+
+
+class TestMapper:
+    def test_output_schema_appends_col(self):
+        t = make_table()
+        m = SumMapper(t.schema)
+        assert m.get_output_schema().field_names == ["f0", "f1", "label", "sum"]
+
+    def test_apply_values(self):
+        t = make_table()
+        out = SumMapper(t.schema).apply(t)
+        np.testing.assert_allclose(out.col("sum"), [11.0, 22.0, 33.0])
+        np.testing.assert_allclose(out.col("f0"), [1.0, 2.0, 3.0])
+
+    def test_apply_batched_matches_whole(self):
+        t = make_table()
+        whole = SumMapper(t.schema).apply(t)
+        batched = SumMapper(t.schema).apply(t, batch_size=2)
+        np.testing.assert_allclose(whole.col("sum"), batched.col("sum"))
+
+    def test_reserved_cols_override(self):
+        class Keep1(SumMapper):
+            def reserved_cols(self):
+                return ["label"]
+
+        t = make_table()
+        out = Keep1(t.schema).apply(t)
+        assert out.schema.field_names == ["label", "sum"]
+
+    def test_output_col_overrides_input_in_place(self):
+        class Overwrite(Mapper):
+            def output_cols(self):
+                return ["f1"], ["double"]
+
+            def map_batch(self, batch):
+                return {"f1": np.asarray(batch.col("f1")) * 2}
+
+        t = make_table()
+        out = Overwrite(t.schema).apply(t)
+        # f1 keeps its position, gets the new values (OutputColsHelper rules)
+        assert out.schema.field_names == ["f0", "f1", "label"]
+        np.testing.assert_allclose(out.col("f1"), [20.0, 40.0, 60.0])
+
+    def test_adapter(self):
+        t = make_table()
+        fn = MapperAdapter(SumMapper(t.schema), batch_size=2)
+        np.testing.assert_allclose(fn(t).col("sum"), [11.0, 22.0, 33.0])
+
+
+class ScaleModelMapper(ModelMapper):
+    """Model = one row holding a scale factor; output f0 * scale."""
+
+    def output_cols(self):
+        return ["scaled"], ["double"]
+
+    def load_model(self, *model_tables):
+        self.scale = float(model_tables[0].col("scale")[0])
+
+    def map_batch(self, batch):
+        return {"scaled": np.asarray(batch.col("f0")) * self.scale}
+
+
+class TestModelMapper:
+    def make_model_table(self):
+        return Table.from_columns(Schema.of(("scale", "double")), {"scale": [10.0]})
+
+    def test_model_mapper_adapter_opens_once(self):
+        t = make_table()
+        model = self.make_model_table()
+        mapper = ScaleModelMapper([model.schema], t.schema)
+        adapter = ModelMapperAdapter(mapper, TablesModelSource(model))
+        out = adapter(t)
+        np.testing.assert_allclose(out.col("scaled"), [10.0, 20.0, 30.0])
+
+    def test_rows_model_source(self):
+        src = RowsModelSource([(3.0,)], Schema.of(("scale", "double")))
+        (table,) = src.get_model_tables()
+        assert table.num_rows() == 1
+
+    def test_broadcast_model_source_packs_once(self):
+        import jax.numpy as jnp
+
+        model = self.make_model_table()
+        calls = []
+
+        def pack(t):
+            calls.append(1)
+            return jnp.asarray(t.col("scale"))
+
+        src = BroadcastModelSource((model,), pack=pack)
+        a = src.get_packed()
+        b = src.get_packed()
+        assert a is b and len(calls) == 1
+
+
+class PlusOneOp(BatchOperator):
+    def link_from(self, *inputs):
+        self.check_op_size(1, inputs)
+        t = inputs[0].get_output()
+        self.set_output(t.with_column("f0", "double", np.asarray(t.col("f0")) + 1))
+        return self
+
+
+class TestBatchOperator:
+    def test_link_chaining(self):
+        src = TableSourceBatchOp(make_table())
+        out = src.link(PlusOneOp()).link(PlusOneOp())
+        np.testing.assert_allclose(out.get_output().col("f0"), [3.0, 4.0, 5.0])
+
+    def test_from_table_and_collect(self):
+        op = BatchOperator.from_table(make_table())
+        assert len(op.collect()) == 3
+
+    def test_source_rejects_link_from(self):
+        src = TableSourceBatchOp(make_table())
+        with pytest.raises(RuntimeError):
+            src.link_from(TableSourceBatchOp(make_table()))
+
+    def test_source_rejects_null(self):
+        with pytest.raises(ValueError):
+            TableSourceBatchOp(None)
+
+    def test_check_op_size(self):
+        with pytest.raises(ValueError):
+            PlusOneOp().link_from(
+                TableSourceBatchOp(make_table()), TableSourceBatchOp(make_table())
+            )
+
+    def test_transform_unifies_with_api(self):
+        # operator usable through the api-level AlgoOperator.transform
+        (out,) = PlusOneOp().transform(make_table())
+        np.testing.assert_allclose(out.col("f0"), [2.0, 3.0, 4.0])
+
+    def test_output_before_link_raises(self):
+        with pytest.raises(RuntimeError):
+            PlusOneOp().get_output()
+
+
+class TestStreamOperator:
+    def test_source_stream(self):
+        from flink_ml_tpu.table.sources import GeneratorSource
+
+        schema = Schema.of(("x", "double"),)
+        src = GeneratorSource.linear_timestamps([(1.0,), (2.0,)], 10, schema)
+        op = TableSourceStreamOp(src)
+        assert op.get_stream() is src
+        assert op.get_schema().field_names == ["x"]
+        with pytest.raises(RuntimeError):
+            op.link_from(op)

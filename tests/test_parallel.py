@@ -106,3 +106,39 @@ def test_data_parallel_matches_single_device():
         ]
         w = w - 0.05 * np.mean(grads, axis=0)
     np.testing.assert_allclose(np.asarray(state), w, rtol=1e-6)
+
+
+class TestBoundedDispatchDonation:
+    def test_long_loop_with_donation_and_inflight(self):
+        """Regression: pending outputs whose state was donated by the next call
+        must not be waited on (BlockHostUntilReady on deleted buffer)."""
+        mesh = default_mesh()
+
+        def local_step(state, batch):
+            grad = pmean(batch.sum(), "data")
+            return state + grad, grad
+
+        step = make_data_parallel_step(
+            local_step, mesh, donate_state=True, max_inflight=4
+        )
+        state = jnp.zeros(())
+        batch = jnp.ones((8, 2))
+        for _ in range(12):
+            state, _ = step(state, batch)
+        assert float(state) == 12 * 2.0
+
+    def test_aux_free_output_still_bounded(self):
+        """All-donated pending entries are skipped, newest syncs the pipeline."""
+        mesh = default_mesh()
+
+        def local_step(state, batch):
+            return state + pmean(batch.sum(), "data"), ()
+
+        step = make_data_parallel_step(
+            local_step, mesh, donate_state=True, max_inflight=2
+        )
+        state = jnp.zeros(())
+        batch = jnp.ones((8, 2))
+        for _ in range(8):
+            state, _ = step(state, batch)
+        assert float(state) == 8 * 2.0

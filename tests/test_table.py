@@ -214,3 +214,17 @@ class TestSources:
         assert events == [(0, (1,)), (10, (2,)), (20, (3,))]
         # re-iterable
         assert len(list(src.stream())) == 3
+
+
+def test_output_cols_case_insensitive_override():
+    """Regression: output col differing only in case overrides the input col
+    in place instead of silently shadowing behind it."""
+    from flink_ml_tpu.table.output_cols import OutputColsHelper
+
+    schema = Schema.of(("f0", "double"), ("sum", "double"))
+    t = Table.from_columns(schema, {"f0": [1.0, 2.0], "sum": [5.0, 6.0]})
+    helper = OutputColsHelper(schema, ["Sum"], ["double"])
+    assert helper.get_result_schema().field_names == ["f0", "Sum"]
+    out = helper.get_result_table(t, {"Sum": np.asarray([100.0, 200.0])})
+    np.testing.assert_allclose(out.col("sum"), [100.0, 200.0])
+    np.testing.assert_allclose(out.col("Sum"), [100.0, 200.0])
