@@ -1,0 +1,62 @@
+"""Incremental learning example — the reference's streaming skeleton
+(examples-streaming/.../IncrementalLearningSkeleton.java:54-83) made concrete.
+
+Topology (SURVEY.md §3.4): an unbounded training stream is cut into 5000 ms
+event-time tumbling windows; each fired window updates the model; a concurrent
+prediction stream is served by the freshest model at each record's event time.
+Instead of the skeleton's dummy Double[] model, the model is a real online
+logistic regression.
+
+Run: python examples/incremental_learning.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from flink_ml_tpu.lib import OnlineLogisticRegression
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.sources import GeneratorSource
+
+TRAIN_SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+PREDICT_SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR),)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.randn(n, 2)
+    true_w = np.array([1.0, -2.0])
+    y = ((X @ true_w) > 0).astype(np.float64)
+
+    # one training record every 50 ms -> 100 records per 5000 ms window
+    train_rows = [(DenseVector(X[i]), y[i]) for i in range(n)]
+    train_src = GeneratorSource.linear_timestamps(train_rows, 50, TRAIN_SCHEMA)
+    predict_rows = [(DenseVector(X[i]),) for i in range(n)]
+    predict_src = GeneratorSource.linear_timestamps(predict_rows, 50, PREDICT_SCHEMA)
+
+    model, result = (
+        OnlineLogisticRegression()
+        .set_vector_col("features")
+        .set_label_col("label")
+        .set_prediction_col("pred")
+        .set_learning_rate(0.5)
+        .set_window_ms(5000)
+        .fit_unbounded(train_src, prediction_source=predict_src)
+    )
+
+    correct = sum(
+        1 for i, (_, p) in enumerate(result.predictions) if p == y[i]
+    )
+    print(f"windows fired: {result.windows_fired}")
+    print(f"streaming predictions: {len(result.predictions)}, "
+          f"accuracy {correct / len(result.predictions):.3f}")
+    print(f"final coefficients: {model.coefficients()}")
+
+
+if __name__ == "__main__":
+    main()

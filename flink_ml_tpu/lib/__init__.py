@@ -1,0 +1,17 @@
+from flink_ml_tpu.lib.classification import LogisticRegression, LogisticRegressionModel
+from flink_ml_tpu.lib.clustering import KMeans, KMeansModel
+from flink_ml_tpu.lib.knn import Knn, KnnModel
+from flink_ml_tpu.lib.online import OnlineLogisticRegression
+from flink_ml_tpu.lib.regression import LinearRegression, LinearRegressionModel
+
+__all__ = [
+    "LinearRegression",
+    "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "KMeans",
+    "KMeansModel",
+    "Knn",
+    "KnnModel",
+    "OnlineLogisticRegression",
+]

@@ -1,0 +1,84 @@
+"""LogisticRegression — binary log-loss GLM (BASELINE configs[0], the
+flagship workload of the north star: LogisticRegression.fit samples/sec/chip).
+
+Labels are {0, 1}. Training is the same data-parallel SGD harness as
+LinearRegression with the logistic gradient; prediction emits the argmax
+label into ``predictionCol`` and, optionally, the positive-class probability
+into ``predictionDetailCol``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.lib.glm import GlmEstimatorBase, GlmModelBase, LinearScoreMapper
+from flink_ml_tpu.table.schema import DataTypes, Schema
+
+
+class LogisticRegressionModel(GlmModelBase):
+    """Predicts the {0,1} label; optional probability detail column."""
+
+    def _make_mapper(self, data_schema: Schema):
+        model = self
+        detail = model.get_prediction_detail_col()
+
+        class _Mapper(LinearScoreMapper):
+            def output_cols(self):
+                names = [model.get_prediction_col()]
+                types = [DataTypes.DOUBLE]
+                if detail is not None:
+                    names.append(detail)
+                    types.append(DataTypes.DOUBLE)
+                return names, types
+
+            def map_batch(self, batch):
+                scores = self._scores(batch)
+                prob = 1.0 / (1.0 + np.exp(-scores))
+                out = {model.get_prediction_col(): (scores > 0).astype(np.float64)}
+                if detail is not None:
+                    out[detail] = prob.astype(np.float64)
+                return out
+
+        return _Mapper(self, data_schema)
+
+    def predict_proba(self, table) -> np.ndarray:
+        """Positive-class probabilities for a feature table (convenience)."""
+        mapper = self._make_mapper(table.schema)
+        mapper.load_model(*self.get_model_data())
+        scores = mapper._scores(table)
+        return 1.0 / (1.0 + np.exp(-scores))
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _log_loss_grads(with_intercept: bool):
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def grad_fn(params, x, y, w):
+        wts, b = params
+        logits = x @ wts + b
+        p = jax.nn.sigmoid(logits)
+        err = (p - y) * w
+        g_w = x.T @ err
+        g_b = jnp.sum(err) * keep_b
+        # numerically-stable weighted log-loss sum
+        loss = jnp.sum(
+            w * (jnp.logaddexp(0.0, logits) - y * logits)
+        )
+        return (g_w, g_b), loss, jnp.sum(w)
+
+    return grad_fn
+
+
+class LogisticRegression(GlmEstimatorBase):
+    """Estimator: binary log loss, minibatch SGD over the data-parallel mesh."""
+
+    def _grad_fn(self):
+        return _log_loss_grads(self.get_with_intercept())
+
+    def _make_model(self) -> LogisticRegressionModel:
+        return LogisticRegressionModel()

@@ -1,0 +1,233 @@
+"""KMeans — Lloyd iterations on the device mesh (BASELINE configs[1], k=100).
+
+The reference has no KMeans; this is the workload BASELINE.json names, built
+on the same bounded-iteration + in-step-psum pattern as the GLMs: centroids
+replicated, rows sharded over the ``data`` axis, one epoch = one device call
+computing assignments (argmin over an MXU-friendly x·cᵀ distance matrix) and
+the psum'd per-cluster sums/counts that yield the next centroids.
+
+Init is k-means++ on a host sample (seeded, reproducible); empty clusters
+keep their previous centroid.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.common.mapper import ModelMapper
+from flink_ml_tpu.iteration.bounded import (
+    IterationBodyResult,
+    ReplayableInputs,
+    iterate_bounded,
+)
+from flink_ml_tpu.iteration.config import IterationConfig
+from flink_ml_tpu.lib.common import apply_batched, resolve_features
+from flink_ml_tpu.lib.model_base import TableModelBase
+from flink_ml_tpu.lib.params import (
+    HasFeatureColsDefaultAsNull,
+    HasK,
+    HasMaxIter,
+    HasSeed,
+    HasTol,
+    HasVectorColDefaultAsNull,
+)
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.parallel.collectives import make_data_parallel_step, psum
+from flink_ml_tpu.parallel.mesh import replicate, shard_batch
+from flink_ml_tpu.params.shared import (
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasReservedCols,
+)
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+CENTROID_SCHEMA = Schema.of(
+    ("clusterId", DataTypes.LONG), ("centroid", DataTypes.DENSE_VECTOR)
+)
+
+
+class KMeansParams(
+    HasVectorColDefaultAsNull,
+    HasFeatureColsDefaultAsNull,
+    HasK,
+    HasReservedCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+):
+    """Shared column/k vocabulary for estimator and model."""
+
+
+def _pairwise_sq_dists(x, c):
+    """(n, k) squared distances; the x·cᵀ term is the MXU matmul."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)
+    return jnp.maximum(x2 - 2.0 * (x @ c.T) + c2, 0.0)
+
+
+# module-level so the jit cache survives across mapper instances
+@jax.jit
+def _assign_fn(x, c):
+    d = _pairwise_sq_dists(x, c)
+    return jnp.stack(
+        [jnp.argmin(d, axis=1).astype(jnp.float64),
+         jnp.min(d, axis=1).astype(jnp.float64)],
+        axis=1,
+    )
+
+
+def kmeans_plus_plus(X: np.ndarray, k: int, rng: np.random.RandomState) -> np.ndarray:
+    """Standard k-means++ seeding on the host (runs on a bounded sample)."""
+    n = X.shape[0]
+    first = rng.randint(n)
+    centers = [X[first]]
+    d2 = np.sum((X - X[first]) ** 2, axis=1)
+    for _ in range(1, k):
+        total = d2.sum()
+        if total <= 0:
+            centers.append(X[rng.randint(n)])
+            continue
+        probs = d2 / total
+        idx = rng.choice(n, p=probs)
+        centers.append(X[idx])
+        d2 = np.minimum(d2, np.sum((X - X[idx]) ** 2, axis=1))
+    return np.stack(centers)
+
+
+class KMeansModelMapper(ModelMapper):
+    """Batched nearest-centroid assignment."""
+
+    def __init__(self, model: "KMeansModel", data_schema: Schema):
+        self._model_stage = model
+        super().__init__([CENTROID_SCHEMA], data_schema, model.get_params())
+
+    def reserved_cols(self) -> Optional[list]:
+        return self._model_stage.get_reserved_cols()
+
+    def output_cols(self):
+        model = self._model_stage
+        names = [model.get_prediction_col()]
+        types = [DataTypes.LONG]
+        if model.get_prediction_detail_col() is not None:
+            names.append(model.get_prediction_detail_col())
+            types.append(DataTypes.DOUBLE)
+        return names, types
+
+    def load_model(self, *model_tables: Table) -> None:
+        (t,) = model_tables
+        order = np.argsort(np.asarray(t.col("clusterId"), dtype=np.int64))
+        cents = np.stack(
+            [t.col("centroid")[i].to_dense().values for i in order]
+        )
+        self._centroids = jnp.asarray(cents, dtype=jnp.float32)
+
+    def map_batch(self, batch: Table):
+        model = self._model_stage
+        X, _ = resolve_features(batch, model, dim=int(self._centroids.shape[1]))
+        X = X.astype(np.float32)
+        n = X.shape[0]
+        both = apply_batched(_assign_fn, X, self._centroids)
+        out = {model.get_prediction_col(): both[:n, 0].astype(np.int64)}
+        detail = model.get_prediction_detail_col()
+        if detail is not None:
+            out[detail] = np.sqrt(both[:n, 1])
+        return out
+
+
+class KMeansModel(TableModelBase, KMeansParams):
+    """Nearest-centroid assignment model; model data = the centroid table."""
+
+    REQUIRED_MODEL_COL = "centroid"
+
+    def centroids(self) -> np.ndarray:
+        (t,) = self.get_model_data()
+        order = np.argsort(np.asarray(t.col("clusterId"), dtype=np.int64))
+        return np.stack([t.col("centroid")[i].to_dense().values for i in order])
+
+    def _make_mapper(self, data_schema: Schema) -> KMeansModelMapper:
+        return KMeansModelMapper(self, data_schema)
+
+
+class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed):
+    """Estimator: k-means++ init + data-parallel Lloyd iterations."""
+
+    INIT_SAMPLE_CAP = 100_000  # k-means++ host sample bound
+
+    def fit(self, *inputs: Table) -> KMeansModel:
+        (table,) = inputs
+        X, dim = resolve_features(table, self)
+        k = self.get_k()
+        n = X.shape[0]
+        if n < k:
+            raise ValueError(f"k={k} exceeds number of rows {n}")
+        rng = np.random.RandomState(self.get_seed())
+
+        sample = X if n <= self.INIT_SAMPLE_CAP else X[
+            rng.choice(n, self.INIT_SAMPLE_CAP, replace=False)
+        ]
+        init = kmeans_plus_plus(sample.astype(np.float64), k, rng)
+
+        env = MLEnvironmentFactory.get_default()
+        mesh = env.get_mesh()
+        n_dev = int(np.prod(list(mesh.shape.values())))
+        n_pad = -(-n // n_dev) * n_dev
+        Xp = np.zeros((n_pad, dim), dtype=np.float32)
+        Xp[:n] = X
+        wp = np.zeros((n_pad,), dtype=np.float32)
+        wp[:n] = 1.0
+
+        def local_epoch(centroids, batch):
+            x, w = batch
+            d = _pairwise_sq_dists(x, centroids)
+            assign = jnp.argmin(d, axis=1)
+            cost_local = jnp.sum(jnp.min(d, axis=1) * w)
+            sums = jax.ops.segment_sum(x * w[:, None], assign, num_segments=k)
+            counts = jax.ops.segment_sum(w, assign, num_segments=k)
+            sums = psum(sums, "data")
+            counts = psum(counts, "data")
+            cost = psum(cost_local, "data")
+            new_c = jnp.where(
+                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centroids
+            )
+            delta = jnp.sqrt(jnp.sum((new_c - centroids) ** 2))
+            return new_c, (cost, delta)
+
+        epoch_step = make_data_parallel_step(local_epoch, mesh)
+        batch = shard_batch(mesh, (Xp, wp))
+        c0 = replicate(mesh, jnp.asarray(init, dtype=jnp.float32))
+        tol = self.get_tol()
+
+        def body(centroids, inputs_, epoch):
+            new_c, (cost, delta) = epoch_step(centroids, inputs_["batch"])
+            criteria = None
+            if tol > 0.0:
+                criteria = [1] if float(delta) > tol else []
+            return IterationBodyResult(
+                feedback=new_c,
+                outputs={"cost": cost},
+                termination_criteria=criteria,
+            )
+
+        result = iterate_bounded(
+            c0,
+            ReplayableInputs.replay(batch=batch),
+            body,
+            IterationConfig(max_epochs=self.get_max_iter()),
+        )
+        centroids = np.asarray(result.final_variables, dtype=np.float64)
+
+        model_table = Table.from_rows(
+            [(int(i), DenseVector(centroids[i])) for i in range(k)], CENTROID_SCHEMA
+        )
+        model = KMeansModel()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(model_table)
+        model.train_epochs_ = result.epochs_run
+        model.train_cost_ = float(result.last_output("cost"))
+        return model

@@ -1,0 +1,409 @@
+"""Shared training/inference harness for the algorithm library.
+
+This is where the reference's training topology (SURVEY.md §3.3: per-record
+gradient map -> network-shuffle reduce -> average -> rebroadcast, repeated
+per round) becomes one compiled TPU program per epoch:
+
+  * rows are packed ONCE into device-major minibatch stacks (static shapes,
+    padded with zero-weight rows so padding never biases gradients);
+  * one epoch = one ``make_data_parallel_step`` call: each mesh slice scans
+    its local minibatches with ``lax.scan``, gradients are ``psum``'d over
+    the ``data`` axis inside the step (the allreduce rides ICI), parameters
+    stay replicated — the whole round trip that Flink does through its
+    network stack never leaves the chip;
+  * epochs surface through the bounded iteration runtime, so listeners and
+    termination (max epochs / tol on update norm — the device-friendly analog
+    of the empty-termination-criteria-stream rule) keep reference semantics.
+
+Inference: model packed to device arrays once (the broadcast-variable analog),
+rows applied in padded power-of-two buckets to bound jit recompiles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.iteration.bounded import (
+    IterationBodyResult,
+    ReplayableInputs,
+    iterate_bounded,
+)
+from flink_ml_tpu.iteration.config import IterationConfig
+from flink_ml_tpu.parallel.collectives import make_data_parallel_step, psum
+from flink_ml_tpu.table.table import Table
+
+
+def resolve_features(
+    table: Table, stage, dim: Optional[int] = None
+) -> Tuple[np.ndarray, int]:
+    """Feature matrix from either ``vectorCol`` or ``featureCols`` params.
+
+    The column-selection convention of the shared param vocabulary
+    (SURVEY.md §2.3.5): an algorithm reads its features from one vector
+    column or a list of numeric columns.  ``dim`` pins the vector width at
+    inference time (the trained model's dimension).
+    """
+    vector_col = stage.get_vector_col()
+    feature_cols = stage.get_feature_cols()
+    if (vector_col is None) == (feature_cols is None):
+        raise ValueError("set exactly one of vectorCol / featureCols")
+    if vector_col is not None:
+        X = table.features_dense(vector_col, dim=dim)
+    else:
+        X = table.numeric_matrix(feature_cols)
+    return X, X.shape[1]
+
+
+@dataclass
+class MinibatchStack:
+    """Device-major stacked minibatches with a padding mask.
+
+    ``x``/``y``/``w`` have leading dims ``(n_dev * steps, mb)`` — dim 0 is
+    sharded over the ``data`` mesh axis, so each device scans ``steps`` local
+    minibatches of ``mb`` rows.  ``w`` is 1.0 for real rows, 0.0 for padding.
+    """
+
+    x: np.ndarray  # (n_dev*steps, mb, d)
+    y: np.ndarray  # (n_dev*steps, mb)
+    w: np.ndarray  # (n_dev*steps, mb)
+    steps: int
+    mb: int
+
+
+def pack_minibatches(
+    X: np.ndarray,
+    y: np.ndarray,
+    n_dev: int,
+    global_batch_size: int = 0,
+    dtype=np.float32,
+) -> MinibatchStack:
+    """Pack rows into the device-major minibatch layout.
+
+    ``global_batch_size`` rows are consumed per SGD step across the whole
+    mesh (0 = full batch).  Rows are padded to fill the last minibatch; pad
+    rows carry weight 0 so sums/counts are exact.
+    """
+    n, d = X.shape
+    if global_batch_size <= 0:
+        global_batch_size = max(n, n_dev)
+    mb = max(1, -(-global_batch_size // n_dev))  # per-device minibatch rows
+    steps = max(1, -(-n // (mb * n_dev)))
+    n_pad = steps * mb * n_dev
+
+    Xp = np.zeros((n_pad, d), dtype=dtype)
+    yp = np.zeros((n_pad,), dtype=dtype)
+    wp = np.zeros((n_pad,), dtype=dtype)
+    Xp[:n] = X
+    yp[:n] = y
+    wp[:n] = 1.0
+
+    # device-major: device k owns rows [k*steps*mb, (k+1)*steps*mb), scanned
+    # as `steps` minibatches — row order within a device is preserved
+    Xp = Xp.reshape(n_dev, steps, mb, d).reshape(n_dev * steps, mb, d)
+    yp = yp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
+    wp = wp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
+    return MinibatchStack(x=Xp, y=yp, w=wp, steps=steps, mb=mb)
+
+
+# A gradient function: (params, x_mb, y_mb, w_mb) ->
+#   (grads pytree matching params, weighted loss sum, weight sum)
+GradFn = Callable
+
+
+# Compiled epoch steps are reused across fit() calls: rebuilding the jitted
+# shard_map per fit would force a fresh XLA compile every time (~1s), which
+# dominates short training runs.  Keyed on (grad_fn, mesh, lr, reg) — grad-fn
+# factories are memoized by their hyper-flags so equal configs hit the cache.
+_EPOCH_STEP_CACHE: dict = {}
+
+
+def make_glm_epoch_step(
+    grad_fn: GradFn,
+    mesh,
+    learning_rate: float,
+    reg: float = 0.0,
+):
+    """One epoch (all local minibatches, SGD updates with in-step psum) as a
+    single data-parallel device call.
+
+    Returns a callable ``epoch_step(params, batch) -> (params, (loss, delta))``
+    where ``batch`` is the sharded MinibatchStack pytree ``(x, y, w)``,
+    ``loss`` is the epoch's mean training loss and ``delta`` the L2 norm of
+    the epoch's total parameter update (the convergence criterion).
+    """
+    key = (grad_fn, mesh, float(learning_rate), float(reg))
+    cached = _EPOCH_STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    lr = float(learning_rate)
+    l2 = float(reg)
+
+    def local_epoch(params, batch):
+        x, y, w = batch  # local: (steps, mb, d), (steps, mb), (steps, mb)
+
+        def mb_step(p, xs):
+            xb, yb, wb = xs
+            grads, loss_sum, w_sum = grad_fn(p, xb, yb, wb)
+            grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
+            loss_sum = psum(loss_sum, "data")
+            w_sum = psum(w_sum, "data")
+            count = jnp.maximum(w_sum, 1.0)
+            new_p = jax.tree_util.tree_map(
+                lambda pi, gi: pi - lr * (gi / count + l2 * pi), p, grads
+            )
+            return new_p, (loss_sum / count, w_sum)
+
+        start = params
+        params, (losses, counts) = jax.lax.scan(mb_step, params, (x, y, w))
+        # weighted mean loss over the epoch; update norm for convergence
+        total = jnp.maximum(jnp.sum(counts), 1.0)
+        loss = jnp.sum(losses * counts) / total
+        delta = jnp.sqrt(
+            sum(
+                jnp.sum((a - b) ** 2)
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(start),
+                )
+            )
+        )
+        return params, (loss, delta)
+
+    step = make_data_parallel_step(local_epoch, mesh)
+    _EPOCH_STEP_CACHE[key] = step
+    return step
+
+
+@dataclass
+class TrainResult:
+    params: tuple
+    epochs: int
+    losses: list
+
+
+def _combined_view(stack: MinibatchStack) -> np.ndarray:
+    """x, y, w packed into one (n_dev*steps, mb, d+2) array — a single
+    host->device transfer instead of three (transfer latency dominates on
+    tunneled devices)."""
+    return np.concatenate(
+        [stack.x, stack.y[..., None], stack.w[..., None]], axis=2
+    )
+
+
+def make_glm_train_fn(
+    grad_fn: GradFn,
+    mesh,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+):
+    """The WHOLE training run as one compiled device program.
+
+    Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
+    convergence test (update norm vs tol — the criteria-stream-empty analog)
+    evaluates on device, so training runs start-to-finish with zero host
+    round-trips: one transfer in (the packed batch), one out (params +
+    per-epoch losses + epochs-run).  This is the fast path ``train_glm``
+    takes when no per-epoch listeners are registered; the epoch watermark
+    degenerates to the loop-carried epoch counter.
+    """
+    key = ("train", grad_fn, mesh, float(learning_rate), float(reg),
+           int(max_iter), float(tol))
+    cached = _EPOCH_STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    lr = float(learning_rate)
+    l2 = float(reg)
+    tol_ = float(tol)
+
+    def local_train(params, combined):
+        x = combined[..., :-2]
+        y = combined[..., -2]
+        w = combined[..., -1]
+
+        def mb_step(p, xs):
+            xb, yb, wb = xs
+            grads, loss_sum, w_sum = grad_fn(p, xb, yb, wb)
+            grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
+            loss_sum = psum(loss_sum, "data")
+            w_sum = psum(w_sum, "data")
+            count = jnp.maximum(w_sum, 1.0)
+            new_p = jax.tree_util.tree_map(
+                lambda pi, gi: pi - lr * (gi / count + l2 * pi), p, grads
+            )
+            return new_p, (loss_sum / count, w_sum)
+
+        def run_epoch(params):
+            start = params
+            params, (losses, counts) = jax.lax.scan(mb_step, params, (x, y, w))
+            total = jnp.maximum(jnp.sum(counts), 1.0)
+            loss = jnp.sum(losses * counts) / total
+            delta = jnp.sqrt(
+                sum(
+                    jnp.sum((a - b) ** 2)
+                    for a, b in zip(
+                        jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(start),
+                    )
+                )
+            )
+            return params, loss, delta
+
+        def cond(carry):
+            _, epoch, delta, _ = carry
+            not_done = epoch < max_iter
+            if tol_ > 0.0:
+                not_done = jnp.logical_and(
+                    not_done, jnp.logical_or(epoch == 0, delta > tol_)
+                )
+            return not_done
+
+        def body(carry):
+            params, epoch, _, loss_hist = carry
+            params, loss, delta = run_epoch(params)
+            loss_hist = loss_hist.at[epoch].set(loss)
+            return params, epoch + 1, delta, loss_hist
+
+        loss_hist0 = jnp.zeros((max_iter,), dtype=jnp.float32)
+        params, epochs, _, loss_hist = jax.lax.while_loop(
+            cond, body, (params, jnp.asarray(0), jnp.asarray(jnp.inf), loss_hist0)
+        )
+        return params, loss_hist, epochs
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.shard_map(
+        local_train,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P(), P()),
+        check_vma=True,
+    )
+    fn = jax.jit(sharded, donate_argnums=(0,))
+    _EPOCH_STEP_CACHE[key] = fn
+    return fn
+
+
+def fetch_flat(*arrays):
+    """Fetch device arrays in ONE transfer (concatenated flat), then split.
+
+    Per-array device->host reads each pay a full round-trip on tunneled
+    backends; bundling them makes the readback latency constant.
+    """
+    shapes = [a.shape for a in arrays]
+    sizes = [int(np.prod(s)) for s in shapes]
+    flat = jnp.concatenate(
+        [jnp.ravel(a).astype(jnp.float64) for a in arrays]
+    )
+    buf = np.asarray(flat)
+    out = []
+    off = 0
+    for shape, size in zip(shapes, sizes):
+        out.append(buf[off : off + size].reshape(shape))
+        off += size
+    return out
+
+
+def train_glm(
+    init_params,
+    stack: MinibatchStack,
+    grad_fn: GradFn,
+    mesh,
+    learning_rate: float,
+    max_iter: int,
+    reg: float = 0.0,
+    tol: float = 0.0,
+    listeners: Sequence = (),
+) -> TrainResult:
+    """Drive GLM training to termination.
+
+    Termination mirrors the reference's two bounded modes: a max epoch count,
+    and — when ``tol`` > 0 — an empty-criteria round, realized as "parameter
+    update norm below tol" (SURVEY.md §3.5, IterationBodyResult.java:44-48).
+
+    Without listeners the entire run is ONE device program (fused epoch
+    while_loop, single transfer each way).  With listeners, epochs go through
+    the bounded iteration runtime so per-epoch watermark callbacks fire.
+    """
+    from flink_ml_tpu.parallel.mesh import replicate, shard_batch
+
+    if not listeners:
+        train_fn = make_glm_train_fn(
+            grad_fn, mesh, learning_rate, reg, max_iter, tol
+        )
+        combined = shard_batch(mesh, _combined_view(stack))
+        params, loss_hist, epochs = train_fn(replicate(mesh, init_params), combined)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        fetched = fetch_flat(*leaves, loss_hist, jnp.asarray(epochs, jnp.float64))
+        n_epochs = int(fetched[-1])
+        host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
+        return TrainResult(
+            params=host_params,
+            epochs=n_epochs,
+            losses=[float(x) for x in fetched[-2][:n_epochs]],
+        )
+
+    epoch_step = make_glm_epoch_step(grad_fn, mesh, learning_rate, reg)
+    batch = shard_batch(mesh, (stack.x, stack.y, stack.w))
+    params0 = replicate(mesh, init_params)
+    losses: list = []
+
+    def body(params, inputs, epoch):
+        new_params, (loss, delta) = epoch_step(params, inputs["batch"])
+        criteria = None
+        if tol > 0.0:
+            # convergence needs the value on host: one readback per epoch —
+            # the device-friendly "criteria stream empty" check
+            criteria = [1] if float(delta) > tol else []
+        # keep the loss as a device value: converting here would sync every
+        # epoch and collapse the async dispatch pipeline
+        losses.append(loss)
+        return IterationBodyResult(
+            feedback=new_params,
+            outputs={"loss": loss},
+            termination_criteria=criteria,
+        )
+
+    result = iterate_bounded(
+        params0,
+        ReplayableInputs.replay(batch=batch),
+        body,
+        IterationConfig(max_epochs=max_iter),
+        listeners=listeners,
+    )
+    final = jax.tree_util.tree_map(np.asarray, result.final_variables)
+    return TrainResult(
+        params=final, epochs=result.epochs_run, losses=[float(x) for x in losses]
+    )
+
+
+def bucket_rows(n: int, minimum: int = 256) -> int:
+    """Next power-of-two row count >= n (bounds the jit cache for inference)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def apply_batched(fn, X: np.ndarray, *args, bucket_minimum: int = 256) -> np.ndarray:
+    """Run a jitted row function over X padded to a power-of-two bucket.
+
+    ``fn(x_padded, *args)`` must be row-aligned; the result is sliced back to
+    the true row count.  Padding rows are zeros.  A 0-row input still runs one
+    padded bucket so the output keeps fn's true rank (sliced to 0 rows).
+    """
+    n = X.shape[0]
+    b = bucket_rows(max(n, 1), bucket_minimum)
+    if b != n:
+        Xp = np.zeros((b,) + X.shape[1:], dtype=X.dtype)
+        Xp[:n] = X
+    else:
+        Xp = X
+    out = fn(jnp.asarray(Xp), *args)
+    return np.asarray(out)[:n]

@@ -1,0 +1,182 @@
+"""Generalized-linear-model Estimator/Model base.
+
+The reference ships the *infrastructure* for such estimators but no concrete
+implementation (SURVEY.md §0.3); its only trainer is the hand-rolled BGD
+LinearRegression example (examples-batch/.../LinearRegression.java:108-121).
+This module is that training topology productized: Estimator.fit packs rows
+once, runs the data-parallel SGD epochs (in-step psum allreduce — the
+UpdateAccumulator/Update reduce-average pair fused on device), and returns a
+Model whose transform is a batched mapper apply.
+
+Model data follows the reference convention — rows of a table
+(Model.getModelData, Model.java:48): one row holding the coefficient vector
+and the intercept, persisted via the columnar table codec.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.common.mapper import ModelMapper
+from flink_ml_tpu.lib.common import (
+    apply_batched,
+    pack_minibatches,
+    resolve_features,
+    train_glm,
+)
+from flink_ml_tpu.lib.model_base import TableModelBase
+from flink_ml_tpu.lib.params import (
+    HasFeatureColsDefaultAsNull,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasReg,
+    HasSeed,
+    HasTol,
+    HasVectorColDefaultAsNull,
+    HasWithIntercept,
+)
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.params.shared import (
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasReservedCols,
+)
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+MODEL_SCHEMA = Schema.of(
+    ("coefficients", DataTypes.DENSE_VECTOR), ("intercept", DataTypes.DOUBLE)
+)
+
+
+class GlmFeatureParams(
+    HasVectorColDefaultAsNull,
+    HasFeatureColsDefaultAsNull,
+    HasReservedCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+):
+    """Input/output column vocabulary shared by GLM estimators and models."""
+
+
+class GlmTrainParams(
+    GlmFeatureParams,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasGlobalBatchSize,
+    HasTol,
+    HasReg,
+    HasWithIntercept,
+    HasSeed,
+):
+    """Training vocabulary for GLM estimators."""
+
+
+class GlmModelBase(TableModelBase, GlmFeatureParams):
+    """Model over (coefficients, intercept) model-data tables
+    (model-as-table contract implemented by TableModelBase)."""
+
+    REQUIRED_MODEL_COL = "coefficients"
+
+    # convenience for algorithm code
+    def coefficients(self) -> np.ndarray:
+        (t,) = self.get_model_data()
+        return np.asarray(t.col("coefficients")[0].to_dense().values)
+
+    def intercept(self) -> float:
+        (t,) = self.get_model_data()
+        return float(t.col("intercept")[0])
+
+
+def make_model_table(weights: np.ndarray, intercept: float) -> Table:
+    return Table.from_rows(
+        [(DenseVector(np.asarray(weights, dtype=np.float64)), float(intercept))],
+        MODEL_SCHEMA,
+    )
+
+
+# module-level so the jit cache is shared across mapper instances — a fresh
+# jit() per load_model would recompile on every transform call
+@jax.jit
+def _score_fn(x, w, b):
+    return x @ w + b
+
+
+class LinearScoreMapper(ModelMapper):
+    """Batched x·w + b scorer; subclasses shape the output columns.
+
+    The replacement for the reference's per-record ModelMapper hot loop
+    (ModelMapperAdapter.java:58-61): one jitted matvec per row bucket.
+    """
+
+    def __init__(self, model: GlmModelBase, data_schema: Schema):
+        self._model_stage = model
+        super().__init__([MODEL_SCHEMA], data_schema, model.get_params())
+
+    def reserved_cols(self) -> Optional[list]:
+        return self._model_stage.get_reserved_cols()
+
+    def load_model(self, *model_tables: Table) -> None:
+        (t,) = model_tables
+        w = np.asarray(t.col("coefficients")[0].to_dense().values)
+        self._w = jnp.asarray(w, dtype=jnp.float32)
+        self._b = jnp.asarray(float(t.col("intercept")[0]), dtype=jnp.float32)
+
+    def _scores(self, batch: Table) -> np.ndarray:
+        model = self._model_stage
+        X, _ = resolve_features(batch, model, dim=int(self._w.shape[0]))
+        return apply_batched(_score_fn, X.astype(np.float32), self._w, self._b)
+
+
+class GlmEstimatorBase(Estimator, GlmTrainParams):
+    """Shared fit: rows -> minibatch stack -> data-parallel SGD epochs."""
+
+    def _grad_fn(self):
+        """(params, x, y, w) -> (grads, weighted loss sum, weight sum)."""
+        raise NotImplementedError
+
+    def _make_model(self) -> GlmModelBase:
+        raise NotImplementedError
+
+    def _labels(self, table: Table) -> np.ndarray:
+        return np.asarray(table.col(self.get_label_col()), dtype=np.float64)
+
+    def fit(self, *inputs: Table) -> GlmModelBase:
+        (table,) = inputs
+        X, dim = resolve_features(table, self)
+        y = self._labels(table)
+        env = MLEnvironmentFactory.get_default()
+        mesh = env.get_mesh()
+        n_dev = int(np.prod(list(mesh.shape.values())))
+        stack = pack_minibatches(X, y, n_dev, self.get_global_batch_size())
+
+        w0 = jnp.zeros((dim,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        result = train_glm(
+            (w0, b0),
+            stack,
+            self._grad_fn(),
+            mesh,
+            learning_rate=self.get_learning_rate(),
+            max_iter=self.get_max_iter(),
+            reg=self.get_reg(),
+            tol=self.get_tol(),
+        )
+        w, b = result.params
+        if not self.get_with_intercept():
+            b = 0.0
+        model = self._make_model()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(make_model_table(w, float(b)))
+        model.train_epochs_ = result.epochs
+        model.train_losses_ = result.losses
+        return model

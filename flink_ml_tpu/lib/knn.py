@@ -1,0 +1,188 @@
+"""Knn — brute-force k-nearest-neighbors classification (BASELINE configs[3]).
+
+Model data is the training set itself (vectors + labels), following the
+model-as-table convention.  ``transform`` is the benchmark workload: each
+query batch computes one (batch, train) distance matrix — the x·cᵀ term is a
+single MXU matmul — then ``lax.top_k`` + a one-hot vote picks the label.
+Per-record distance loops (the reference's Mapper shape) never appear.
+
+Large training sets are chunked on device to bound the distance-matrix
+footprint; the running top-k is merged across chunks, so memory is
+O(batch × chunk) instead of O(batch × train).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.common.mapper import ModelMapper
+from flink_ml_tpu.lib.common import apply_batched, resolve_features
+from flink_ml_tpu.lib.model_base import TableModelBase
+from flink_ml_tpu.lib.params import (
+    HasFeatureColsDefaultAsNull,
+    HasK,
+    HasLabelCol,
+    HasVectorColDefaultAsNull,
+)
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.params.shared import (
+    HasPredictionCol,
+    HasPredictionDetailCol,
+    HasReservedCols,
+)
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+KNN_MODEL_SCHEMA = Schema.of(
+    ("features", DataTypes.DENSE_VECTOR), ("label", DataTypes.DOUBLE)
+)
+
+
+class KnnParams(
+    HasVectorColDefaultAsNull,
+    HasFeatureColsDefaultAsNull,
+    HasK,
+    HasReservedCols,
+    HasPredictionCol,
+    HasPredictionDetailCol,
+):
+    """Shared vocabulary for the Knn estimator and model."""
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _knn_chunked(xq, xt, yt, k, chunk):
+    """Top-k labels for query batch xq against chunked training data.
+
+    Returns (labels (n, k), dists (n, k)).  xt/yt are padded to a multiple of
+    ``chunk``; padded rows carry +inf distance so they never enter the top-k.
+    """
+    n = xq.shape[0]
+    n_chunks = xt.shape[0] // chunk
+    xq2 = jnp.sum(xq * xq, axis=1, keepdims=True)
+    is_real = jnp.isfinite(yt)
+
+    def scan_chunk(carry, idx):
+        best_d, best_y = carry
+        xc = jax.lax.dynamic_slice_in_dim(xt, idx * chunk, chunk)
+        yc = jax.lax.dynamic_slice_in_dim(yt, idx * chunk, chunk)
+        valid = jax.lax.dynamic_slice_in_dim(is_real, idx * chunk, chunk)
+        d = xq2 - 2.0 * (xq @ xc.T) + jnp.sum(xc * xc, axis=1)
+        d = jnp.where(valid, d, jnp.inf)
+        # merge running best with this chunk, re-select top-k
+        cat_d = jnp.concatenate([best_d, d], axis=1)
+        cat_y = jnp.concatenate([best_y, jnp.broadcast_to(yc, (n, chunk))], axis=1)
+        neg_top, pos = jax.lax.top_k(-cat_d, k)
+        return (-neg_top, jnp.take_along_axis(cat_y, pos, axis=1)), None
+
+    init = (
+        jnp.full((n, k), jnp.inf, dtype=xq.dtype),
+        jnp.zeros((n, k), dtype=yt.dtype),
+    )
+    (best_d, best_y), _ = jax.lax.scan(scan_chunk, init, jnp.arange(n_chunks))
+    return best_y, best_d
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _majority_vote(labels, dists, n_classes):
+    """Mode of each row of integer class ids via one-hot sum (ties -> lowest id).
+
+    Slots that never matched a real training row (distance inf — possible when
+    k exceeds the training-set size) carry no vote: one_hot of an out-of-range
+    id contributes all-zeros.
+    """
+    labels = jnp.where(jnp.isfinite(dists), labels, n_classes)
+    one_hot = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    votes = jnp.sum(one_hot, axis=1)
+    return jnp.argmax(votes, axis=1)
+
+
+class KnnModelMapper(ModelMapper):
+    def __init__(self, model: "KnnModel", data_schema: Schema):
+        self._model_stage = model
+        super().__init__([KNN_MODEL_SCHEMA], data_schema, model.get_params())
+
+    def reserved_cols(self) -> Optional[list]:
+        return self._model_stage.get_reserved_cols()
+
+    def output_cols(self):
+        model = self._model_stage
+        names = [model.get_prediction_col()]
+        types = [DataTypes.DOUBLE]
+        if model.get_prediction_detail_col() is not None:
+            names.append(model.get_prediction_detail_col())
+            types.append(DataTypes.DOUBLE)
+        return names, types
+
+    def load_model(self, *model_tables: Table) -> None:
+        (t,) = model_tables
+        X = np.stack([v.to_dense().values for v in t.col("features")])
+        y = np.asarray(t.col("label"), dtype=np.float64)
+        k = self._model_stage.get_k()
+        if k > len(y):
+            raise ValueError(f"k={k} exceeds training-set size {len(y)}")
+        # class-id encoding for the vote
+        self._classes = np.unique(y)
+        y_ids = np.searchsorted(self._classes, y)
+
+        chunk = min(8192, max(256, 1 << int(np.ceil(np.log2(max(X.shape[0], 1))))))
+        n_pad = -(-X.shape[0] // chunk) * chunk
+        Xp = np.zeros((n_pad, X.shape[1]), dtype=np.float32)
+        Xp[: X.shape[0]] = X
+        yp = np.full((n_pad,), np.inf)  # inf marks padding (never wins top-k)
+        yp[: y.shape[0]] = y_ids
+        self._xt = jnp.asarray(Xp)
+        self._yt = jnp.asarray(yp)
+        self._chunk = chunk
+
+    def map_batch(self, batch: Table):
+        model = self._model_stage
+        k = model.get_k()
+        X, _ = resolve_features(batch, model, dim=int(self._xt.shape[1]))
+        X = X.astype(np.float32)
+        n = X.shape[0]
+
+        def fn(xp):
+            labels, dists = _knn_chunked(xp, self._xt, self._yt, k, self._chunk)
+            pred = _majority_vote(
+                labels.astype(jnp.int32), dists, len(self._classes)
+            )
+            return jnp.concatenate(
+                [pred[:, None].astype(jnp.float64), dists.astype(jnp.float64)], axis=1
+            )
+
+        out = apply_batched(fn, X)
+        pred_ids = out[:n, 0].astype(np.int64)
+        result = {model.get_prediction_col(): self._classes[pred_ids]}
+        detail = model.get_prediction_detail_col()
+        if detail is not None:
+            result[detail] = np.sqrt(np.maximum(out[:n, 1], 0.0))  # nearest distance
+        return result
+
+
+class KnnModel(TableModelBase, KnnParams):
+    """Brute-force kNN classifier; model data = the training table."""
+
+    REQUIRED_MODEL_COL = "features"
+
+    def _make_mapper(self, data_schema: Schema) -> KnnModelMapper:
+        return KnnModelMapper(self, data_schema)
+
+
+class Knn(Estimator, KnnParams, HasLabelCol):
+    """Estimator: fit = pack the training table into the model-data layout."""
+
+    def fit(self, *inputs: Table) -> KnnModel:
+        (table,) = inputs
+        X, dim = resolve_features(table, self)
+        y = np.asarray(table.col(self.get_label_col()), dtype=np.float64)
+        rows = [(DenseVector(X[i].astype(np.float64)), float(y[i])) for i in range(len(y))]
+        model = KnnModel()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(Table.from_rows(rows, KNN_MODEL_SCHEMA))
+        return model

@@ -1,0 +1,67 @@
+"""Shared model-data plumbing for table-backed models.
+
+Every algorithm Model in this library follows the reference's model-as-table
+convention (Model.java:102-122, SURVEY.md §2.3.2): model data is rows of a
+table, persisted through the columnar codec, materialized into a device
+mapper at transform time.  This base implements that contract once; concrete
+models supply the validation predicate and the mapper.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from flink_ml_tpu.api.core import Model
+from flink_ml_tpu.common.mapper import ModelMapper
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import persistence
+from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+MODEL_DATA_FILE = "model_data.jsonl"
+
+
+class TableModelBase(Model):
+    """Model whose data is one table (set/get/save/load implemented)."""
+
+    # class-level default: Stage.load reconstructs instances bypassing __init__
+    _model_table: Optional[Table] = None
+
+    #: name of a column the model table must contain (None skips the check)
+    REQUIRED_MODEL_COL: Optional[str] = None
+
+    def __init__(self):
+        super().__init__()
+        self._model_table = None
+
+    def set_model_data(self, *inputs: Table) -> "TableModelBase":
+        (table,) = inputs
+        required = self.REQUIRED_MODEL_COL
+        if required is not None and not table.schema.contains(required):
+            raise ValueError(f"model table must have a {required!r} column")
+        self._model_table = table
+        return self
+
+    def get_model_data(self) -> Tuple[Table, ...]:
+        if self._model_table is None:
+            raise RuntimeError("model data not set")
+        return (self._model_table,)
+
+    def save_model_data(self, path: str) -> None:
+        persistence.save_table(self._model_table, os.path.join(path, MODEL_DATA_FILE))
+
+    def load_model_data(self, path: str) -> None:
+        self._model_table = persistence.load_table(os.path.join(path, MODEL_DATA_FILE))
+
+    # -- transform -----------------------------------------------------------
+
+    def _make_mapper(self, data_schema: Schema) -> ModelMapper:
+        raise NotImplementedError
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        mapper = self._make_mapper(table.schema)
+        mapper.load_model(*self.get_model_data())
+        batch = MLEnvironmentFactory.get_default().default_batch_size
+        return (mapper.apply(table, batch_size=batch),)

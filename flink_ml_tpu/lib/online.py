@@ -1,0 +1,145 @@
+"""Online LogisticRegression — unbounded streaming mini-batch training
+(BASELINE configs[4]).
+
+The reference defines this topology but never implements it: the unbounded
+iteration entry point returns null (Iterations.java:87-90) and the
+IncrementalLearningSkeleton example (SURVEY.md §3.4) shows the intended shape —
+training stream -> event-time tumbling window -> model update per window;
+prediction stream connected to the freshest model.  Here that shape runs on
+the :class:`flink_ml_tpu.iteration.unbounded.StreamingDriver`: each fired
+window is one jitted SGD step on a padded row bucket (static shapes keep the
+jit cache bounded), and the prediction path scores batches with exactly the
+model that was current at each record's event time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.iteration.unbounded import StreamingDriver, StreamingResult
+from flink_ml_tpu.lib.classification import LogisticRegressionModel, _log_loss_grads
+from flink_ml_tpu.lib.common import bucket_rows, resolve_features
+from flink_ml_tpu.lib.glm import GlmTrainParams, make_model_table
+from flink_ml_tpu.lib.params import HasWindowMs
+from flink_ml_tpu.table.sources import UnboundedSource
+from flink_ml_tpu.table.table import Table
+
+
+class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
+    """Streaming binary LR: one SGD step per fired event-time window.
+
+    ``fit`` consumes a *bounded* table by replaying it as a timestamped
+    stream (useful for tests); ``fit_unbounded`` is the real entry point:
+    it drives training and optional concurrent prediction sources and
+    returns the final model plus the full :class:`StreamingResult`
+    (per-record predictions, model history, windows fired).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._dim: Optional[int] = None
+
+    # -- feature packing for a window ---------------------------------------
+
+    def _window_xyw(self, table: Table) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        X, _ = resolve_features(table, self, dim=self._dim)
+        y = np.asarray(table.col(self.get_label_col()), dtype=np.float64)
+        n = X.shape[0]
+        b = bucket_rows(n, 64)
+        Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
+        yp = np.zeros((b,), dtype=np.float32)
+        wp = np.zeros((b,), dtype=np.float32)
+        Xp[:n], yp[:n], wp[:n] = X, y, 1.0
+        return Xp, yp, wp
+
+    def _infer_dim(self, source: UnboundedSource) -> int:
+        if self.get_feature_cols() is not None:
+            return len(self.get_feature_cols())
+        # peek the first training record's vector size
+        for _, row in source.stream():
+            schema = source.schema()
+            i = schema.find_col_index(self.get_vector_col())
+            v = row[i]
+            return v.size() if v.size() >= 0 else v.to_dense().size()
+        raise ValueError("empty training stream; cannot infer feature dim")
+
+    # -- streaming fit -------------------------------------------------------
+
+    def fit_unbounded(
+        self,
+        training_source: UnboundedSource,
+        prediction_source: Optional[UnboundedSource] = None,
+        max_windows: Optional[int] = None,
+        keep_model_history: bool = False,
+    ) -> Tuple[LogisticRegressionModel, StreamingResult]:
+        self._dim = self._infer_dim(training_source)
+        lr = self.get_learning_rate()
+        reg = self.get_reg()
+        grad_fn = _log_loss_grads(self.get_with_intercept())
+
+        @jax.jit
+        def sgd_step(params, x, y, w):
+            grads, _, w_sum = grad_fn(params, x, y, w)
+            count = jnp.maximum(w_sum, 1.0)
+            return jax.tree_util.tree_map(
+                lambda p, g: p - lr * (g / count + reg * p), params, grads
+            )
+
+        @jax.jit
+        def score(params, x):
+            w, b = params
+            return x @ w + b
+
+        def update(state, window_table: Table, epoch: int):
+            x, y, w = self._window_xyw(window_table)
+            return sgd_step(state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+
+        def predict(state, batch_table: Table):
+            X, _ = resolve_features(batch_table, self, dim=self._dim)
+            n = X.shape[0]
+            b = bucket_rows(n, 64)
+            Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
+            Xp[:n] = X
+            scores = np.asarray(score(state, jnp.asarray(Xp)))[:n]
+            return (scores > 0).astype(np.float64)
+
+        params0 = (
+            jnp.zeros((self._dim,), dtype=jnp.float32),
+            jnp.zeros((), dtype=jnp.float32),
+        )
+        driver = StreamingDriver(
+            window_ms=self.get_window_ms(), keep_model_history=keep_model_history
+        )
+        result = driver.run(
+            params0,
+            training_source,
+            update,
+            prediction_source=prediction_source,
+            predict=predict if prediction_source is not None else None,
+            max_windows=max_windows,
+        )
+        w, b = (np.asarray(a) for a in result.final_state)
+        model = LogisticRegressionModel()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(make_model_table(w, float(b)))
+        model.windows_fired_ = result.windows_fired
+        return model, result
+
+    # -- bounded convenience (replay a table as a stream) --------------------
+
+    def fit(self, *inputs: Table) -> LogisticRegressionModel:
+        from flink_ml_tpu.table.sources import GeneratorSource
+
+        (table,) = inputs
+        rows = table.to_rows()
+        # spread rows uniformly so each window holds ~globalBatchSize rows
+        per_window = self.get_global_batch_size() or 32
+        interval = max(1, self.get_window_ms() // per_window)
+        source = GeneratorSource.linear_timestamps(rows, interval, table.schema)
+        model, _ = self.fit_unbounded(source)
+        return model

@@ -1,0 +1,61 @@
+"""LinearRegression — squared-loss GLM (BASELINE configs[2]).
+
+The productized form of the reference's only trainer
+(examples-batch/.../LinearRegression.java): the per-record gradient step
+(SubUpdate:215-231), sum-reduce (UpdateAccumulator:235-246) and average
+(Update:249-256) become one jitted epoch with in-step psum; the broadcast of
+new parameters (withBroadcastSet:114) is the replicated params placement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.lib.glm import GlmEstimatorBase, GlmModelBase, LinearScoreMapper
+from flink_ml_tpu.table.schema import DataTypes, Schema
+
+
+class LinearRegressionModel(GlmModelBase):
+    """Predicts x·w + b into ``predictionCol``."""
+
+    def _make_mapper(self, data_schema: Schema):
+        model = self
+
+        class _Mapper(LinearScoreMapper):
+            def output_cols(self):
+                return [model.get_prediction_col()], [DataTypes.DOUBLE]
+
+            def map_batch(self, batch):
+                return {model.get_prediction_col(): self._scores(batch)}
+
+        return _Mapper(self, data_schema)
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _squared_loss_grads(with_intercept: bool):
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def grad_fn(params, x, y, w):
+        wts, b = params
+        pred = x @ wts + b
+        err = (pred - y) * w
+        # d/dw of 0.5*sum(w*(pred-y)^2)
+        g_w = x.T @ err
+        g_b = jnp.sum(err) * keep_b
+        loss_sum = 0.5 * jnp.sum(err * (pred - y))
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return grad_fn
+
+
+class LinearRegression(GlmEstimatorBase):
+    """Estimator: squared loss, minibatch SGD over the data-parallel mesh."""
+
+    def _grad_fn(self):
+        return _squared_loss_grads(self.get_with_intercept())
+
+    def _make_model(self) -> LinearRegressionModel:
+        return LinearRegressionModel()

@@ -86,6 +86,22 @@ class AlgoOperator(ApiAlgoOperator, HasMLEnvironmentId):
                 f"got {len(inputs)}"
             )
 
+    # -- chaining (shared by batch and stream subclasses) --------------------
+
+    def link(self, next_op: "AlgoOperator") -> "AlgoOperator":
+        """``this.link(next)`` == ``next.link_from(this)`` (BatchOperator.java:69-72)."""
+        next_op.link_from(self)
+        return next_op
+
+    def link_from(self, *inputs: "AlgoOperator") -> "AlgoOperator":
+        raise NotImplementedError
+
+    @staticmethod
+    def _reject_upstream():
+        raise RuntimeError(
+            "Table source operator should not have any upstream to link from."
+        )
+
     # -- unification with the api-level AlgoOperator -------------------------
 
     def transform(self, *inputs: Table):

@@ -3,24 +3,18 @@
 Parity map:
   BatchOperator.java:69-107 (link/linkFrom/fromTable) -> BatchOperator
   TableSourceBatchOp.java:27-39                       -> TableSourceBatchOp
+
+``link``/``link_from`` chaining lives on the shared AlgoOperator base.
 """
 
 from __future__ import annotations
-
-from typing import Sequence
 
 from flink_ml_tpu.operator.base import AlgoOperator
 from flink_ml_tpu.table.table import Table
 
 
 class BatchOperator(AlgoOperator):
-    """Operator over bounded tables with link/linkFrom chaining
-    (BatchOperator.java:69-107)."""
-
-    def link(self, next_op: "BatchOperator") -> "BatchOperator":
-        """``this.link(next)`` == ``next.link_from(this)`` (BatchOperator.java:69-72)."""
-        next_op.link_from(self)
-        return next_op
+    """Operator over bounded tables (BatchOperator.java:69-107)."""
 
     def link_from(self, *inputs: "BatchOperator") -> "BatchOperator":
         """Compute this op's outputs from upstream ops (BatchOperator.java:97)."""
@@ -49,4 +43,4 @@ class TableSourceBatchOp(BatchOperator):
         self.set_output(table)
 
     def link_from(self, *inputs: "BatchOperator") -> "BatchOperator":
-        raise RuntimeError("Table source operator should not have any upstream to link from.")
+        self._reject_upstream()

@@ -5,8 +5,8 @@ Parity map:
   TableSourceStreamOp.java:27-39                       -> TableSourceStreamOp
 
 A stream operator's payload is an :class:`UnboundedSource` (timestamped row
-stream) rather than a bounded Table; chaining semantics are identical to the
-batch side.  Compute on streams goes through the
+stream) rather than a bounded Table; chaining semantics live on the shared
+AlgoOperator base.  Compute on streams goes through the
 :mod:`flink_ml_tpu.iteration.unbounded` driver, which is where windows fire
 and models update.
 """
@@ -17,15 +17,18 @@ from typing import Optional
 
 from flink_ml_tpu.operator.base import AlgoOperator
 from flink_ml_tpu.table.sources import UnboundedSource
-from flink_ml_tpu.table.table import Table
 
 
 class StreamOperator(AlgoOperator):
     """Operator over unbounded sources (StreamOperator.java:70-108)."""
 
+    # class-level default for instances reconstructed via Stage.load, which
+    # bypasses __init__ (same rationale as AlgoOperator._output)
+    _stream: Optional[UnboundedSource] = None
+
     def __init__(self, params=None):
         super().__init__(params)
-        self._stream: Optional[UnboundedSource] = None
+        self._stream = None
 
     def get_stream(self) -> UnboundedSource:
         if self._stream is None:
@@ -39,10 +42,6 @@ class StreamOperator(AlgoOperator):
         if self._stream is not None:
             return self._stream.schema()
         return super().get_schema()
-
-    def link(self, next_op: "StreamOperator") -> "StreamOperator":
-        next_op.link_from(self)
-        return next_op
 
     def link_from(self, *inputs: "StreamOperator") -> "StreamOperator":
         raise NotImplementedError
@@ -62,4 +61,4 @@ class TableSourceStreamOp(StreamOperator):
         self.set_stream(source)
 
     def link_from(self, *inputs: "StreamOperator") -> "StreamOperator":
-        raise RuntimeError("Table source operator should not have any upstream to link from.")
+        self._reject_upstream()

@@ -37,7 +37,11 @@ class OutputColsHelper:
 
         in_names = input_schema.field_names
         in_types = input_schema.field_types
-        reserved = set(in_names if reserved_col_names is None else reserved_col_names)
+        # reserved matching is case-insensitive like all other column lookup
+        reserved = {
+            n.lower()
+            for n in (in_names if reserved_col_names is None else reserved_col_names)
+        }
 
         # name collision is case-insensitive, matching Schema/Table lookup —
         # an output col spelled 'Sum' overrides an input col 'sum' in place
@@ -62,7 +66,7 @@ class OutputColsHelper:
                 result_names.append(self.output_col_names[j])
                 result_types.append(self.output_col_types[j])
                 continue
-            if name in reserved:
+            if name.lower() in reserved:
                 self._reserved_input_cols.append(name)
                 result_names.append(name)
                 result_types.append(in_types[i])

@@ -1,0 +1,86 @@
+"""Online LogisticRegression tests: streaming convergence, concurrent
+prediction freshness, bounded-replay fit, window accounting."""
+
+import numpy as np
+
+from flink_ml_tpu.lib.online import OnlineLogisticRegression
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.sources import GeneratorSource
+from flink_ml_tpu.table.table import Table
+
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+QSCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR),)
+
+
+def stream_rows(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    true_w = np.array([2.0, -1.5, 1.0])
+    y = ((X @ true_w + 0.2 * rng.randn(n)) > 0).astype(np.float64)
+    return [(DenseVector(X[i]), y[i]) for i in range(n)], X, y
+
+
+def make_estimator():
+    return (
+        OnlineLogisticRegression()
+        .set_vector_col("features")
+        .set_label_col("label")
+        .set_prediction_col("pred")
+        .set_learning_rate(0.5)
+        .set_window_ms(1000)
+    )
+
+
+class TestOnlineLogisticRegression:
+    def test_streaming_convergence(self):
+        rows, X, y = stream_rows()
+        # 20 rows per 1000ms window -> 30 windows
+        source = GeneratorSource.linear_timestamps(rows, 50, SCHEMA)
+        model, result = make_estimator().fit_unbounded(source)
+        assert result.windows_fired == 30
+        t = Table.from_rows([(DenseVector(x),) for x in X], QSCHEMA)
+        probs = model.predict_proba(t)
+        acc = np.mean((probs > 0.5) == (y == 1))
+        assert acc > 0.9
+
+    def test_concurrent_prediction_uses_fresh_model(self):
+        rows, X, y = stream_rows(200, seed=1)
+        train_src = GeneratorSource.linear_timestamps(rows, 50, SCHEMA)
+        # prediction stream over the same timeline
+        qrows = [(DenseVector(X[i]),) for i in range(200)]
+        pred_src = GeneratorSource.linear_timestamps(qrows, 50, QSCHEMA)
+        model, result = make_estimator().fit_unbounded(
+            train_src, prediction_source=pred_src
+        )
+        assert len(result.predictions) == 200
+        # late predictions (after training) are far better than early ones
+        late = result.predictions[150:]
+        late_acc = np.mean(
+            [p == y[150 + i] for i, (_, p) in enumerate(late)]
+        )
+        assert late_acc > 0.8
+
+    def test_model_history(self):
+        rows, _, _ = stream_rows(100, seed=2)
+        source = GeneratorSource.linear_timestamps(rows, 50, SCHEMA)
+        _, result = make_estimator().fit_unbounded(source, keep_model_history=True)
+        assert len(result.model_updates) == result.windows_fired
+        # each update is a (window_end_ts, params) pair with increasing ts
+        stamps = [ts for ts, _ in result.model_updates]
+        assert stamps == sorted(stamps)
+
+    def test_max_windows_cap(self):
+        rows, _, _ = stream_rows(500, seed=3)
+        source = GeneratorSource.linear_timestamps(rows, 50, SCHEMA)
+        _, result = make_estimator().fit_unbounded(source, max_windows=5)
+        assert result.windows_fired == 5
+
+    def test_bounded_fit_replay(self):
+        rows, X, y = stream_rows(400, seed=4)
+        t = Table.from_rows(rows, SCHEMA)
+        model = make_estimator().set_global_batch_size(40).fit(t)
+        probs = model.predict_proba(
+            Table.from_rows([(DenseVector(x),) for x in X], QSCHEMA)
+        )
+        assert np.mean((probs > 0.5) == (y == 1)) > 0.88

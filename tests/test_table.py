@@ -228,3 +228,12 @@ def test_output_cols_case_insensitive_override():
     out = helper.get_result_table(t, {"Sum": np.asarray([100.0, 200.0])})
     np.testing.assert_allclose(out.col("sum"), [100.0, 200.0])
     np.testing.assert_allclose(out.col("Sum"), [100.0, 200.0])
+
+
+def test_output_cols_reserved_case_insensitive():
+    """Reserved names match case-insensitively like all other column lookup."""
+    from flink_ml_tpu.table.output_cols import OutputColsHelper
+
+    schema = Schema.of(("f0", "double"), ("label", "double"))
+    helper = OutputColsHelper(schema, ["out"], ["double"], reserved_col_names=["Label"])
+    assert helper.get_result_schema().field_names == ["label", "out"]
