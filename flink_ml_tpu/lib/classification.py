@@ -77,6 +77,8 @@ def _log_loss_grads(with_intercept: bool):
 class LogisticRegression(GlmEstimatorBase):
     """Estimator: binary log loss, minibatch SGD over the data-parallel mesh."""
 
+    LOSS_KIND = "logistic"
+
     def _grad_fn(self):
         return _log_loss_grads(self.get_with_intercept())
 

@@ -115,6 +115,100 @@ def pack_minibatches(
 GradFn = Callable
 
 
+@dataclass
+class SparseMinibatchStack:
+    """Device-major sparse minibatches in padded segment-CSR layout.
+
+    The Criteo-scale replacement for per-record SparseVector math
+    (BLAS.java:205-233, SURVEY.md §7.3 'sparse features at Criteo scale'):
+    every minibatch is a fixed-size segment-COO block, so the whole training
+    set is two dense arrays XLA can shard and scan — no ragged shapes.
+
+      ints   (n_dev*steps, 2, nnz_pad) int32 — [col index, local row id] per
+             stored value; pad entries carry row id ``mb`` (dropped by
+             segment_sum) and col index 0 with value 0.
+      floats (n_dev*steps, nnz_pad + 2*mb) — [values | y | w] concatenated so
+             the host->device hop is one float and one int transfer.
+    """
+
+    ints: np.ndarray
+    floats: np.ndarray
+    steps: int
+    mb: int
+    nnz_pad: int
+    dim: int
+
+
+def pack_sparse_minibatches(
+    vectors: Sequence,
+    y: np.ndarray,
+    n_dev: int,
+    global_batch_size: int = 0,
+    dim: Optional[int] = None,
+    pad_multiple: int = 512,
+) -> SparseMinibatchStack:
+    """Pack SparseVector rows into the device-major sparse layout.
+
+    Out-of-range feature indices fail loudly here: XLA's gather clamps and
+    segment_sum drops them, which would silently train a corrupted model.
+    """
+    n = len(vectors)
+    max_idx = -1
+    for v in vectors:
+        if len(v.indices):
+            max_idx = max(max_idx, int(v.indices.max()))
+    if dim is None:
+        dim = max_idx + 1
+        for v in vectors:
+            size = v.size()
+            if size >= 0:
+                dim = max(dim, size)
+    elif max_idx >= dim:
+        raise ValueError(
+            f"feature index {max_idx} out of range for numFeatures={dim}"
+        )
+    dim = max(dim, 1)
+    if global_batch_size <= 0:
+        global_batch_size = max(n, n_dev)
+    mb = max(1, -(-global_batch_size // n_dev))
+    steps = max(1, -(-n // (mb * n_dev)))
+    n_groups = n_dev * steps
+
+    # max nnz over minibatches, padded to a bucket multiple (shared static shape)
+    nnz_max = 1
+    for g in range(n_groups):
+        k, s = divmod(g, steps)
+        lo = k * steps * mb + s * mb
+        nnz_max = max(
+            nnz_max,
+            sum(len(vectors[i].indices) for i in range(lo, min(lo + mb, n))),
+        )
+    nnz_pad = -(-nnz_max // pad_multiple) * pad_multiple
+
+    ints = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
+    ints[:, 1, :] = mb  # pad row id -> dropped segment
+    floats = np.zeros((n_groups, nnz_pad + 2 * mb), dtype=np.float32)
+    for g in range(n_groups):
+        k, s = divmod(g, steps)
+        lo = k * steps * mb + s * mb
+        pos = 0
+        for j in range(mb):
+            i = lo + j
+            if i >= n:
+                break
+            v = vectors[i]
+            cnt = len(v.indices)
+            ints[g, 0, pos : pos + cnt] = v.indices
+            ints[g, 1, pos : pos + cnt] = j
+            floats[g, pos : pos + cnt] = v.vals
+            pos += cnt
+            floats[g, nnz_pad + j] = y[i]
+            floats[g, nnz_pad + mb + j] = 1.0
+    return SparseMinibatchStack(
+        ints=ints, floats=floats, steps=steps, mb=mb, nnz_pad=nnz_pad, dim=dim
+    )
+
+
 # Compiled epoch steps are reused across fit() calls: rebuilding the jitted
 # shard_map per fit would force a fresh XLA compile every time (~1s), which
 # dominates short training runs.  Keyed on (grad_fn, mesh, lr, reg) — grad-fn
@@ -195,14 +289,8 @@ def _combined_view(stack: MinibatchStack) -> np.ndarray:
     )
 
 
-def make_glm_train_fn(
-    grad_fn: GradFn,
-    mesh,
-    learning_rate: float,
-    reg: float,
-    max_iter: int,
-    tol: float,
-):
+def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
+                          max_iter, tol):
     """The WHOLE training run as one compiled device program.
 
     Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
@@ -212,9 +300,11 @@ def make_glm_train_fn(
     per-epoch losses + epochs-run).  This is the fast path ``train_glm``
     takes when no per-epoch listeners are registered; the epoch watermark
     degenerates to the loop-carried epoch counter.
+
+    ``mb_grad_step(params, mb_slice) -> (grads, loss_sum, w_sum)`` consumes
+    one scanned minibatch slice of the batch pytree — the dense and sparse
+    layouts differ only there.
     """
-    key = ("train", grad_fn, mesh, float(learning_rate), float(reg),
-           int(max_iter), float(tol))
     cached = _EPOCH_STEP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -222,14 +312,9 @@ def make_glm_train_fn(
     l2 = float(reg)
     tol_ = float(tol)
 
-    def local_train(params, combined):
-        x = combined[..., :-2]
-        y = combined[..., -2]
-        w = combined[..., -1]
-
+    def local_train(params, batch):
         def mb_step(p, xs):
-            xb, yb, wb = xs
-            grads, loss_sum, w_sum = grad_fn(p, xb, yb, wb)
+            grads, loss_sum, w_sum = mb_grad_step(p, xs)
             grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
             loss_sum = psum(loss_sum, "data")
             w_sum = psum(w_sum, "data")
@@ -241,7 +326,7 @@ def make_glm_train_fn(
 
         def run_epoch(params):
             start = params
-            params, (losses, counts) = jax.lax.scan(mb_step, params, (x, y, w))
+            params, (losses, counts) = jax.lax.scan(mb_step, params, batch)
             total = jnp.maximum(jnp.sum(counts), 1.0)
             loss = jnp.sum(losses * counts) / total
             delta = jnp.sqrt(
@@ -288,6 +373,124 @@ def make_glm_train_fn(
     fn = jax.jit(sharded, donate_argnums=(0,))
     _EPOCH_STEP_CACHE[key] = fn
     return fn
+
+
+def _run_fused_train(train_fn, init_params, batch, mesh) -> TrainResult:
+    """Shared epilogue: run the fused program and fetch params + loss
+    history + epoch count back in ONE transfer."""
+    from flink_ml_tpu.parallel.mesh import replicate, shard_batch
+
+    params, loss_hist, epochs = train_fn(
+        replicate(mesh, init_params), shard_batch(mesh, batch)
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    fetched = fetch_flat(*leaves, loss_hist, jnp.asarray(epochs, jnp.float64))
+    n_epochs = int(fetched[-1])
+    host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
+    return TrainResult(
+        params=host_params,
+        epochs=n_epochs,
+        losses=[float(x) for x in fetched[-2][:n_epochs]],
+    )
+
+
+def make_glm_train_fn(
+    grad_fn: GradFn,
+    mesh,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+):
+    """Fused training over the dense combined layout
+    (see :func:`_build_fused_train_fn` for the program structure)."""
+    key = ("train", grad_fn, mesh, float(learning_rate), float(reg),
+           int(max_iter), float(tol))
+
+    def mb_grad_step(p, mb):
+        return grad_fn(p, mb[..., :-2], mb[..., -2], mb[..., -1])
+
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+    )
+
+
+def make_sparse_glm_train_fn(
+    kind: str,
+    mesh,
+    mb: int,
+    nnz_pad: int,
+    dim: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+):
+    """Fused training over :class:`SparseMinibatchStack` batches.
+
+    ``kind`` picks the loss ('logistic' | 'squared').  The minibatch forward
+    is ``segment_sum(values * gather(w))`` — the batched static-shape
+    replacement for the reference's hand-rolled sparse gemv
+    (BLAS.java:205-233); the gradient scatters back through the same
+    segments.  Program structure is shared with the dense path via
+    :func:`_build_fused_train_fn`.
+    """
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    key = ("sparse", kind, mesh, mb, nnz_pad, dim,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept))
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def mb_grad_step(params, xs):
+        ints, floats = xs  # (2, nnz_pad), (nnz_pad + 2*mb,)
+        idx = ints[0]
+        rid = ints[1]
+        vals = floats[:nnz_pad]
+        y = floats[nnz_pad : nnz_pad + mb]
+        w = floats[nnz_pad + mb :]
+        wts, b = params
+        contrib = vals * jnp.take(wts, idx, axis=0)
+        logits = jax.ops.segment_sum(contrib, rid, num_segments=mb) + b
+        if kind == "logistic":
+            p = jax.nn.sigmoid(logits)
+            err = (p - y) * w
+            loss_sum = jnp.sum(w * (jnp.logaddexp(0.0, logits) - y * logits))
+        else:
+            err = (logits - y) * w
+            loss_sum = 0.5 * jnp.sum(err * (logits - y))
+        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+        g_w = jax.ops.segment_sum(
+            vals * jnp.take(err_ext, rid, axis=0), idx, num_segments=dim
+        )
+        g_b = jnp.sum(err) * keep_b
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+    )
+
+
+def train_glm_sparse(
+    init_params,
+    sstack: SparseMinibatchStack,
+    kind: str,
+    mesh,
+    learning_rate: float,
+    max_iter: int,
+    reg: float = 0.0,
+    tol: float = 0.0,
+    with_intercept: bool = True,
+) -> TrainResult:
+    """Sparse counterpart of :func:`train_glm` (always the fused device loop)."""
+    train_fn = make_sparse_glm_train_fn(
+        kind, mesh, sstack.mb, sstack.nnz_pad, sstack.dim,
+        learning_rate, reg, max_iter, tol, with_intercept,
+    )
+    return _run_fused_train(
+        train_fn, init_params, (sstack.ints, sstack.floats), mesh
+    )
 
 
 def fetch_flat(*arrays):
@@ -337,17 +540,7 @@ def train_glm(
         train_fn = make_glm_train_fn(
             grad_fn, mesh, learning_rate, reg, max_iter, tol
         )
-        combined = shard_batch(mesh, _combined_view(stack))
-        params, loss_hist, epochs = train_fn(replicate(mesh, init_params), combined)
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        fetched = fetch_flat(*leaves, loss_hist, jnp.asarray(epochs, jnp.float64))
-        n_epochs = int(fetched[-1])
-        host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
-        return TrainResult(
-            params=host_params,
-            epochs=n_epochs,
-            losses=[float(x) for x in fetched[-2][:n_epochs]],
-        )
+        return _run_fused_train(train_fn, init_params, _combined_view(stack), mesh)
 
     epoch_step = make_glm_epoch_step(grad_fn, mesh, learning_rate, reg)
     batch = shard_batch(mesh, (stack.x, stack.y, stack.w))

@@ -26,12 +26,15 @@ from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
     apply_batched,
     pack_minibatches,
+    pack_sparse_minibatches,
     resolve_features,
     train_glm,
+    train_glm_sparse,
 )
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
     HasFeatureColsDefaultAsNull,
+    HasNumFeatures,
     HasGlobalBatchSize,
     HasLabelCol,
     HasLearningRate,
@@ -42,7 +45,7 @@ from flink_ml_tpu.lib.params import (
     HasVectorColDefaultAsNull,
     HasWithIntercept,
 )
-from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector
 from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasPredictionDetailCol,
@@ -76,6 +79,7 @@ class GlmTrainParams(
     HasTol,
     HasReg,
     HasWithIntercept,
+    HasNumFeatures,
     HasSeed,
 ):
     """Training vocabulary for GLM estimators."""
@@ -111,6 +115,16 @@ def _score_fn(x, w, b):
     return x @ w + b
 
 
+@jax.jit
+def _sparse_score_fn(csr, w, b):
+    return csr.matvec(w.astype(jnp.float32)) + b
+
+
+def _col_is_sparse(table: Table, col: str) -> bool:
+    values = table.col(col)
+    return len(values) > 0 and isinstance(values[0], SparseVector)
+
+
 class LinearScoreMapper(ModelMapper):
     """Batched x·w + b scorer; subclasses shape the output columns.
 
@@ -133,6 +147,22 @@ class LinearScoreMapper(ModelMapper):
 
     def _scores(self, batch: Table) -> np.ndarray:
         model = self._model_stage
+        vector_col = model.get_vector_col()
+        if vector_col is not None and _col_is_sparse(batch, vector_col):
+            # wide models never densify: segment-CSR matvec on device.  Row
+            # count is bucketed (power of two) so varying batch sizes reuse
+            # one compiled program; pad rows receive only zero contributions
+            # and are sliced away.
+            from flink_ml_tpu.lib.common import bucket_rows
+            from flink_ml_tpu.ops.batch import CsrBatch
+
+            csr = batch.features_csr(vector_col, n_cols=int(self._w.shape[0]))
+            n = csr.n_rows
+            padded = CsrBatch(
+                csr.indices, csr.values, csr.row_ids,
+                n_rows=bucket_rows(max(n, 1)), n_cols=csr.n_cols,
+            )
+            return np.asarray(_sparse_score_fn(padded, self._w, self._b))[:n]
         X, _ = resolve_features(batch, model, dim=int(self._w.shape[0]))
         return apply_batched(_score_fn, X.astype(np.float32), self._w, self._b)
 
@@ -150,13 +180,23 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
     def _labels(self, table: Table) -> np.ndarray:
         return np.asarray(table.col(self.get_label_col()), dtype=np.float64)
 
+    #: loss kind for the sparse fused path ('logistic' | 'squared')
+    LOSS_KIND: str = ""
+
     def fit(self, *inputs: Table) -> GlmModelBase:
         (table,) = inputs
-        X, dim = resolve_features(table, self)
         y = self._labels(table)
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
         n_dev = int(np.prod(list(mesh.shape.values())))
+
+        vector_col = self.get_vector_col()
+        if (vector_col is None) == (self.get_feature_cols() is None):
+            raise ValueError("set exactly one of vectorCol / featureCols")
+        if vector_col is not None and _col_is_sparse(table, vector_col):
+            return self._fit_sparse(table, y, mesh, n_dev)
+
+        X, dim = resolve_features(table, self)
         stack = pack_minibatches(X, y, n_dev, self.get_global_batch_size())
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
@@ -171,6 +211,35 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             reg=self.get_reg(),
             tol=self.get_tol(),
         )
+        return self._finish(result)
+
+    def _fit_sparse(self, table: Table, y, mesh, n_dev: int) -> GlmModelBase:
+        """Sparse-feature training: segment-CSR minibatches, fused device loop."""
+        if not self.LOSS_KIND:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no sparse loss kind"
+            )
+        vectors = list(table.col(self.get_vector_col()))
+        num_features = self.get_num_features()
+        sstack = pack_sparse_minibatches(
+            vectors, y, n_dev, self.get_global_batch_size(), dim=num_features
+        )
+        w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        result = train_glm_sparse(
+            (w0, b0),
+            sstack,
+            self.LOSS_KIND,
+            mesh,
+            learning_rate=self.get_learning_rate(),
+            max_iter=self.get_max_iter(),
+            reg=self.get_reg(),
+            tol=self.get_tol(),
+            with_intercept=self.get_with_intercept(),
+        )
+        return self._finish(result)
+
+    def _finish(self, result) -> GlmModelBase:
         w, b = result.params
         if not self.get_with_intercept():
             b = 0.0

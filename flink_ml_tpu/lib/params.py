@@ -147,6 +147,20 @@ class HasSeed(WithParams):
         return self.set(self.SEED, value)
 
 
+class HasNumFeatures(WithParams):
+    NUM_FEATURES: ParamInfo = param_info(
+        "numFeatures",
+        "Feature-space dimension for sparse vectors; None infers from data.",
+        default=None, value_type=int,
+    )
+
+    def get_num_features(self):
+        return self.get(self.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(self.NUM_FEATURES, value)
+
+
 class HasWindowMs(WithParams):
     WINDOW_MS: ParamInfo = param_info(
         "windowMs", "Event-time tumbling window size in milliseconds.",

@@ -54,6 +54,8 @@ def _squared_loss_grads(with_intercept: bool):
 class LinearRegression(GlmEstimatorBase):
     """Estimator: squared loss, minibatch SGD over the data-parallel mesh."""
 
+    LOSS_KIND = "squared"
+
     def _grad_fn(self):
         return _squared_loss_grads(self.get_with_intercept())
 

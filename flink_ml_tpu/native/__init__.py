@@ -1,0 +1,146 @@
+"""ctypes bindings for the native ingestion library (loader.cpp).
+
+The shared library is built lazily on first use (``make`` in this directory)
+and the table sources fall back to pure Python when it is unavailable —
+``FLINK_ML_TPU_NO_NATIVE=1`` forces the fallback.  API consumed by
+``flink_ml_tpu.table.sources._native_lib``:
+
+  available() -> bool
+  read_csv(path, delimiter, skip_header, arity) -> list[list[str]]
+  read_libsvm(path, n_features, zero_based) -> (labels ndarray, [SparseVector])
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libflinkmltpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FLINK_ML_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.fml_read_csv.restype = ctypes.POINTER(ctypes.c_char)
+        lib.fml_read_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.fml_read_libsvm.restype = ctypes.c_int
+        lib.fml_read_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.fml_free.restype = None
+        lib.fml_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_csv(path: str, delimiter: str, skip_header: bool, arity: int) -> List[List[str]]:
+    lib = _load()
+    out_len = ctypes.c_int64(0)
+    buf = lib.fml_read_csv(
+        path.encode(), delimiter.encode()[:1], 1 if skip_header else 0,
+        ctypes.byref(out_len),
+    )
+    if not buf:
+        raise IOError(f"cannot read {path}")
+    try:
+        text = ctypes.string_at(buf, out_len.value).decode("utf-8", "replace")
+    finally:
+        lib.fml_free(buf)
+    rows = []
+    for i, line in enumerate(text.split("\x1e")):
+        if line == "" and i > 0:
+            continue  # trailing terminator
+        cells = line.split("\x1f")
+        if cells == [""]:
+            continue  # blank line in the file
+        if len(cells) != arity:
+            raise ValueError(
+                f"{path}: row {i} has {len(cells)} fields, schema expects {arity}"
+            )
+        rows.append(cells)
+    return rows
+
+
+def read_libsvm(path: str, n_features: Optional[int], zero_based: bool):
+    from flink_ml_tpu.ops.vector import SparseVector
+
+    lib = _load()
+    labels_p = ctypes.POINTER(ctypes.c_double)()
+    indptr_p = ctypes.POINTER(ctypes.c_int64)()
+    indices_p = ctypes.POINTER(ctypes.c_int64)()
+    values_p = ctypes.POINTER(ctypes.c_double)()
+    n_rows = ctypes.c_int64(0)
+    nnz = ctypes.c_int64(0)
+    max_idx = ctypes.c_int64(0)
+    rc = lib.fml_read_libsvm(
+        path.encode(), 1 if zero_based else 0,
+        ctypes.byref(labels_p), ctypes.byref(indptr_p),
+        ctypes.byref(indices_p), ctypes.byref(values_p),
+        ctypes.byref(n_rows), ctypes.byref(nnz), ctypes.byref(max_idx),
+    )
+    if rc == -1:
+        raise IOError(f"cannot read {path}")
+    if rc != 0:
+        raise ValueError(f"{path}: malformed libsvm input")
+    try:
+        nr, nz = n_rows.value, nnz.value
+        labels = np.ctypeslib.as_array(labels_p, shape=(max(nr, 1),))[:nr].copy()
+        indptr = np.ctypeslib.as_array(indptr_p, shape=(nr + 1,)).copy()
+        indices = np.ctypeslib.as_array(indices_p, shape=(max(nz, 1),))[:nz].copy()
+        values = np.ctypeslib.as_array(values_p, shape=(max(nz, 1),))[:nz].copy()
+    finally:
+        lib.fml_free(labels_p)
+        lib.fml_free(indptr_p)
+        lib.fml_free(indices_p)
+        lib.fml_free(values_p)
+
+    dim = n_features if n_features is not None else int(max_idx.value) + 1
+    vecs = [
+        SparseVector(dim, indices[indptr[i]:indptr[i + 1]],
+                     values[indptr[i]:indptr[i + 1]])
+        for i in range(nr)
+    ]
+    return labels, vecs

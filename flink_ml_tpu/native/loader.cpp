@@ -1,0 +1,208 @@
+// Native data-plane loaders.
+//
+// The reference's only native boundary is the math kernel (netlib BLAS via
+// flink-ml-lib, BLAS.java:28-41); on TPU that role is played by XLA.  The
+// runtime component that still deserves native code here is ingestion: CSV
+// and LibSVM parsing is pure host CPU work on the training path (SURVEY.md
+// §7.1 'bounded sources'), and the Python fallbacks are interpreter-bound.
+//
+// Exposed via a plain C ABI consumed with ctypes (no pybind11 in this
+// environment):
+//   fml_read_csv     -> one malloc'd buffer: rows joined by \x1e, cells by
+//                       \x1f (RFC-4180 quoting handled here; Python does two
+//                       C-speed splits to materialize cells)
+//   fml_read_libsvm  -> CSR triplet buffers (labels / indptr / indices /
+//                       values) ready to wrap as numpy arrays
+//   fml_free         -> release any buffer returned by the calls above
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Read a whole file into a string; empty string on failure (len 0).
+static bool read_file(const char* path, std::string& out) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long size = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (size < 0) {
+        std::fclose(f);
+        return false;
+    }
+    out.resize(static_cast<size_t>(size));
+    size_t got = size ? std::fread(&out[0], 1, static_cast<size_t>(size), f) : 0;
+    std::fclose(f);
+    out.resize(got);
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void fml_free(void* p) { std::free(p); }
+
+// Parse CSV with RFC-4180 double-quote semantics.  Returns a buffer of
+// rows separated by \x1e whose cells are separated by \x1f, or nullptr on
+// I/O error.  *out_len receives the buffer length.
+char* fml_read_csv(const char* path, char delim, int skip_header,
+                   int64_t* out_len) {
+    std::string data;
+    if (!read_file(path, data)) return nullptr;
+
+    std::string out;
+    out.reserve(data.size() + data.size() / 8);
+
+    size_t i = 0;
+    const size_t n = data.size();
+    bool row_started = false;
+    bool skipping = skip_header != 0;
+
+    while (i < n) {
+        // parse one cell
+        std::string cell;
+        if (data[i] == '"') {
+            ++i;
+            while (i < n) {
+                if (data[i] == '"') {
+                    if (i + 1 < n && data[i + 1] == '"') {
+                        cell.push_back('"');
+                        i += 2;
+                    } else {
+                        ++i;
+                        break;
+                    }
+                } else {
+                    cell.push_back(data[i++]);
+                }
+            }
+        } else {
+            while (i < n && data[i] != delim && data[i] != '\n' && data[i] != '\r') {
+                cell.push_back(data[i++]);
+            }
+        }
+        if (!skipping) {
+            if (row_started) out.push_back('\x1f');
+            out += cell;
+            row_started = true;
+        }
+        // cell terminator
+        if (i < n && data[i] == delim) {
+            ++i;
+            continue;
+        }
+        // row terminator (handle \r\n and \n)
+        if (i < n && data[i] == '\r') ++i;
+        if (i < n && data[i] == '\n') ++i;
+        if (skipping) {
+            skipping = false;
+        } else if (row_started) {
+            out.push_back('\x1e');
+            row_started = false;
+        }
+    }
+    if (row_started) out.push_back('\x1e');
+
+    char* buf = static_cast<char*>(std::malloc(out.size() ? out.size() : 1));
+    if (!buf) return nullptr;
+    std::memcpy(buf, out.data(), out.size());
+    *out_len = static_cast<int64_t>(out.size());
+    return buf;
+}
+
+// Parse LibSVM/SVMlight text into CSR buffers.  '#' starts a comment.
+// Returns 0 on success, -1 on I/O error, -2 on parse error.
+int fml_read_libsvm(const char* path, int zero_based, double** out_labels,
+                    int64_t** out_indptr, int64_t** out_indices,
+                    double** out_values, int64_t* out_rows, int64_t* out_nnz,
+                    int64_t* out_max_idx) {
+    std::string data;
+    if (!read_file(path, data)) return -1;
+
+    std::vector<double> labels;
+    std::vector<int64_t> indptr(1, 0);
+    std::vector<int64_t> indices;
+    std::vector<double> values;
+    int64_t max_idx = -1;
+    const int64_t offset = zero_based ? 0 : 1;
+
+    const char* p = data.c_str();
+    const char* end = p + data.size();
+    while (p < end) {
+        // one line
+        const char* line_end = static_cast<const char*>(
+            std::memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        const char* hash = static_cast<const char*>(
+            std::memchr(p, '#', static_cast<size_t>(line_end - p)));
+        const char* stop = hash ? hash : line_end;
+
+        // skip leading whitespace
+        while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+        if (p < stop) {
+            char* next = nullptr;
+            double label = std::strtod(p, &next);
+            if (next == p) return -2;
+            labels.push_back(label);
+            p = next;
+            // idx:val pairs
+            for (;;) {
+                while (p < stop && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+                if (p >= stop) break;
+                char* colon = nullptr;
+                long long idx = std::strtoll(p, &colon, 10);
+                if (colon == p || colon >= stop || *colon != ':') return -2;
+                // the value must start right after ':' within this line —
+                // strtod's own whitespace-skipping would otherwise walk past
+                // the newline and silently consume the next line's label
+                const char* vstart = colon + 1;
+                if (vstart >= stop || *vstart == ' ' || *vstart == '\t' ||
+                    *vstart == '\r' || *vstart == '\n') {
+                    return -2;
+                }
+                char* after = nullptr;
+                double val = std::strtod(vstart, &after);
+                if (after == vstart || after > stop) return -2;
+                int64_t j = static_cast<int64_t>(idx) - offset;
+                if (j < 0) return -2;
+                indices.push_back(j);
+                values.push_back(val);
+                if (j > max_idx) max_idx = j;
+                p = after;
+            }
+            indptr.push_back(static_cast<int64_t>(indices.size()));
+        }
+        p = (line_end < end) ? line_end + 1 : end;
+    }
+
+    const size_t nr = labels.size();
+    const size_t nz = indices.size();
+    auto* lab = static_cast<double*>(std::malloc(sizeof(double) * (nr ? nr : 1)));
+    auto* ptr = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (nr + 1)));
+    auto* ind = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (nz ? nz : 1)));
+    auto* val = static_cast<double*>(std::malloc(sizeof(double) * (nz ? nz : 1)));
+    if (!lab || !ptr || !ind || !val) {
+        std::free(lab); std::free(ptr); std::free(ind); std::free(val);
+        return -1;
+    }
+    if (nr) std::memcpy(lab, labels.data(), sizeof(double) * nr);
+    std::memcpy(ptr, indptr.data(), sizeof(int64_t) * (nr + 1));
+    if (nz) std::memcpy(ind, indices.data(), sizeof(int64_t) * nz);
+    if (nz) std::memcpy(val, values.data(), sizeof(double) * nz);
+    *out_labels = lab;
+    *out_indptr = ptr;
+    *out_indices = ind;
+    *out_values = val;
+    *out_rows = static_cast<int64_t>(nr);
+    *out_nnz = static_cast<int64_t>(nz);
+    *out_max_idx = max_idx;
+    return 0;
+}
+
+}  // extern "C"

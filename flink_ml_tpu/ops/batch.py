@@ -71,9 +71,16 @@ class CsrBatch:
     ) -> "CsrBatch":
         idx_parts, val_parts, row_parts = [], [], []
         for r, v in enumerate(vectors):
-            idx_parts.append(np.asarray(v.indices, dtype=np.int32))
+            idx = np.asarray(v.indices, dtype=np.int32)
+            # out-of-range indices must fail here: device gather clamps and
+            # segment_sum drops them, silently corrupting results
+            if idx.size and (int(idx.max()) >= n_cols or int(idx.min()) < 0):
+                raise ValueError(
+                    f"row {r}: feature index out of range for n_cols={n_cols}"
+                )
+            idx_parts.append(idx)
             val_parts.append(np.asarray(v.vals, dtype=np.float32))
-            row_parts.append(np.full(v.indices.size, r, dtype=np.int32))
+            row_parts.append(np.full(idx.size, r, dtype=np.int32))
         nnz = sum(p.size for p in idx_parts)
         nnz_pad = max(_round_up(max(nnz, 1), pad_multiple), pad_multiple)
         indices = np.zeros(nnz_pad, dtype=np.int32)
