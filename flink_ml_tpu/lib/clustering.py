@@ -175,7 +175,9 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed):
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        n_dev = int(np.prod(list(mesh.shape.values())))
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+
+        n_dev = data_parallel_size(mesh)
         n_pad = -(-n // n_dev) * n_dev
         Xp = np.zeros((n_pad, dim), dtype=np.float32)
         Xp[:n] = X

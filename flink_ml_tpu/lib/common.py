@@ -154,8 +154,10 @@ def pack_sparse_minibatches(
     """
     n = len(vectors)
     max_idx = -1
-    for v in vectors:
+    for r, v in enumerate(vectors):
         if len(v.indices):
+            if int(v.indices.min()) < 0:
+                raise ValueError(f"row {r}: negative feature index")
             max_idx = max(max_idx, int(v.indices.max()))
     if dim is None:
         dim = max_idx + 1
@@ -278,6 +280,7 @@ class TrainResult:
     params: tuple
     epochs: int
     losses: list
+    final_delta: Optional[float] = None
 
 
 def _combined_view(stack: MinibatchStack) -> np.ndarray:
@@ -290,7 +293,8 @@ def _combined_view(stack: MinibatchStack) -> np.ndarray:
 
 
 def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
-                          max_iter, tol):
+                          max_iter, tol, in_specs=None, out_specs=None,
+                          delta_fn=None):
     """The WHOLE training run as one compiled device program.
 
     Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
@@ -302,8 +306,12 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
     degenerates to the loop-carried epoch counter.
 
     ``mb_grad_step(params, mb_slice) -> (grads, loss_sum, w_sum)`` consumes
-    one scanned minibatch slice of the batch pytree — the dense and sparse
-    layouts differ only there.
+    one scanned minibatch slice of the batch pytree — the dense, sparse, and
+    feature-sharded layouts differ only there.  ``in_specs``/``out_specs``
+    override the default replicated-params/data-sharded-batch placement
+    (feature sharding puts the weight leaf on the ``model`` axis) and
+    ``delta_fn(params, start)`` overrides the convergence norm when params
+    are sharded.
     """
     cached = _EPOCH_STEP_CACHE.get(key)
     if cached is not None:
@@ -329,15 +337,18 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
             params, (losses, counts) = jax.lax.scan(mb_step, params, batch)
             total = jnp.maximum(jnp.sum(counts), 1.0)
             loss = jnp.sum(losses * counts) / total
-            delta = jnp.sqrt(
-                sum(
-                    jnp.sum((a - b) ** 2)
-                    for a, b in zip(
-                        jax.tree_util.tree_leaves(params),
-                        jax.tree_util.tree_leaves(start),
+            if delta_fn is not None:
+                delta = delta_fn(params, start)
+            else:
+                delta = jnp.sqrt(
+                    sum(
+                        jnp.sum((a - b) ** 2)
+                        for a, b in zip(
+                            jax.tree_util.tree_leaves(params),
+                            jax.tree_util.tree_leaves(start),
+                        )
                     )
                 )
-            )
             return params, loss, delta
 
         def cond(carry):
@@ -352,22 +363,24 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
         def body(carry):
             params, epoch, _, loss_hist = carry
             params, loss, delta = run_epoch(params)
-            loss_hist = loss_hist.at[epoch].set(loss)
+            loss_hist = loss_hist.at[epoch].set(loss.astype(loss_hist.dtype))
             return params, epoch + 1, delta, loss_hist
 
         loss_hist0 = jnp.zeros((max_iter,), dtype=jnp.float32)
-        params, epochs, _, loss_hist = jax.lax.while_loop(
+        params, epochs, delta, loss_hist = jax.lax.while_loop(
             cond, body, (params, jnp.asarray(0), jnp.asarray(jnp.inf), loss_hist0)
         )
-        return params, loss_hist, epochs
+        return params, loss_hist, epochs, delta
 
     from jax.sharding import PartitionSpec as P
 
     sharded = jax.shard_map(
         local_train,
         mesh=mesh,
-        in_specs=(P(), P("data")),
-        out_specs=(P(), P(), P()),
+        in_specs=in_specs if in_specs is not None else (P(), P("data")),
+        out_specs=(
+            out_specs if out_specs is not None else (P(), P(), P(), P())
+        ),
         check_vma=True,
     )
     fn = jax.jit(sharded, donate_argnums=(0,))
@@ -375,22 +388,33 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
     return fn
 
 
-def _run_fused_train(train_fn, init_params, batch, mesh) -> TrainResult:
+def _run_fused_train(train_fn, init_params, batch, mesh,
+                     place_params=None, batch_preplaced=False) -> TrainResult:
     """Shared epilogue: run the fused program and fetch params + loss
-    history + epoch count back in ONE transfer."""
+    history + epoch count + final update norm back in ONE transfer.
+    ``place_params`` overrides the default replicated placement (feature
+    sharding); ``batch_preplaced`` skips the device transfer when the caller
+    already sharded the batch (chunked checkpoint loops place it once)."""
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
-    params, loss_hist, epochs = train_fn(
-        replicate(mesh, init_params), shard_batch(mesh, batch)
+    placed = (
+        place_params(init_params) if place_params is not None
+        else replicate(mesh, init_params)
     )
+    device_batch = batch if batch_preplaced else shard_batch(mesh, batch)
+    params, loss_hist, epochs, delta = train_fn(placed, device_batch)
     leaves, treedef = jax.tree_util.tree_flatten(params)
-    fetched = fetch_flat(*leaves, loss_hist, jnp.asarray(epochs, jnp.float64))
-    n_epochs = int(fetched[-1])
+    fetched = fetch_flat(
+        *leaves, loss_hist, jnp.asarray(epochs, jnp.float64),
+        jnp.asarray(delta, jnp.float64),
+    )
+    n_epochs = int(fetched[-2])
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
     return TrainResult(
         params=host_params,
         epochs=n_epochs,
-        losses=[float(x) for x in fetched[-2][:n_epochs]],
+        losses=[float(x) for x in fetched[-3][:n_epochs]],
+        final_delta=float(fetched[-1]),
     )
 
 
@@ -413,6 +437,18 @@ def make_glm_train_fn(
     return _build_fused_train_fn(
         key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
     )
+
+
+def _sparse_loss(kind: str, logits, y, w):
+    """Shared loss/error math for the sparse paths."""
+    if kind == "logistic":
+        prob = jax.nn.sigmoid(logits)
+        err = (prob - y) * w
+        loss_sum = jnp.sum(w * (jnp.logaddexp(0.0, logits) - y * logits))
+    else:
+        err = (logits - y) * w
+        loss_sum = 0.5 * jnp.sum(err * (logits - y))
+    return err, loss_sum
 
 
 def make_sparse_glm_train_fn(
@@ -453,13 +489,7 @@ def make_sparse_glm_train_fn(
         wts, b = params
         contrib = vals * jnp.take(wts, idx, axis=0)
         logits = jax.ops.segment_sum(contrib, rid, num_segments=mb) + b
-        if kind == "logistic":
-            p = jax.nn.sigmoid(logits)
-            err = (p - y) * w
-            loss_sum = jnp.sum(w * (jnp.logaddexp(0.0, logits) - y * logits))
-        else:
-            err = (logits - y) * w
-            loss_sum = 0.5 * jnp.sum(err * (logits - y))
+        err, loss_sum = _sparse_loss(kind, logits, y, w)
         err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
         g_w = jax.ops.segment_sum(
             vals * jnp.take(err_ext, rid, axis=0), idx, num_segments=dim
@@ -469,6 +499,86 @@ def make_sparse_glm_train_fn(
 
     return _build_fused_train_fn(
         key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+    )
+
+
+def make_sparse_glm_train_fn_2d(
+    kind: str,
+    mesh,
+    mb: int,
+    nnz_pad: int,
+    dim: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+):
+    """Feature-dimension-sharded sparse training over a ('data','model') mesh.
+
+    For models too wide for one chip's HBM (Criteo-scale hashed features,
+    SURVEY.md §5.7): the weight vector is sharded over the ``model`` axis —
+    shard i owns the contiguous feature range [i*dim_local, (i+1)*dim_local).
+    Each minibatch forward computes partial logits from locally-owned
+    features and one ``psum`` over ``model`` (the tensor-parallel allreduce,
+    riding ICI) completes them; gradients scatter back only into the local
+    shard, so weight traffic never crosses chips.  ``dim`` must be divisible
+    by the model-axis size (pad the feature space up).  Loop scaffolding is
+    shared with every other path via :func:`_build_fused_train_fn`.
+    """
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    model_size = dict(mesh.shape)["model"]
+    if dim % model_size != 0:
+        raise ValueError(
+            f"dim={dim} not divisible by model axis size {model_size}"
+        )
+    dim_local = dim // model_size
+    key = ("sparse2d", kind, mesh, mb, nnz_pad, dim,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept))
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def mb_grad_step(params, xs):
+        ints, floats = xs
+        idx = ints[0]
+        rid = ints[1]
+        vals = floats[:nnz_pad]
+        y = floats[nnz_pad : nnz_pad + mb]
+        w = floats[nnz_pad + mb :]
+        wts_local, b = params
+        lo = jax.lax.axis_index("model") * dim_local
+        local_idx = idx - lo
+        mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
+        safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
+        contrib = jnp.where(
+            mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
+        )
+        partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
+        # the TP allreduce: complete logits across feature shards
+        logits = jax.lax.psum(partial, "model") + b
+        err, loss_sum = _sparse_loss(kind, logits, y, w)
+        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+        scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
+        g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
+        g_b = jnp.sum(err) * keep_b
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    def delta_fn(params, start):
+        # shard-local weight squares summed across 'model'; the replicated
+        # intercept counts once
+        return jnp.sqrt(
+            jax.lax.psum(jnp.sum((params[0] - start[0]) ** 2), "model")
+            + (params[1] - start[1]) ** 2
+        )
+
+    from jax.sharding import PartitionSpec as P
+
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol,
+        in_specs=((P("model"), P()), P("data")),
+        out_specs=((P("model"), P()), P(), P(), P()),
+        delta_fn=delta_fn,
     )
 
 
@@ -482,15 +592,107 @@ def train_glm_sparse(
     reg: float = 0.0,
     tol: float = 0.0,
     with_intercept: bool = True,
+    checkpoint=None,
 ) -> TrainResult:
-    """Sparse counterpart of :func:`train_glm` (always the fused device loop)."""
-    train_fn = make_sparse_glm_train_fn(
-        kind, mesh, sstack.mb, sstack.nnz_pad, sstack.dim,
-        learning_rate, reg, max_iter, tol, with_intercept,
+    """Sparse counterpart of :func:`train_glm` (always the fused device loop).
+
+    On a mesh with a >1-sized ``model`` axis the weight vector is sharded
+    over it (:func:`make_sparse_glm_train_fn_2d`); the feature dimension is
+    padded up to a multiple of the axis size.  With a
+    :class:`~flink_ml_tpu.iteration.checkpoint.CheckpointConfig` the run
+    executes as fused chunks of ``every_n_epochs`` epochs with a snapshot
+    between chunks (and resumes from the latest snapshot).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model_size = dict(mesh.shape).get("model", 1)
+    dim = sstack.dim
+    if model_size > 1:
+        dim_pad = -(-dim // model_size) * model_size
+
+        def place(params):
+            w0, b0 = params
+            w0 = jnp.asarray(w0)
+            if dim_pad != int(w0.shape[0]):
+                w0 = jnp.concatenate(
+                    [w0, jnp.zeros((dim_pad - w0.shape[0],), w0.dtype)]
+                )
+            return (
+                jax.device_put(w0, NamedSharding(mesh, P("model"))),
+                jax.device_put(jnp.asarray(b0), NamedSharding(mesh, P())),
+            )
+
+        def factory(n_epochs):
+            return make_sparse_glm_train_fn_2d(
+                kind, mesh, sstack.mb, sstack.nnz_pad, dim_pad,
+                learning_rate, reg, n_epochs, tol, with_intercept,
+            )
+
+        def trim(params):
+            return (params[0][:dim], params[1])
+    else:
+        def place(params):
+            from flink_ml_tpu.parallel.mesh import replicate
+
+            return replicate(mesh, params)
+
+        def factory(n_epochs):
+            return make_sparse_glm_train_fn(
+                kind, mesh, sstack.mb, sstack.nnz_pad, dim,
+                learning_rate, reg, n_epochs, tol, with_intercept,
+            )
+
+        def trim(params):
+            return params
+
+    batch = (sstack.ints, sstack.floats)
+
+    def run(n_epochs, params, device_batch=None):
+        r = _run_fused_train(
+            factory(n_epochs), params,
+            batch if device_batch is None else device_batch, mesh,
+            place_params=place, batch_preplaced=device_batch is not None,
+        )
+        return TrainResult(params=trim(r.params), epochs=r.epochs,
+                           losses=r.losses, final_delta=r.final_delta)
+
+    if checkpoint is None:
+        return run(max_iter, init_params)
+
+    from flink_ml_tpu.iteration.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+        prune_checkpoints,
+        save_checkpoint,
     )
-    return _run_fused_train(
-        train_fn, init_params, (sstack.ints, sstack.floats), mesh
-    )
+
+    params = init_params
+    start_epoch = 0
+    losses: list = []
+    latest = latest_checkpoint(checkpoint.directory)
+    if latest is not None:
+        params, meta = load_checkpoint(latest, like=init_params)
+        start_epoch = int(meta["epoch"]) + 1
+        losses = list(meta.get("losses", []))
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
+    while start_epoch < max_iter:
+        chunk = min(checkpoint.every_n_epochs, max_iter - start_epoch)
+        r = run(chunk, params, device_batch)
+        params = r.params
+        losses.extend(r.losses)
+        start_epoch += r.epochs
+        save_checkpoint(
+            checkpoint.directory, start_epoch - 1, params,
+            meta={"losses": losses},
+        )
+        prune_checkpoints(checkpoint.directory, checkpoint.keep)
+        if r.epochs < chunk:
+            break  # converged mid-chunk (tol)
+        if tol > 0.0 and r.final_delta is not None and r.final_delta <= tol:
+            break  # converged exactly at a chunk boundary
+    return TrainResult(params=params, epochs=start_epoch, losses=losses)
 
 
 def fetch_flat(*arrays):
@@ -523,6 +725,7 @@ def train_glm(
     reg: float = 0.0,
     tol: float = 0.0,
     listeners: Sequence = (),
+    checkpoint=None,
 ) -> TrainResult:
     """Drive GLM training to termination.
 
@@ -530,22 +733,44 @@ def train_glm(
     and — when ``tol`` > 0 — an empty-criteria round, realized as "parameter
     update norm below tol" (SURVEY.md §3.5, IterationBodyResult.java:44-48).
 
-    Without listeners the entire run is ONE device program (fused epoch
-    while_loop, single transfer each way).  With listeners, epochs go through
-    the bounded iteration runtime so per-epoch watermark callbacks fire.
+    Without listeners or checkpointing the entire run is ONE device program
+    (fused epoch while_loop, single transfer each way).  With listeners or a
+    :class:`~flink_ml_tpu.iteration.checkpoint.CheckpointConfig`, epochs go
+    through the bounded iteration runtime so per-epoch watermark callbacks
+    fire and snapshots land at the configured cadence; an existing snapshot
+    in ``checkpoint.directory`` resumes the run from its epoch, and the
+    deterministic packing order makes resumed runs bit-match uninterrupted
+    ones.
     """
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
-    if not listeners:
+    if not listeners and checkpoint is None:
         train_fn = make_glm_train_fn(
             grad_fn, mesh, learning_rate, reg, max_iter, tol
         )
         return _run_fused_train(train_fn, init_params, _combined_view(stack), mesh)
 
+    start_epoch = 0
+    losses: list = []
+    if checkpoint is not None:
+        from flink_ml_tpu.iteration.checkpoint import latest_checkpoint, load_checkpoint
+
+        latest = latest_checkpoint(checkpoint.directory)
+        if latest is not None:
+            init_params, meta = load_checkpoint(latest, like=init_params)
+            start_epoch = int(meta["epoch"]) + 1
+            losses = list(meta.get("losses", []))
+            if start_epoch >= max_iter:
+                return TrainResult(
+                    params=jax.tree_util.tree_map(np.asarray, init_params),
+                    epochs=start_epoch,
+                    losses=[float(x) for x in losses],
+                )
+
     epoch_step = make_glm_epoch_step(grad_fn, mesh, learning_rate, reg)
     batch = shard_batch(mesh, (stack.x, stack.y, stack.w))
     params0 = replicate(mesh, init_params)
-    losses: list = []
+    converted: list = list(losses)  # float prefix (resumed history)
 
     def body(params, inputs, epoch):
         new_params, (loss, delta) = epoch_step(params, inputs["batch"])
@@ -557,6 +782,25 @@ def train_glm(
         # keep the loss as a device value: converting here would sync every
         # epoch and collapse the async dispatch pipeline
         losses.append(loss)
+        if checkpoint is not None:
+            true_epoch = start_epoch + epoch
+            if (true_epoch + 1) % checkpoint.every_n_epochs == 0:
+                from flink_ml_tpu.iteration.checkpoint import (
+                    prune_checkpoints,
+                    save_checkpoint,
+                )
+
+                # convert only the not-yet-converted tail (the save itself
+                # syncs anyway; re-converting the whole history each time
+                # would be O(E^2) blocking float() calls)
+                converted.extend(float(x) for x in losses[len(converted):])
+                save_checkpoint(
+                    checkpoint.directory,
+                    true_epoch,
+                    jax.tree_util.tree_map(np.asarray, new_params),
+                    meta={"losses": list(converted)},
+                )
+                prune_checkpoints(checkpoint.directory, checkpoint.keep)
         return IterationBodyResult(
             feedback=new_params,
             outputs={"loss": loss},
@@ -567,12 +811,14 @@ def train_glm(
         params0,
         ReplayableInputs.replay(batch=batch),
         body,
-        IterationConfig(max_epochs=max_iter),
+        IterationConfig(max_epochs=max_iter - start_epoch),
         listeners=listeners,
     )
     final = jax.tree_util.tree_map(np.asarray, result.final_variables)
     return TrainResult(
-        params=final, epochs=result.epochs_run, losses=[float(x) for x in losses]
+        params=final,
+        epochs=start_epoch + result.epochs_run,
+        losses=[float(x) for x in losses],
     )
 
 

@@ -33,6 +33,7 @@ from flink_ml_tpu.lib.common import (
 )
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
+    HasCheckpoint,
     HasFeatureColsDefaultAsNull,
     HasNumFeatures,
     HasGlobalBatchSize,
@@ -80,6 +81,7 @@ class GlmTrainParams(
     HasReg,
     HasWithIntercept,
     HasNumFeatures,
+    HasCheckpoint,
     HasSeed,
 ):
     """Training vocabulary for GLM estimators."""
@@ -180,6 +182,16 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
     def _labels(self, table: Table) -> np.ndarray:
         return np.asarray(table.col(self.get_label_col()), dtype=np.float64)
 
+    def _checkpoint_config(self):
+        directory = self.get_checkpoint_dir()
+        if directory is None:
+            return None
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        return CheckpointConfig(
+            directory=directory, every_n_epochs=self.get_checkpoint_interval()
+        )
+
     #: loss kind for the sparse fused path ('logistic' | 'squared')
     LOSS_KIND: str = ""
 
@@ -188,7 +200,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         y = self._labels(table)
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        n_dev = int(np.prod(list(mesh.shape.values())))
+        # rows shard over the data axis only; other mesh axes replicate
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+
+        n_dev = data_parallel_size(mesh)
 
         vector_col = self.get_vector_col()
         if (vector_col is None) == (self.get_feature_cols() is None):
@@ -210,6 +225,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             max_iter=self.get_max_iter(),
             reg=self.get_reg(),
             tol=self.get_tol(),
+            checkpoint=self._checkpoint_config(),
         )
         return self._finish(result)
 
@@ -236,6 +252,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             reg=self.get_reg(),
             tol=self.get_tol(),
             with_intercept=self.get_with_intercept(),
+            checkpoint=self._checkpoint_config(),
         )
         return self._finish(result)
 

@@ -147,6 +147,32 @@ class HasSeed(WithParams):
         return self.set(self.SEED, value)
 
 
+class HasCheckpoint(WithParams):
+    CHECKPOINT_DIR: ParamInfo = param_info(
+        "checkpointDir",
+        "Directory for periodic training snapshots; None disables "
+        "checkpointing. An existing snapshot there resumes training.",
+        default=None, value_type=str,
+    )
+    CHECKPOINT_INTERVAL: ParamInfo = param_info(
+        "checkpointInterval", "Snapshot every N completed epochs.",
+        default=1, value_type=int,
+        validator=lambda v: v > 0,
+    )
+
+    def get_checkpoint_dir(self):
+        return self.get(self.CHECKPOINT_DIR)
+
+    def set_checkpoint_dir(self, value: str):
+        return self.set(self.CHECKPOINT_DIR, value)
+
+    def get_checkpoint_interval(self) -> int:
+        return self.get(self.CHECKPOINT_INTERVAL)
+
+    def set_checkpoint_interval(self, value: int):
+        return self.set(self.CHECKPOINT_INTERVAL, value)
+
+
 class HasNumFeatures(WithParams):
     NUM_FEATURES: ParamInfo = param_info(
         "numFeatures",

@@ -36,15 +36,17 @@ def _load():
         _tried = True
         if os.environ.get("FLINK_ML_TPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO):
-            try:
-                subprocess.run(
-                    ["make", "-C", _DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
+        # always invoke make: the Makefile's dependency tracking makes this a
+        # no-op when the .so is fresh and rebuilds it after loader.cpp edits
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_SO):
                 return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -76,7 +78,9 @@ def available() -> bool:
     return _load() is not None
 
 
-def read_csv(path: str, delimiter: str, skip_header: bool, arity: int) -> List[List[str]]:
+def read_csv(path: str, delimiter: str, skip_header: bool, arity: int):
+    """Parse via the native loader; None means 'fall back to pure Python'
+    (the file contains the transport's control bytes in quoted cells)."""
     lib = _load()
     out_len = ctypes.c_int64(0)
     buf = lib.fml_read_csv(
@@ -84,6 +88,8 @@ def read_csv(path: str, delimiter: str, skip_header: bool, arity: int) -> List[L
         ctypes.byref(out_len),
     )
     if not buf:
+        if out_len.value == -2:
+            return None
         raise IOError(f"cannot read {path}")
     try:
         text = ctypes.string_at(buf, out_len.value).decode("utf-8", "replace")

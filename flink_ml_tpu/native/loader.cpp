@@ -50,11 +50,20 @@ void fml_free(void* p) { std::free(p); }
 
 // Parse CSV with RFC-4180 double-quote semantics.  Returns a buffer of
 // rows separated by \x1e whose cells are separated by \x1f, or nullptr on
-// I/O error.  *out_len receives the buffer length.
+// I/O error (*out_len = 0) or when the data itself contains the separator
+// control bytes 0x1E/0x1F (*out_len = -2: legal in quoted cells but not
+// representable in this transport — the caller falls back to the pure
+// parser).  Otherwise *out_len receives the buffer length.
 char* fml_read_csv(const char* path, char delim, int skip_header,
                    int64_t* out_len) {
+    *out_len = 0;
     std::string data;
     if (!read_file(path, data)) return nullptr;
+    if (data.find('\x1e') != std::string::npos ||
+        data.find('\x1f') != std::string::npos) {
+        *out_len = -2;
+        return nullptr;
+    }
 
     std::string out;
     out.reserve(data.size() + data.size() / 8);

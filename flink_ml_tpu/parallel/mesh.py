@@ -37,6 +37,16 @@ def create_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return Mesh(arr, tuple(axes.keys()))
 
 
+def data_parallel_size(mesh: Mesh, axis: str = "data") -> int:
+    """Size of the data-parallel axis — the number of row shards.
+
+    On a multi-axis mesh (e.g. ``('data','model')``) batches shard over the
+    ``data`` axis only (other axes replicate), so packing/layout must use
+    this, not the total device count.
+    """
+    return dict(mesh.shape).get(axis, 1)
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     """Place a host batch pytree on the mesh, sharded along ``axis`` on dim 0.
 

@@ -168,7 +168,11 @@ def _native_lib():
 def _read_csv_cells(path: str, delimiter: str, skip_header: bool, arity: int):
     native = _native_lib()
     if native is not None:
-        return native.read_csv(path, delimiter, skip_header, arity)
+        rows = native.read_csv(path, delimiter, skip_header, arity)
+        if rows is not None:
+            return rows
+        # None: input not representable in the native transport (control
+        # bytes inside quoted cells) — parse it with the pure reader below
     out = []
     with open(path, newline="") as f:
         reader = csv.reader(f, delimiter=delimiter)

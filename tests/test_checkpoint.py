@@ -1,0 +1,182 @@
+"""Checkpoint/resume: interrupted training resumed from a snapshot must
+bit-match an uninterrupted run (deterministic data-order replay)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.iteration.checkpoint import (
+    CheckpointConfig,
+    latest_checkpoint,
+    load_checkpoint,
+    prune_checkpoints,
+    save_checkpoint,
+)
+from flink_ml_tpu.lib import LinearRegression
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.table import Table
+
+
+def make_table(n=120, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 2)
+    y = X @ np.array([1.5, -0.5]) + 1.0
+    schema = Schema.of(("f0", "double"), ("f1", "double"), ("label", "double"))
+    return Table.from_columns(
+        schema, {"f0": X[:, 0], "f1": X[:, 1], "label": y}
+    )
+
+
+def estimator(ckpt_dir=None, max_iter=10):
+    est = (
+        LinearRegression()
+        .set_feature_cols(["f0", "f1"])
+        .set_label_col("label")
+        .set_prediction_col("pred")
+        .set_learning_rate(0.1)
+        .set_max_iter(max_iter)
+    )
+    if ckpt_dir:
+        est.set_checkpoint_dir(str(ckpt_dir))
+    return est
+
+
+class TestCheckpointPrimitives:
+    def test_save_load_roundtrip(self, tmp_path):
+        params = (np.arange(3.0), np.asarray(2.0))
+        save_checkpoint(str(tmp_path), 4, params, meta={"losses": [1.0, 0.5]})
+        path = latest_checkpoint(str(tmp_path))
+        assert path.endswith("epoch_4.npz")
+        loaded, meta = load_checkpoint(path, like=params)
+        np.testing.assert_array_equal(loaded[0], params[0])
+        assert meta["epoch"] == 4 and meta["losses"] == [1.0, 0.5]
+
+    def test_latest_picks_highest_epoch(self, tmp_path):
+        p = (np.zeros(2),)
+        for e in (0, 10, 2):
+            save_checkpoint(str(tmp_path), e, p)
+        assert latest_checkpoint(str(tmp_path)).endswith("epoch_10.npz")
+
+    def test_prune_keeps_newest(self, tmp_path):
+        p = (np.zeros(2),)
+        for e in range(6):
+            save_checkpoint(str(tmp_path), e, p)
+        prune_checkpoints(str(tmp_path), keep=2)
+        names = sorted(os.listdir(str(tmp_path)))
+        assert "epoch_4.npz" in names and "epoch_5.npz" in names
+        assert "epoch_0.npz" not in names
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        save_checkpoint(str(tmp_path), 0, (np.zeros(2),))
+        with pytest.raises(ValueError, match="leaves"):
+            load_checkpoint(
+                latest_checkpoint(str(tmp_path)), like=(np.zeros(2), np.zeros(1))
+            )
+
+
+class TestResumeTraining:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        t = make_table()
+        # uninterrupted 10-epoch run (no checkpointing -> fused path)
+        full = estimator(max_iter=10).fit(t)
+
+        # interrupted: 4 epochs with snapshots, then resume to 10
+        ckpt = tmp_path / "ckpt"
+        part = estimator(ckpt, max_iter=4).fit(t)
+        assert latest_checkpoint(str(ckpt)) is not None
+        resumed = estimator(ckpt, max_iter=10).fit(t)
+
+        assert resumed.train_epochs_ == 10
+        np.testing.assert_allclose(
+            resumed.coefficients(), full.coefficients(), rtol=1e-6
+        )
+        np.testing.assert_allclose(resumed.intercept(), full.intercept(), rtol=1e-6)
+
+    def test_resume_past_max_iter_is_noop(self, tmp_path):
+        t = make_table()
+        ckpt = tmp_path / "ckpt"
+        m1 = estimator(ckpt, max_iter=5).fit(t)
+        m2 = estimator(ckpt, max_iter=3).fit(t)  # already past 3 epochs
+        assert m2.train_epochs_ == 5
+        np.testing.assert_allclose(m2.coefficients(), m1.coefficients())
+
+    def test_checkpoint_interval(self, tmp_path):
+        t = make_table()
+        ckpt = tmp_path / "ckpt"
+        est = estimator(ckpt, max_iter=9).set_checkpoint_interval(3)
+        est.fit(t)
+        epochs = sorted(
+            int(n.split("_")[1].split(".")[0])
+            for n in os.listdir(str(ckpt))
+            if n.endswith(".npz")
+        )
+        assert epochs == [2, 5, 8]
+
+
+class TestSparseCheckpoint:
+    def test_sparse_resume_matches_uninterrupted(self, tmp_path):
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.ops.vector import SparseVector
+        from flink_ml_tpu.table.schema import DataTypes
+
+        rng = np.random.RandomState(0)
+        vecs, ys = [], []
+        for _ in range(120):
+            idx = np.sort(rng.choice(12, 3, replace=False))
+            val = rng.randn(3)
+            vecs.append(SparseVector(12, idx.astype(np.int64), val))
+            ys.append(float(val.sum() > 0))
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR), ("label", "double"))
+        t = Table.from_columns(schema, {"features": vecs, "label": np.asarray(ys)})
+
+        def est(mi, ckpt=None):
+            e = (LogisticRegression().set_vector_col("features")
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_learning_rate(0.5).set_max_iter(mi))
+            if ckpt:
+                e.set_checkpoint_dir(str(ckpt)).set_checkpoint_interval(2)
+            return e
+
+        full = est(8).fit(t)
+        ckpt = tmp_path / "sc"
+        est(4, ckpt).fit(t)
+        assert latest_checkpoint(str(ckpt)) is not None
+        resumed = est(8, ckpt).fit(t)
+        assert resumed.train_epochs_ == 8
+        np.testing.assert_allclose(
+            resumed.coefficients(), full.coefficients(), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestSparseCheckpointTol:
+    def test_tol_stops_checkpointed_sparse_run(self, tmp_path):
+        """Regression: interval=1 chunks used to mask tol convergence."""
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.ops.vector import SparseVector
+        from flink_ml_tpu.table.schema import DataTypes
+
+        rng = np.random.RandomState(4)
+        vecs, ys = [], []
+        for _ in range(150):
+            idx = np.sort(rng.choice(10, 3, replace=False))
+            val = rng.randn(3)
+            vecs.append(SparseVector(10, idx.astype(np.int64), val))
+            ys.append(float(val.sum() > 0))
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR), ("label", "double"))
+        t = Table.from_columns(schema, {"features": vecs, "label": np.asarray(ys)})
+
+        def est(ckpt=None):
+            e = (LogisticRegression().set_vector_col("features")
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_learning_rate(1.0).set_max_iter(400)
+                 .set_tol(1e-4).set_reg(0.1))
+            if ckpt:
+                e.set_checkpoint_dir(str(ckpt))  # default interval = 1
+            return e
+
+        plain = est().fit(t)
+        assert plain.train_epochs_ < 400
+        ckpt = est(tmp_path / "c").fit(t)
+        # converges within one extra epoch of the uncheckpointed run
+        assert abs(ckpt.train_epochs_ - plain.train_epochs_) <= 1

@@ -1,0 +1,80 @@
+"""Feature-dimension (model-axis) sharding: sparse training over a
+('data','model') mesh must match the 1-D data-parallel result exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ml_tpu.lib.common import (
+    pack_sparse_minibatches,
+    train_glm_sparse,
+)
+from flink_ml_tpu.ops.vector import SparseVector
+from flink_ml_tpu.parallel.mesh import create_mesh, default_mesh
+
+
+def sparse_rows(n=200, dim=24, nnz=4, seed=0):
+    rng = np.random.RandomState(seed)
+    true_w = rng.randn(dim)
+    vecs, ys = [], []
+    for _ in range(n):
+        idx = np.sort(rng.choice(dim, nnz, replace=False))
+        val = rng.randn(nnz)
+        x = np.zeros(dim)
+        x[idx] = val
+        vecs.append(SparseVector(dim, idx.astype(np.int64), val))
+        ys.append(float((x @ true_w) > 0))
+    return vecs, np.asarray(ys)
+
+
+def train(mesh, n_dev_data, kind="logistic", max_iter=20, dim=None, vecs=None, ys=None):
+    sstack = pack_sparse_minibatches(vecs, ys, n_dev_data, global_batch_size=64, dim=dim)
+    w0 = jnp.zeros((sstack.dim,), jnp.float32)
+    b0 = jnp.zeros((), jnp.float32)
+    return train_glm_sparse(
+        (w0, b0), sstack, kind, mesh,
+        learning_rate=0.5, max_iter=max_iter,
+    )
+
+
+class TestFeatureSharding:
+    def test_2d_matches_1d(self):
+        vecs, ys = sparse_rows()
+        r1 = train(default_mesh(), 8, vecs=vecs, ys=ys)
+        mesh2 = create_mesh({"data": 2, "model": 4})
+        r2 = train(mesh2, 2, vecs=vecs, ys=ys)
+        # different data-sharding changes minibatch grouping; use the same
+        # grouping for an exact check: data axis 2 in both runs
+        mesh1x2 = create_mesh({"data": 2, "model": 1}, devices=jax.devices()[:2])
+        r1b = train(mesh1x2, 2, vecs=vecs, ys=ys)
+        np.testing.assert_allclose(r2.params[0], r1b.params[0], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r2.params[1], r1b.params[1], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r2.losses, r1b.losses, rtol=1e-5)
+        assert r1.epochs == r2.epochs == 20
+
+    def test_dim_padding_to_model_axis(self):
+        # dim=25 not divisible by model=4 -> padded internally, result trimmed
+        vecs, ys = sparse_rows(dim=25)
+        mesh2 = create_mesh({"data": 2, "model": 4})
+        r = train(mesh2, 2, dim=25, vecs=vecs, ys=ys)
+        assert r.params[0].shape == (25,)
+
+    def test_squared_loss_2d(self):
+        rng = np.random.RandomState(1)
+        dim = 16
+        true_w = rng.randn(dim)
+        vecs, ys = [], []
+        for _ in range(160):
+            idx = np.sort(rng.choice(dim, 3, replace=False))
+            val = rng.randn(3)
+            x = np.zeros(dim)
+            x[idx] = val
+            vecs.append(SparseVector(dim, idx.astype(np.int64), val))
+            ys.append(x @ true_w)
+        ys = np.asarray(ys)
+        mesh2 = create_mesh({"data": 4, "model": 2})
+        r2 = train(mesh2, 4, kind="squared", max_iter=200, vecs=vecs, ys=ys)
+        mesh1 = create_mesh({"data": 4, "model": 1}, devices=jax.devices()[:4])
+        r1 = train(mesh1, 4, kind="squared", max_iter=200, vecs=vecs, ys=ys)
+        np.testing.assert_allclose(r2.params[0], r1.params[0], rtol=1e-4, atol=1e-5)

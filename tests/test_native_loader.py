@@ -102,3 +102,15 @@ class TestLibSvmParity:
         p.write_text("1 notanindex:2\n")
         with pytest.raises(ValueError):
             LibSvmSource(str(p)).read()
+
+
+class TestControlByteFallback:
+    def test_quoted_control_bytes_fall_back_to_python(self, tmp_path):
+        """A 0x1F byte inside a quoted cell is legal CSV; the native
+        transport can't represent it, so the source must fall back."""
+        p = tmp_path / "ctl.csv"
+        p.write_bytes(b'x,name\n1.5,"a\x1fb"\n')
+        schema = Schema.of(("x", "double"), ("name", "string"))
+        rows = CsvSource(str(p), schema, skip_header=True).read().to_rows()
+        assert rows == [(1.5, "a\x1fb")]
+        assert native.read_csv(str(p), ",", False, 2) is None
