@@ -72,6 +72,11 @@ def load_checkpoint(path: str, like) -> Tuple[Any, Dict]:
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    if "epoch" not in meta:
+        # sidecar lost/absent: the epoch is authoritative in the filename
+        m = _NAME_RE.match(os.path.basename(path))
+        if m:
+            meta["epoch"] = int(m.group(1))
     return params, meta
 
 

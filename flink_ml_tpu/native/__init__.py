@@ -6,7 +6,9 @@ and the table sources fall back to pure Python when it is unavailable —
 ``flink_ml_tpu.table.sources._native_lib``:
 
   available() -> bool
-  read_csv(path, delimiter, skip_header, arity) -> list[list[str]]
+  read_csv(path, delimiter, skip_header, arity) -> list[list[str]] | None
+      (None = input not representable in the native transport — control
+      bytes inside quoted cells — caller must fall back to the pure parser)
   read_libsvm(path, n_features, zero_based) -> (labels ndarray, [SparseVector])
 """
 
@@ -36,18 +38,28 @@ def _load():
         _tried = True
         if os.environ.get("FLINK_ML_TPU_NO_NATIVE"):
             return None
-        # always invoke make: the Makefile's dependency tracking makes this a
-        # no-op when the .so is fresh and rebuilds it after loader.cpp edits
+        # rebuild only when the .so is missing or older than its sources — a
+        # cheap mtime stat instead of forking make in every process (which
+        # would also race concurrent builders and always fail in read-only
+        # installs)
+        sources = (os.path.join(_DIR, "loader.cpp"), os.path.join(_DIR, "Makefile"))
         try:
-            subprocess.run(
-                ["make", "-C", _DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
+            stale = not os.path.exists(_SO) or os.path.getmtime(_SO) < max(
+                os.path.getmtime(p) for p in sources
             )
-        except Exception:
-            if not os.path.exists(_SO):
-                return None
+        except OSError:
+            stale = not os.path.exists(_SO)
+        if stale:
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                if not os.path.exists(_SO):
+                    return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:

@@ -1,0 +1,183 @@
+"""Pallas TPU kernels for the hot ops XLA fusion leaves on the table.
+
+The flagship hot loop is the GLM minibatch gradient
+(lib/common.py grad fns): ``g_w = X.T @ err(X @ w + b)``.  :func:`glm_grad`
+tiles rows, keeps each X tile VMEM-resident for both the forward matvec and
+the gradient rank-1 accumulate, and accumulates ``g_w`` in VMEM across the
+sequential grid — one HBM pass over X instead of the two the naive
+two-matmul formulation implies.
+
+Measured on v5e (65536 x 2048 f32): this kernel sustains ~139 GB/s
+effective while XLA's own fusion of the jnp formulation reaches ~182 GB/s —
+XLA already avoids the second X read and pipelines better than the
+straightforward sequential-grid kernel.  The jnp grad fns therefore remain
+the default; this kernel is the drop-in alternative
+(:func:`make_pallas_grad_fn` satisfies the lib/common.py GradFn contract)
+for shapes where manual control wins, and the reference implementation for
+future kernels (double-buffered variants, fused sparse segment ops).
+
+Kernels run ``interpret=True`` off-TPU so the CPU test mesh exercises the
+same code path numerically; :func:`use_pallas` gates the real lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable off-TPU; guard anyway for exotic builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+
+def use_pallas() -> bool:
+    """Real Pallas lowering only on TPU backends (interpret elsewhere)."""
+    return _HAS_PLTPU and jax.default_backend() == "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _glm_grad_kernel(kind: str, x_ref, yw_ref, w_ref, b_ref,
+                     gw_ref, stats_ref):
+    """One row tile: forward matvec + loss stats + gradient accumulate.
+
+    Refs (all VMEM):
+      x_ref     (TM, D)   row tile of features
+      yw_ref    (TM, 2)   [label, sample weight] per row
+      w_ref     (D, 1)    weights (same block every step)
+      b_ref     (1, 1)    intercept
+      gw_ref    (D, 1)    accumulated weight gradient (same block every step)
+      stats_ref (1, 128)  [g_b, loss_sum, w_sum, 0...] accumulators
+    """
+    # zero the cross-tile accumulators on the first sequential grid step
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        gw_ref[...] = jnp.zeros_like(gw_ref)
+        stats_ref[...] = jnp.zeros_like(stats_ref)
+
+    x = x_ref[...]
+    y = yw_ref[..., 0:1]
+    w = yw_ref[..., 1:2]
+    logits = jax.lax.dot_general(
+        x, w_ref[...], (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    logits = logits + b_ref[0, 0]
+    if kind == "logistic":
+        p = jax.nn.sigmoid(logits)
+        err = (p - y) * w
+        loss = jnp.sum(w * (jnp.logaddexp(0.0, logits) - y * logits))
+    else:
+        err = (logits - y) * w
+        loss = 0.5 * jnp.sum(err * (logits - y))
+    # rank-1 accumulate: X tile reused from VMEM — the second HBM pass
+    # the two-matmul formulation would have paid
+    gw_ref[...] += jax.lax.dot_general(
+        x.T, err, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    # build the [g_b, loss, w_sum, 0...] row with an iota mask (dynamic
+    # scatter does not lower in Pallas TPU kernels)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 128), dimension=1)
+    stats = (
+        jnp.where(col == 0, jnp.sum(err), 0.0)
+        + jnp.where(col == 1, loss, 0.0)
+        + jnp.where(col == 2, jnp.sum(w), 0.0)
+    )
+    stats_ref[...] += stats
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kind", "tile_rows", "interpret")
+)
+def glm_grad(x, y, w, wts, b, kind: str = "logistic",
+             tile_rows: int = 512, interpret: bool = False):
+    """Fused GLM minibatch gradient: one HBM pass over ``x``.
+
+    Args: x (n, d), y (n,), w (n,) sample weights, wts (d,), b scalar.
+    Returns (g_w (d,), g_b, loss_sum, w_sum) — identical semantics to the
+    jnp grad fns in lib/regression.py / lib/classification.py.
+    """
+    n, d = x.shape
+    d_pad = _round_up(max(d, 1), 128)
+    # keep the double-buffered X block within the ~16MB VMEM budget
+    vmem_rows = max(8, (6 * 1024 * 1024) // (2 * d_pad * 4))
+    tm = min(tile_rows, _round_up(max(n, 8), 8), _round_up(vmem_rows, 8))
+    n_pad = _round_up(max(n, 1), tm)
+
+    xp = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(x)
+    yw = jnp.zeros((n_pad, 2), jnp.float32)
+    yw = yw.at[:n, 0].set(y.astype(jnp.float32))
+    yw = yw.at[:n, 1].set(w.astype(jnp.float32))  # pad rows weight 0
+    wp = jnp.zeros((d_pad, 1), jnp.float32).at[:d, 0].set(
+        wts.astype(jnp.float32)
+    )
+    bp = jnp.asarray(b, jnp.float32).reshape(1, 1)
+
+    # under shard_map(check_vma=True) outputs must declare how they vary
+    # across mesh axes: they vary wherever any input does.  Operands are
+    # promoted to the same vma (pvary) so in-kernel dots see matching axes.
+    vma = frozenset()
+    for operand in (xp, yw, wp, bp):
+        vma = vma | getattr(getattr(operand, "aval", None), "vma", frozenset())
+
+    def _promote(a):
+        have = getattr(getattr(a, "aval", None), "vma", frozenset())
+        need = vma - have
+        return jax.lax.pvary(a, tuple(need)) if need else a
+
+    xp, yw, wp, bp = (_promote(a) for a in (xp, yw, wp, bp))
+
+    grid = (n_pad // tm,)
+    gw, stats = pl.pallas_call(
+        functools.partial(_glm_grad_kernel, kind),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, d_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tm, 2), lambda i: (i, 0)),
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d_pad, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 128), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d_pad, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(xp, yw, wp, bp)
+    return gw[:d, 0], stats[0, 0], stats[0, 1], stats[0, 2]
+
+
+def make_pallas_grad_fn(kind: str, with_intercept: bool, tile_rows: int = 512):
+    """A drop-in GradFn (lib/common.py contract) backed by :func:`glm_grad`.
+
+    Signature matches the jnp grad factories: (params, x, y, w) ->
+    ((g_w, g_b), loss_sum, w_sum).  Off-TPU the kernel runs interpreted —
+    numerically identical, just slower — so tests cover one code path.
+    """
+    keep_b = 1.0 if with_intercept else 0.0
+    interpret = not use_pallas()
+
+    def grad_fn(params, x, y, w):
+        wts, b = params
+        g_w, g_b, loss_sum, w_sum = glm_grad(
+            x, y, w, wts, b, kind=kind, tile_rows=tile_rows,
+            interpret=interpret,
+        )
+        return (g_w.astype(wts.dtype), (g_b * keep_b).astype(jnp.float32)), \
+            loss_sum, w_sum
+
+    return grad_fn

@@ -1,0 +1,51 @@
+"""Profiling hooks — step tracing the reference never had (SURVEY.md §5.1).
+
+The reference exposes no in-library tracing (Flink's web UI was the only
+observability); here ``jax.profiler`` integration is first-class: wrap any
+training/inference call in :func:`trace` to capture a TensorBoard-loadable
+device trace, or annotate phases with :func:`annotate` so step boundaries
+show up in the timeline.  Pure context managers — zero overhead when unused.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device profile into ``log_dir`` (TensorBoard format)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in the profiler timeline (StepTraceAnnotation analog)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def timed(label: str, sink=None) -> Iterator[None]:
+    """Wall-clock timing of a host-side phase; ``sink(label, seconds)``
+    receives the result (default: stored on the function attribute
+    ``timed.last`` for ad-hoc use)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        timed.last = (label, dt)
+        if sink is not None:
+            sink(label, dt)
+
+
+timed.last = None

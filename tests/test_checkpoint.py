@@ -180,3 +180,13 @@ class TestSparseCheckpointTol:
         ckpt = est(tmp_path / "c").fit(t)
         # converges within one extra epoch of the uncheckpointed run
         assert abs(ckpt.train_epochs_ - plain.train_epochs_) <= 1
+
+
+def test_missing_meta_sidecar_derives_epoch_from_filename(tmp_path):
+    """Regression: a snapshot without its .meta.json must still resume."""
+    params = (np.arange(4.0),)
+    path = save_checkpoint(str(tmp_path), 6, params)
+    os.remove(path + ".meta.json")
+    loaded, meta = load_checkpoint(latest_checkpoint(str(tmp_path)), like=params)
+    assert meta["epoch"] == 6
+    np.testing.assert_array_equal(loaded[0], params[0])

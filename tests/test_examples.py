@@ -1,0 +1,57 @@
+"""Example end-to-end fixture tests — the ITCase analog (SURVEY.md §4):
+run each example's main() and compare its behavior against expected
+characteristics (seeded, so deterministic)."""
+
+import io
+import re
+import sys
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+
+def run_main(module, argv=None):
+    old_argv = sys.argv
+    sys.argv = [module.__name__] + (argv or [])
+    buf = io.StringIO()
+    try:
+        with redirect_stdout(buf):
+            module.main()
+    finally:
+        sys.argv = old_argv
+    return buf.getvalue()
+
+
+class TestLinearRegressionExample:
+    def test_fits_the_reference_line(self):
+        from examples import linear_regression
+
+        out = run_main(linear_regression, ["--iterations", "300"])
+        # dataset is y = 2x + 1; the example prints the fitted line
+        m = re.search(r"fitted: y = ([-\d.]+) \+ ([-\d.]+) \* x", out)
+        assert m, out[:200]
+        theta0, theta1 = float(m.group(1)), float(m.group(2))
+        assert abs(theta1 - 2.0) < 0.1
+        # per-point table printed like the reference's result.print()
+        assert out.count("pred=") == 21
+
+    def test_predictions_track_labels(self):
+        from examples import linear_regression
+
+        out = run_main(linear_regression, ["--iterations", "300"])
+        rows = re.findall(r"y=\s*([-\d.]+)\s+pred=\s*([-\d.]+)", out)
+        assert len(rows) == 21
+        err = [abs(float(y) - float(p)) for y, p in rows]
+        assert np.mean(err) < 1.5
+
+
+class TestIncrementalLearningExample:
+    def test_streaming_topology_runs(self):
+        from examples import incremental_learning
+
+        out = run_main(incremental_learning)
+        m = re.search(r"windows fired: (\d+)", out)
+        assert m and int(m.group(1)) == 20  # 2000 records / 100-per-window
+        m = re.search(r"accuracy ([\d.]+)", out)
+        assert m and float(m.group(1)) > 0.9

@@ -1,0 +1,93 @@
+"""Pallas kernel numerics (interpret mode on the CPU test mesh) and
+integration as a drop-in GradFn in the training harness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops.pallas_kernels import glm_grad, make_pallas_grad_fn
+
+
+def data(n=300, d=28, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, d), jnp.float32)
+    y = jnp.asarray((rng.randn(n) > 0), jnp.float32)
+    w = jnp.asarray((rng.rand(n) > 0.1), jnp.float32)  # some zero weights
+    wts = jnp.asarray(rng.randn(d), jnp.float32)
+    b = jnp.asarray(0.3, jnp.float32)
+    return x, y, w, wts, b
+
+
+class TestGlmGradKernel:
+    @pytest.mark.parametrize("kind", ["logistic", "squared"])
+    def test_matches_jnp_reference(self, kind):
+        x, y, w, wts, b = data()
+        gw, gb, loss, wsum = glm_grad(x, y, w, wts, b, kind=kind, interpret=True)
+        logits = x @ wts + b
+        if kind == "logistic":
+            err = (jax.nn.sigmoid(logits) - y) * w
+            ref_loss = jnp.sum(w * (jnp.logaddexp(0.0, logits) - y * logits))
+        else:
+            err = (logits - y) * w
+            ref_loss = 0.5 * jnp.sum(err * (logits - y))
+        np.testing.assert_allclose(gw, x.T @ err, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(gb, err.sum(), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-4)
+        np.testing.assert_allclose(wsum, w.sum(), rtol=1e-6)
+
+    def test_row_padding_is_neutral(self):
+        """n not a multiple of the tile: padded rows must contribute nothing."""
+        x, y, w, wts, b = data(n=130)
+        gw_a, *_ = glm_grad(x, y, w, wts, b, interpret=True, tile_rows=64)
+        gw_b, *_ = glm_grad(x, y, w, wts, b, interpret=True, tile_rows=512)
+        np.testing.assert_allclose(gw_a, gw_b, rtol=1e-5, atol=1e-5)
+
+    def test_wide_d_tile_shrinks_to_vmem_budget(self):
+        x, y, w, wts, b = data(n=64, d=3000)
+        gw, *_ = glm_grad(x, y, w, wts, b, interpret=True)
+        logits = x @ wts + b
+        err = (jax.nn.sigmoid(logits) - y) * w
+        np.testing.assert_allclose(gw, x.T @ err, rtol=2e-3, atol=2e-3)
+
+
+class TestPallasGradFnIntegration:
+    def test_grad_fn_contract(self):
+        """make_pallas_grad_fn satisfies the GradFn contract numerically."""
+        x, y, w, wts, b = data()
+        grad_fn = make_pallas_grad_fn("logistic", with_intercept=True)
+        (g_w, g_b), loss, wsum = grad_fn((wts, b), x, y, w)
+        logits = x @ wts + b
+        err = (jax.nn.sigmoid(logits) - y) * w
+        np.testing.assert_allclose(g_w, x.T @ err, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(g_b, err.sum(), rtol=1e-4, atol=1e-4)
+
+        no_b = make_pallas_grad_fn("logistic", with_intercept=False)
+        (_, g_b0), *_ = no_b((wts, b), x, y, w)
+        assert float(g_b0) == 0.0
+
+    @pytest.mark.skipif(
+        jax.default_backend() != "tpu",
+        reason="interpret-mode Pallas inside strict shard_map hits JAX-"
+        "internal vma limits; the real Mosaic lowering works (verified on "
+        "v5e) — run this on a TPU backend",
+    )
+    def test_trains_through_harness_on_tpu(self):
+        """make_pallas_grad_fn drops into train_glm and converges."""
+        from flink_ml_tpu.lib.common import pack_minibatches, train_glm
+        from flink_ml_tpu.parallel.mesh import default_mesh
+
+        rng = np.random.RandomState(1)
+        X = rng.randn(160, 4)
+        true_w = np.array([1.0, -2.0, 0.5, 0.0])
+        y = ((X @ true_w) > 0).astype(np.float64)
+        mesh = default_mesh()
+        stack = pack_minibatches(X, y, jax.device_count())
+        grad_fn = make_pallas_grad_fn("logistic", with_intercept=True)
+        result = train_glm(
+            (jnp.zeros((4,), jnp.float32), jnp.zeros((), jnp.float32)),
+            stack, grad_fn, mesh, learning_rate=0.5, max_iter=60,
+        )
+        w, b = result.params
+        preds = (X @ w + b) > 0
+        assert np.mean(preds == y) > 0.9

@@ -237,3 +237,13 @@ def test_output_cols_reserved_case_insensitive():
     schema = Schema.of(("f0", "double"), ("label", "double"))
     helper = OutputColsHelper(schema, ["out"], ["double"], reserved_col_names=["Label"])
     assert helper.get_result_schema().field_names == ["label", "out"]
+
+
+def test_tracing_helpers():
+    from flink_ml_tpu.utils.tracing import annotate, timed
+
+    calls = []
+    with timed("phase", sink=lambda l, s: calls.append((l, s))):
+        with annotate("step"):
+            pass
+    assert calls and calls[0][0] == "phase" and calls[0][1] >= 0
