@@ -405,8 +405,7 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     params, loss_hist, epochs, delta = train_fn(placed, device_batch)
     leaves, treedef = jax.tree_util.tree_flatten(params)
     fetched = fetch_flat(
-        *leaves, loss_hist, jnp.asarray(epochs, jnp.float64),
-        jnp.asarray(delta, jnp.float64),
+        *leaves, loss_hist, jnp.asarray(epochs), jnp.asarray(delta)
     )
     n_epochs = int(fetched[-2])
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
@@ -699,12 +698,15 @@ def fetch_flat(*arrays):
     """Fetch device arrays in ONE transfer (concatenated flat), then split.
 
     Per-array device->host reads each pay a full round-trip on tunneled
-    backends; bundling them makes the readback latency constant.
+    backends; bundling them makes the readback latency constant.  The fetch
+    dtype follows the backend: f64 only when x64 is enabled (CPU test mesh) —
+    requesting f64 on TPU would just truncate to f32 with a warning per call.
     """
+    fetch_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     shapes = [a.shape for a in arrays]
     sizes = [int(np.prod(s)) for s in shapes]
     flat = jnp.concatenate(
-        [jnp.ravel(a).astype(jnp.float64) for a in arrays]
+        [jnp.ravel(a).astype(fetch_dtype) for a in arrays]
     )
     buf = np.asarray(flat)
     out = []
