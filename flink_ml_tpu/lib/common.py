@@ -115,6 +115,26 @@ def pack_minibatches(
 GradFn = Callable
 
 
+def make_sgd_update(learning_rate: float, l2: float):
+    """``update(params, grads, count)``: one SGD step with L2 weight decay.
+
+    Weight decay skips scalar leaves (the intercept) — the sklearn/Spark
+    convention of not regularizing the bias term.  Shared by every training
+    path (dense/sparse fused loops, epoch step, streaming SGD) so the update
+    rule cannot drift between them.
+    """
+    lr = float(learning_rate)
+    l2 = float(l2)
+
+    def update(params, grads, count):
+        return jax.tree_util.tree_map(
+            lambda pi, gi: pi - lr * (gi / count + (l2 if pi.ndim else 0.0) * pi),
+            params, grads,
+        )
+
+    return update
+
+
 @dataclass
 class SparseMinibatchStack:
     """Device-major sparse minibatches in padded segment-CSR layout.
@@ -215,7 +235,27 @@ def pack_sparse_minibatches(
 # shard_map per fit would force a fresh XLA compile every time (~1s), which
 # dominates short training runs.  Keyed on (grad_fn, mesh, lr, reg) — grad-fn
 # factories are memoized by their hyper-flags so equal configs hit the cache.
-_EPOCH_STEP_CACHE: dict = {}
+# LRU-bounded: long-lived processes sweeping hyperparameters (or chunked
+# checkpoint runs with varying chunk sizes) would otherwise retain every
+# compiled executable forever.
+from collections import OrderedDict
+
+_EPOCH_STEP_CACHE: OrderedDict = OrderedDict()
+_EPOCH_STEP_CACHE_CAPACITY = 32
+
+
+def _cache_get(key):
+    fn = _EPOCH_STEP_CACHE.get(key)
+    if fn is not None:
+        _EPOCH_STEP_CACHE.move_to_end(key)
+    return fn
+
+
+def _cache_put(key, fn):
+    _EPOCH_STEP_CACHE[key] = fn
+    while len(_EPOCH_STEP_CACHE) > _EPOCH_STEP_CACHE_CAPACITY:
+        _EPOCH_STEP_CACHE.popitem(last=False)
+    return fn
 
 
 def make_glm_epoch_step(
@@ -233,11 +273,10 @@ def make_glm_epoch_step(
     the epoch's total parameter update (the convergence criterion).
     """
     key = (grad_fn, mesh, float(learning_rate), float(reg))
-    cached = _EPOCH_STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
-    lr = float(learning_rate)
-    l2 = float(reg)
+    sgd_update = make_sgd_update(learning_rate, reg)
 
     def local_epoch(params, batch):
         x, y, w = batch  # local: (steps, mb, d), (steps, mb), (steps, mb)
@@ -249,9 +288,7 @@ def make_glm_epoch_step(
             loss_sum = psum(loss_sum, "data")
             w_sum = psum(w_sum, "data")
             count = jnp.maximum(w_sum, 1.0)
-            new_p = jax.tree_util.tree_map(
-                lambda pi, gi: pi - lr * (gi / count + l2 * pi), p, grads
-            )
+            new_p = sgd_update(p, grads, count)
             return new_p, (loss_sum / count, w_sum)
 
         start = params
@@ -270,9 +307,7 @@ def make_glm_epoch_step(
         )
         return params, (loss, delta)
 
-    step = make_data_parallel_step(local_epoch, mesh)
-    _EPOCH_STEP_CACHE[key] = step
-    return step
+    return _cache_put(key, make_data_parallel_step(local_epoch, mesh))
 
 
 @dataclass
@@ -313,11 +348,10 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
     ``delta_fn(params, start)`` overrides the convergence norm when params
     are sharded.
     """
-    cached = _EPOCH_STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
-    lr = float(learning_rate)
-    l2 = float(reg)
+    sgd_update = make_sgd_update(learning_rate, reg)
     tol_ = float(tol)
 
     def local_train(params, batch):
@@ -327,9 +361,7 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
             loss_sum = psum(loss_sum, "data")
             w_sum = psum(w_sum, "data")
             count = jnp.maximum(w_sum, 1.0)
-            new_p = jax.tree_util.tree_map(
-                lambda pi, gi: pi - lr * (gi / count + l2 * pi), p, grads
-            )
+            new_p = sgd_update(p, grads, count)
             return new_p, (loss_sum / count, w_sum)
 
         def run_epoch(params):
@@ -383,9 +415,7 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
         ),
         check_vma=True,
     )
-    fn = jax.jit(sharded, donate_argnums=(0,))
-    _EPOCH_STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
 
 
 def _run_fused_train(train_fn, init_params, batch, mesh,
@@ -673,6 +703,11 @@ def train_glm_sparse(
         params, meta = load_checkpoint(latest, like=init_params)
         start_epoch = int(meta["epoch"]) + 1
         losses = list(meta.get("losses", []))
+        if _meta_converged(meta, tol) or start_epoch >= max_iter:
+            # the stored run already finished — re-fitting must not run extra
+            # epochs (the fused while_loop always executes a chunk's epoch 0,
+            # which would drift from the uninterrupted result)
+            return TrainResult(params=params, epochs=start_epoch, losses=losses)
     from flink_ml_tpu.parallel.mesh import shard_batch
 
     device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
@@ -682,16 +717,30 @@ def train_glm_sparse(
         params = r.params
         losses.extend(r.losses)
         start_epoch += r.epochs
+        converged = r.epochs < chunk or (  # mid-chunk, or exactly at boundary
+            tol > 0.0 and r.final_delta is not None and r.final_delta <= tol
+        )
         save_checkpoint(
             checkpoint.directory, start_epoch - 1, params,
-            meta={"losses": losses},
+            meta={"losses": losses, "converged": converged, "tol": tol},
         )
         prune_checkpoints(checkpoint.directory, checkpoint.keep)
-        if r.epochs < chunk:
-            break  # converged mid-chunk (tol)
-        if tol > 0.0 and r.final_delta is not None and r.final_delta <= tol:
-            break  # converged exactly at a chunk boundary
+        if converged:
+            break
     return TrainResult(params=params, epochs=start_epoch, losses=losses)
+
+
+def _meta_converged(meta: dict, tol: float) -> bool:
+    """Does a checkpoint's recorded convergence satisfy the CURRENT tol?
+
+    A run stamped converged at a looser tolerance must keep training when
+    re-fit with a tighter (or zero) tol, so the early return fires only when
+    the stored criterion is at least as strict as the requested one.
+    """
+    if not meta.get("converged") or tol <= 0.0:
+        return False
+    stored_tol = float(meta.get("tol") or 0.0)
+    return 0.0 < stored_tol <= tol
 
 
 def fetch_flat(*arrays):
@@ -762,7 +811,9 @@ def train_glm(
             init_params, meta = load_checkpoint(latest, like=init_params)
             start_epoch = int(meta["epoch"]) + 1
             losses = list(meta.get("losses", []))
-            if start_epoch >= max_iter:
+            if _meta_converged(meta, tol) or start_epoch >= max_iter:
+                # finished run (max epochs or recorded tol convergence at
+                # this-or-stricter tolerance): re-fitting runs nothing more
                 return TrainResult(
                     params=jax.tree_util.tree_map(np.asarray, init_params),
                     epochs=start_epoch,
@@ -774,13 +825,16 @@ def train_glm(
     params0 = replicate(mesh, init_params)
     converted: list = list(losses)  # float prefix (resumed history)
 
+    tol_converged = [False]  # last epoch's delta <= tol (for the final stamp)
+
     def body(params, inputs, epoch):
         new_params, (loss, delta) = epoch_step(params, inputs["batch"])
         criteria = None
         if tol > 0.0:
             # convergence needs the value on host: one readback per epoch —
             # the device-friendly "criteria stream empty" check
-            criteria = [1] if float(delta) > tol else []
+            tol_converged[0] = float(delta) <= tol
+            criteria = [] if tol_converged[0] else [1]
         # keep the loss as a device value: converting here would sync every
         # epoch and collapse the async dispatch pipeline
         losses.append(loss)
@@ -817,10 +871,26 @@ def train_glm(
         listeners=listeners,
     )
     final = jax.tree_util.tree_map(np.asarray, result.final_variables)
+    total_epochs = start_epoch + result.epochs_run
+    float_losses = [float(x) for x in losses]
+    if checkpoint is not None and tol_converged[0]:
+        # terminated by tol (including convergence landing exactly on the
+        # final permitted epoch): stamp the final state as converged so a
+        # re-fit resumes to a no-op instead of running extra epochs
+        from flink_ml_tpu.iteration.checkpoint import (
+            prune_checkpoints,
+            save_checkpoint,
+        )
+
+        save_checkpoint(
+            checkpoint.directory, total_epochs - 1, final,
+            meta={"losses": float_losses, "converged": True, "tol": tol},
+        )
+        prune_checkpoints(checkpoint.directory, checkpoint.keep)
     return TrainResult(
         params=final,
-        epochs=start_epoch + result.epochs_run,
-        losses=[float(x) for x in losses],
+        epochs=total_epochs,
+        losses=float_losses,
     )
 
 

@@ -23,11 +23,29 @@ import numpy as np
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.iteration.unbounded import StreamingDriver, StreamingResult
 from flink_ml_tpu.lib.classification import LogisticRegressionModel, _log_loss_grads
-from flink_ml_tpu.lib.common import bucket_rows, resolve_features
+from flink_ml_tpu.lib.common import bucket_rows, make_sgd_update, resolve_features
 from flink_ml_tpu.lib.glm import GlmTrainParams, make_model_table
 from flink_ml_tpu.lib.params import HasWindowMs
 from flink_ml_tpu.table.sources import UnboundedSource
 from flink_ml_tpu.table.table import Table
+
+
+class _PeekedSource(UnboundedSource):
+    """Re-yields a record peeked off a single-pass source, then the remainder
+    of the SAME iterator — nothing is lost to the dim probe.  One-shot:
+    ``stream()`` may only be consumed once (like the source it wraps)."""
+
+    def __init__(self, first, rest, inner: UnboundedSource):
+        self._first = first
+        self._rest = rest
+        self._inner = inner
+
+    def stream(self):
+        yield self._first
+        yield from self._rest
+
+    def schema(self):
+        return self._inner.schema()
 
 
 class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
@@ -57,16 +75,26 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
         Xp[:n], yp[:n], wp[:n] = X, y, 1.0
         return Xp, yp, wp
 
-    def _infer_dim(self, source: UnboundedSource) -> int:
+    def _infer_dim(self, source: UnboundedSource) -> Tuple[int, UnboundedSource]:
+        """Feature dim + the source to actually train from.
+
+        When the dim comes from peeking the first record, the peeked record is
+        buffered and re-yielded ahead of the same iterator — the UnboundedSource
+        contract does not promise ``stream()`` is re-iterable, and a
+        single-pass source (socket/queue-backed) must not lose its first
+        training record to the probe.
+        """
         if self.get_feature_cols() is not None:
-            return len(self.get_feature_cols())
-        # peek the first training record's vector size
-        for _, row in source.stream():
-            schema = source.schema()
-            i = schema.find_col_index(self.get_vector_col())
-            v = row[i]
-            return v.size() if v.size() >= 0 else v.to_dense().size()
-        raise ValueError("empty training stream; cannot infer feature dim")
+            return len(self.get_feature_cols()), source
+        it = iter(source.stream())
+        try:
+            first = next(it)
+        except StopIteration:
+            raise ValueError("empty training stream; cannot infer feature dim")
+        i = source.schema().find_col_index(self.get_vector_col())
+        v = first[1][i]
+        dim = v.size() if v.size() >= 0 else v.to_dense().size()
+        return dim, _PeekedSource(first, it, source)
 
     # -- streaming fit -------------------------------------------------------
 
@@ -77,18 +105,17 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
         max_windows: Optional[int] = None,
         keep_model_history: bool = False,
     ) -> Tuple[LogisticRegressionModel, StreamingResult]:
-        self._dim = self._infer_dim(training_source)
+        self._dim, training_source = self._infer_dim(training_source)
         lr = self.get_learning_rate()
         reg = self.get_reg()
         grad_fn = _log_loss_grads(self.get_with_intercept())
 
+        sgd_update = make_sgd_update(lr, reg)
+
         @jax.jit
         def sgd_step(params, x, y, w):
             grads, _, w_sum = grad_fn(params, x, y, w)
-            count = jnp.maximum(w_sum, 1.0)
-            return jax.tree_util.tree_map(
-                lambda p, g: p - lr * (g / count + reg * p), params, grads
-            )
+            return sgd_update(params, grads, jnp.maximum(w_sum, 1.0))
 
         @jax.jit
         def score(params, x):

@@ -161,21 +161,25 @@ def glm_grad(x, y, w, wts, b, kind: str = "logistic",
     return gw[:d, 0], stats[0, 0], stats[0, 1], stats[0, 2]
 
 
+@functools.lru_cache(maxsize=None)
 def make_pallas_grad_fn(kind: str, with_intercept: bool, tile_rows: int = 512):
     """A drop-in GradFn (lib/common.py contract) backed by :func:`glm_grad`.
 
     Signature matches the jnp grad factories: (params, x, y, w) ->
     ((g_w, g_b), loss_sum, w_sum).  Off-TPU the kernel runs interpreted —
     numerically identical, just slower — so tests cover one code path.
+
+    Memoized on the hyper-flags (like the jnp grad factories): downstream
+    compiled-step caches key on grad-fn identity, so a fresh closure per call
+    would force a recompile of the whole fused training program every fit.
     """
     keep_b = 1.0 if with_intercept else 0.0
-    interpret = not use_pallas()
 
     def grad_fn(params, x, y, w):
         wts, b = params
         g_w, g_b, loss_sum, w_sum = glm_grad(
             x, y, w, wts, b, kind=kind, tile_rows=tile_rows,
-            interpret=interpret,
+            interpret=not use_pallas(),  # at trace time: current backend
         )
         return (g_w.astype(wts.dtype), (g_b * keep_b).astype(jnp.float32)), \
             loss_sum, w_sum

@@ -190,3 +190,86 @@ def test_missing_meta_sidecar_derives_epoch_from_filename(tmp_path):
     loaded, meta = load_checkpoint(latest_checkpoint(str(tmp_path)), like=params)
     assert meta["epoch"] == 6
     np.testing.assert_array_equal(loaded[0], params[0])
+
+
+class TestConvergedResume:
+    def _sparse_table(self, seed=4):
+        from flink_ml_tpu.ops.vector import SparseVector
+        from flink_ml_tpu.table.schema import DataTypes
+
+        rng = np.random.RandomState(seed)
+        vecs, ys = [], []
+        for _ in range(150):
+            idx = np.sort(rng.choice(10, 3, replace=False))
+            val = rng.randn(3)
+            vecs.append(SparseVector(10, idx.astype(np.int64), val))
+            ys.append(float(val.sum() > 0))
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR), ("label", "double"))
+        return Table.from_columns(schema, {"features": vecs, "label": np.asarray(ys)})
+
+    def test_sparse_refit_after_convergence_is_noop(self, tmp_path):
+        """Regression: re-fitting a tol-converged checkpointed run used to
+        execute at least one extra epoch per invocation (the fused while_loop
+        always runs a chunk's epoch 0), drifting from the uninterrupted run."""
+        from flink_ml_tpu.lib import LogisticRegression
+
+        t = self._sparse_table()
+
+        def est():
+            return (LogisticRegression().set_vector_col("features")
+                    .set_label_col("label").set_prediction_col("p")
+                    .set_learning_rate(1.0).set_max_iter(400)
+                    .set_tol(1e-4).set_reg(0.1)
+                    .set_checkpoint_dir(str(tmp_path / "c")))
+
+        first = est().fit(t)
+        assert first.train_epochs_ < 400  # converged by tol
+        again = est().fit(t)
+        assert again.train_epochs_ == first.train_epochs_
+        np.testing.assert_array_equal(again.coefficients(), first.coefficients())
+
+    def test_dense_refit_after_convergence_is_noop(self, tmp_path):
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.ops.vector import DenseVector
+        from flink_ml_tpu.table.schema import DataTypes
+
+        rng = np.random.RandomState(1)
+        X = rng.randn(160, 4)
+        y = (X @ np.array([1.0, -2.0, 0.5, 1.5]) > 0).astype(np.float64)
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+        t = Table.from_columns(
+            schema,
+            {"features": [DenseVector(r) for r in X], "label": y},
+        )
+
+        def est():
+            return (LogisticRegression().set_vector_col("features")
+                    .set_label_col("label").set_prediction_col("p")
+                    .set_learning_rate(1.0).set_max_iter(400)
+                    .set_tol(1e-4).set_reg(0.1)
+                    .set_checkpoint_dir(str(tmp_path / "d")))
+
+        first = est().fit(t)
+        assert first.train_epochs_ < 400
+        again = est().fit(t)
+        assert again.train_epochs_ == first.train_epochs_
+        np.testing.assert_array_equal(again.coefficients(), first.coefficients())
+
+    def test_refit_with_tighter_tol_keeps_training(self, tmp_path):
+        """A run stamped converged at a loose tol must keep training when
+        re-fit with a stricter tol instead of early-returning stale params."""
+        from flink_ml_tpu.lib import LogisticRegression
+
+        t = self._sparse_table()
+
+        def est(tol):
+            return (LogisticRegression().set_vector_col("features")
+                    .set_label_col("label").set_prediction_col("p")
+                    .set_learning_rate(1.0).set_max_iter(400)
+                    .set_tol(tol).set_reg(0.1)
+                    .set_checkpoint_dir(str(tmp_path / "t")))
+
+        loose = est(1e-2).fit(t)
+        assert loose.train_epochs_ < 400
+        tight = est(1e-5).fit(t)
+        assert tight.train_epochs_ > loose.train_epochs_

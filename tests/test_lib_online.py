@@ -84,3 +84,29 @@ class TestOnlineLogisticRegression:
             Table.from_rows([(DenseVector(x),) for x in X], QSCHEMA)
         )
         assert np.mean((probs > 0.5) == (y == 1)) > 0.88
+
+
+class TestSinglePassSource:
+    def test_dim_probe_keeps_first_record(self):
+        """Regression: _infer_dim peeks the first record off the stream; a
+        single-pass (non-re-iterable) source must not lose it to the probe."""
+        from flink_ml_tpu.table.sources import UnboundedSource
+
+        rows, X, y = stream_rows(40, seed=3)
+
+        class OneShotSource(UnboundedSource):
+            def __init__(self):
+                self.calls = 0
+
+            def stream(self):
+                self.calls += 1
+                assert self.calls == 1, "stream() consumed more than once"
+                return ((i * 50, rows[i]) for i in range(len(rows)))
+
+            def schema(self):
+                return SCHEMA
+
+        model, result = make_estimator().fit_unbounded(OneShotSource())
+        # all 40 rows trained: 20 rows / 1000ms window -> 2 windows
+        assert result.windows_fired == 2
+        assert model.coefficients().shape == (3,)
