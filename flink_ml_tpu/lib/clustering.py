@@ -26,7 +26,7 @@ from flink_ml_tpu.iteration.bounded import (
     iterate_bounded,
 )
 from flink_ml_tpu.iteration.config import IterationConfig
-from flink_ml_tpu.lib.common import apply_batched, resolve_features
+from flink_ml_tpu.lib.common import apply_batched, apply_sharded, resolve_features
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
     HasFeatureColsDefaultAsNull,
@@ -71,8 +71,7 @@ def _pairwise_sq_dists(x, c):
     return jnp.maximum(x2 - 2.0 * (x @ c.T) + c2, 0.0)
 
 
-# module-level so the jit cache survives across mapper instances
-@jax.jit
+# module-level + memoized so the jit cache survives across mapper instances
 def _assign_fn(x, c):
     d = _pairwise_sq_dists(x, c)
     return jnp.stack(
@@ -80,6 +79,18 @@ def _assign_fn(x, c):
          jnp.min(d, axis=1).astype(jnp.float64)],
         axis=1,
     )
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _assign_apply(mesh):
+    """Mesh-sharded assignment: rows over 'data', centroids replicated
+    (plain jit on a single chip)."""
+    from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
+
+    return make_data_parallel_apply(_assign_fn, mesh, n_args=2)
 
 
 def kmeans_plus_plus(X: np.ndarray, k: int, rng: np.random.RandomState) -> np.ndarray:
@@ -132,7 +143,7 @@ class KMeansModelMapper(ModelMapper):
         X, _ = resolve_features(batch, model, dim=int(self._centroids.shape[1]))
         X = X.astype(np.float32)
         n = X.shape[0]
-        both = apply_batched(_assign_fn, X, self._centroids)
+        both = apply_sharded(_assign_apply, X, self._centroids)
         out = {model.get_prediction_col(): both[:n, 0].astype(np.int64)}
         detail = model.get_prediction_detail_col()
         if detail is not None:

@@ -894,6 +894,26 @@ def train_glm(
     )
 
 
+def apply_sharded(apply_factory, X: np.ndarray, *args, bucket_minimum: int = 256):
+    """Run a mesh-sharded model apply over the default environment's mesh.
+
+    ``apply_factory(mesh)`` returns the (memoized) row-aligned device fn for
+    that mesh (built via
+    :func:`~flink_ml_tpu.parallel.collectives.make_data_parallel_apply`);
+    rows pad to a multiple of the data-axis size so the shard_map sees equal
+    shards.  The single shared entry point for every ModelMapper hot path.
+    """
+    from flink_ml_tpu.parallel.mesh import data_parallel_size
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    mesh = MLEnvironmentFactory.get_default().get_mesh()
+    return apply_batched(
+        apply_factory(mesh), X, *args,
+        bucket_minimum=bucket_minimum,
+        row_multiple=data_parallel_size(mesh),
+    )
+
+
 def bucket_rows(n: int, minimum: int = 256) -> int:
     """Next power-of-two row count >= n (bounds the jit cache for inference)."""
     b = minimum
@@ -902,15 +922,22 @@ def bucket_rows(n: int, minimum: int = 256) -> int:
     return b
 
 
-def apply_batched(fn, X: np.ndarray, *args, bucket_minimum: int = 256) -> np.ndarray:
+def apply_batched(
+    fn, X: np.ndarray, *args, bucket_minimum: int = 256, row_multiple: int = 1
+) -> np.ndarray:
     """Run a jitted row function over X padded to a power-of-two bucket.
 
     ``fn(x_padded, *args)`` must be row-aligned; the result is sliced back to
     the true row count.  Padding rows are zeros.  A 0-row input still runs one
     padded bucket so the output keeps fn's true rank (sliced to 0 rows).
+    ``row_multiple`` rounds the bucket up so mesh-sharded applies
+    (:func:`~flink_ml_tpu.parallel.collectives.make_data_parallel_apply`)
+    always see a row count divisible by the data-axis size.
     """
     n = X.shape[0]
     b = bucket_rows(max(n, 1), bucket_minimum)
+    if row_multiple > 1:
+        b = -(-b // row_multiple) * row_multiple
     if b != n:
         Xp = np.zeros((b,) + X.shape[1:], dtype=X.dtype)
         Xp[:n] = X

@@ -25,6 +25,7 @@ from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
     apply_batched,
+    apply_sharded,
     pack_minibatches,
     pack_sparse_minibatches,
     resolve_features,
@@ -110,11 +111,23 @@ def make_model_table(weights: np.ndarray, intercept: float) -> Table:
     )
 
 
-# module-level so the jit cache is shared across mapper instances — a fresh
-# jit() per load_model would recompile on every transform call
-@jax.jit
+# module-level + memoized so the jit cache is shared across mapper instances —
+# a fresh jit() per load_model would recompile on every transform call
 def _score_fn(x, w, b):
     return x @ w + b
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _score_apply(mesh):
+    """Mesh-sharded scorer: query rows over the 'data' axis, model replicated
+    (the ModelMapperAdapter.java:53-61 parallel-apply analog; plain jit on a
+    single chip)."""
+    from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
+
+    return make_data_parallel_apply(_score_fn, mesh, n_args=3)
 
 
 @jax.jit
@@ -166,7 +179,7 @@ class LinearScoreMapper(ModelMapper):
             )
             return np.asarray(_sparse_score_fn(padded, self._w, self._b))[:n]
         X, _ = resolve_features(batch, model, dim=int(self._w.shape[0]))
-        return apply_batched(_score_fn, X.astype(np.float32), self._w, self._b)
+        return apply_sharded(_score_apply, X.astype(np.float32), self._w, self._b)
 
 
 class GlmEstimatorBase(Estimator, GlmTrainParams):

@@ -13,7 +13,7 @@ O(batch × chunk) instead of O(batch × train).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -22,7 +22,7 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
-from flink_ml_tpu.lib.common import apply_batched, resolve_features
+from flink_ml_tpu.lib.common import apply_sharded, resolve_features
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
     HasFeatureColsDefaultAsNull,
@@ -88,6 +88,25 @@ def _knn_chunked(xq, xt, yt, k, chunk):
     return best_y, best_d
 
 
+@lru_cache(maxsize=32)
+def _knn_apply(mesh, k, chunk, n_classes):
+    """Mesh-sharded kNN transform: query rows shard over 'data', the training
+    set (the model) replicates to every device — the broadcast-variable
+    analog (ModelMapperAdapter.java:53-61) for the benchmark transform
+    workload.  Plain jit on a single chip."""
+    from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
+
+    def forward(xq, xt, yt):
+        labels, dists = _knn_chunked(xq, xt, yt, k, chunk)
+        pred = _majority_vote(labels.astype(jnp.int32), dists, n_classes)
+        return jnp.concatenate(
+            [pred[:, None].astype(jnp.float64), dists.astype(jnp.float64)],
+            axis=1,
+        )
+
+    return make_data_parallel_apply(forward, mesh, n_args=3)
+
+
 @partial(jax.jit, static_argnums=(2,))
 def _majority_vote(labels, dists, n_classes):
     """Mode of each row of integer class ids via one-hot sum (ties -> lowest id).
@@ -146,17 +165,10 @@ class KnnModelMapper(ModelMapper):
         X, _ = resolve_features(batch, model, dim=int(self._xt.shape[1]))
         X = X.astype(np.float32)
         n = X.shape[0]
-
-        def fn(xp):
-            labels, dists = _knn_chunked(xp, self._xt, self._yt, k, self._chunk)
-            pred = _majority_vote(
-                labels.astype(jnp.int32), dists, len(self._classes)
-            )
-            return jnp.concatenate(
-                [pred[:, None].astype(jnp.float64), dists.astype(jnp.float64)], axis=1
-            )
-
-        out = apply_batched(fn, X)
+        out = apply_sharded(
+            lambda mesh: _knn_apply(mesh, k, self._chunk, len(self._classes)),
+            X, self._xt, self._yt,
+        )
         pred_ids = out[:n, 0].astype(np.int64)
         result = {model.get_prediction_col(): self._classes[pred_ids]}
         detail = model.get_prediction_detail_col()

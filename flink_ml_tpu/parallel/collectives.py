@@ -72,6 +72,34 @@ def make_data_parallel_step(
     return _BoundedDispatch(fn, max_inflight)
 
 
+def make_data_parallel_apply(
+    fn: Callable,
+    mesh: Mesh,
+    axis: str = "data",
+    n_args: int = 1,
+) -> Callable:
+    """Lift a row-aligned inference fn onto the mesh for model *apply*.
+
+    Arg 0's rows shard over ``axis``; the remaining ``n_args - 1`` args (the
+    model) replicate — the TPU analog of the reference running its
+    ModelMapperAdapter at operator parallelism (ModelMapperAdapter.java:53-61:
+    model rows broadcast to every subtask at open, input rows partitioned).
+    ``fn`` must be row-aligned (row i of the output depends only on row i of
+    arg 0), and the row count must be a multiple of the axis size — pad via
+    ``apply_batched(..., row_multiple=...)``.
+
+    Degenerates to a plain jit when the axis has size 1 (single chip), so one
+    call path serves both.  No collectives are involved, hence no vma check.
+    """
+    if dict(mesh.shape).get(axis, 1) == 1:
+        return jax.jit(fn)
+    in_specs = (P(axis),) + (P(),) * (n_args - 1)
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=P(axis), check_vma=False
+    )
+    return jax.jit(sharded)
+
+
 class _BoundedDispatch:
     """Wraps an async-dispatching jitted fn, keeping at most ``max_inflight``
     results outstanding (blocks on the oldest live output, not the whole
