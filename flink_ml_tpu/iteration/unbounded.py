@@ -35,6 +35,8 @@ class StreamingResult:
     predictions: List[Tuple[int, Any]]  # (event_time, predicted value) per record
     listener_context: ListenerContext
     model_updates: List[Tuple[int, Any]] = field(default_factory=list)  # (window_end, state)
+    #: per-window StepMetrics (SURVEY §5.5): wall time + rows per fired window
+    metrics: Any = None
 
 
 class StreamingDriver:
@@ -73,10 +75,13 @@ class StreamingDriver:
         if (prediction_source is None) != (predict is None):
             raise ValueError("prediction_source and predict must be given together")
 
+        from flink_ml_tpu.utils.metrics import StepMetrics
+
         context = ListenerContext()
         state = initial_state
         window_ms = self.window_ms
         train_schema = training_source.schema()
+        metrics = StepMetrics("stream_train")
 
         # merge the two timestamped streams; training sorts before prediction
         # at equal timestamps so a model update at time T serves a prediction
@@ -117,9 +122,12 @@ class StreamingDriver:
             nonlocal state, epoch, stopped
             # predictions timestamped before this window's close see the old model
             flush_predictions()
+            metrics.start_step()
+            n_rows = len(window_rows)
             table = Table.from_rows(window_rows, train_schema)
             window_rows.clear()
             state = update(state, table, epoch)
+            metrics.end_step(samples=n_rows, window_end=end_ts)
             if self.keep_model_history:
                 model_updates.append((end_ts, state))
             for listener in listeners:
@@ -159,6 +167,7 @@ class StreamingDriver:
             predictions=predictions,
             listener_context=context,
             model_updates=model_updates,
+            metrics=metrics,
         )
 
 

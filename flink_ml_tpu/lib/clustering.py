@@ -20,15 +20,10 @@ import numpy as np
 
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
-from flink_ml_tpu.iteration.bounded import (
-    IterationBodyResult,
-    ReplayableInputs,
-    iterate_bounded,
-)
-from flink_ml_tpu.iteration.config import IterationConfig
-from flink_ml_tpu.lib.common import apply_batched, apply_sharded, resolve_features
+from flink_ml_tpu.lib.common import apply_sharded, resolve_features
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
+    HasCheckpoint,
     HasFeatureColsDefaultAsNull,
     HasK,
     HasMaxIter,
@@ -37,8 +32,7 @@ from flink_ml_tpu.lib.params import (
     HasVectorColDefaultAsNull,
 )
 from flink_ml_tpu.ops.vector import DenseVector
-from flink_ml_tpu.parallel.collectives import make_data_parallel_step, psum
-from flink_ml_tpu.parallel.mesh import replicate, shard_batch
+from flink_ml_tpu.parallel.collectives import psum
 from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasPredictionDetailCol,
@@ -91,6 +85,82 @@ def _assign_apply(mesh):
     from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
 
     return make_data_parallel_apply(_assign_fn, mesh, n_args=2)
+
+
+def make_kmeans_train_fn(mesh, k: int, max_iter: int, tol: float):
+    """The WHOLE Lloyd run as one compiled device program.
+
+    Reuses the GLM fused-loop scaffolding (lib/common.py
+    ``_build_fused_train_fn``) with a Lloyd ``epoch_fn``: epochs are a
+    ``lax.while_loop`` with the convergence test (centroid-shift norm vs
+    tol) evaluated on device, so training runs start-to-finish with zero
+    host round-trips — one transfer in (rows + weights), one out (centroids
+    + cost history + epochs).  Rows shard over ``data``; the per-cluster
+    sums/counts/cost ``psum`` over it (the reference's reduce-average round,
+    SURVEY.md §3.3, fused on-chip); empty clusters keep their previous
+    centroid.
+    """
+    from flink_ml_tpu.lib.common import _build_fused_train_fn
+
+    key = ("kmeans", mesh, int(k), int(max_iter), float(tol))
+
+    def lloyd_epoch(c, batch):
+        x, w = batch  # local shards: (rows, d), (rows,)
+        d = _pairwise_sq_dists(x, c)
+        assign = jnp.argmin(d, axis=1)
+        cost = psum(jnp.sum(jnp.min(d, axis=1) * w), "data")
+        sums = psum(
+            jax.ops.segment_sum(x * w[:, None], assign, num_segments=k),
+            "data",
+        )
+        counts = psum(jax.ops.segment_sum(w, assign, num_segments=k), "data")
+        new_c = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts[:, None], 1.0),
+            c,
+        )
+        delta = jnp.sqrt(jnp.sum((new_c - c) ** 2))
+        return new_c, cost, delta
+
+    return _build_fused_train_fn(
+        key, None, mesh, 0.0, 0.0, max_iter, tol, epoch_fn=lloyd_epoch
+    )
+
+
+def train_kmeans(
+    init_centroids: np.ndarray,
+    Xp: np.ndarray,
+    wp: np.ndarray,
+    mesh,
+    max_iter: int,
+    tol: float,
+    n_rows: int,
+    checkpoint=None,
+):
+    """Drive fused Lloyd iterations to termination (TrainResult contract).
+
+    With a CheckpointConfig the run executes as fused chunks with centroid
+    snapshots between them, through the same chunked-checkpoint driver as
+    the sparse GLM path (lib/common.py ``run_chunked_checkpoint``)."""
+    from flink_ml_tpu.lib.common import _run_fused_train, run_chunked_checkpoint
+
+    k = int(init_centroids.shape[0])
+    batch = (Xp, wp)
+    cents0 = np.asarray(init_centroids, dtype=np.float32)
+
+    def run(n_epochs, cents, device_batch=None):
+        return _run_fused_train(
+            make_kmeans_train_fn(mesh, k, n_epochs, tol),
+            jnp.asarray(cents, dtype=jnp.float32),
+            batch if device_batch is None else device_batch, mesh,
+            batch_preplaced=device_batch is not None, n_rows=n_rows,
+        )
+
+    if checkpoint is None:
+        return run(max_iter, cents0)
+    return run_chunked_checkpoint(
+        run, cents0, max_iter, tol, checkpoint, mesh, batch
+    )
 
 
 def kmeans_plus_plus(X: np.ndarray, k: int, rng: np.random.RandomState) -> np.ndarray:
@@ -165,10 +235,25 @@ class KMeansModel(TableModelBase, KMeansParams):
         return KMeansModelMapper(self, data_schema)
 
 
-class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed):
-    """Estimator: k-means++ init + data-parallel Lloyd iterations."""
+class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint):
+    """Estimator: k-means++ init + FUSED data-parallel Lloyd iterations.
+
+    The whole run is one device program (:func:`make_kmeans_train_fn`) — no
+    per-epoch host sync; with a checkpoint dir configured, fused chunks with
+    centroid snapshots between them (resume restores the latest snapshot and
+    skips re-init)."""
 
     INIT_SAMPLE_CAP = 100_000  # k-means++ host sample bound
+
+    def _checkpoint_config(self):
+        directory = self.get_checkpoint_dir()
+        if directory is None:
+            return None
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        return CheckpointConfig(
+            directory=directory, every_n_epochs=self.get_checkpoint_interval()
+        )
 
     def fit(self, *inputs: Table) -> KMeansModel:
         (table,) = inputs
@@ -195,45 +280,12 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed):
         wp = np.zeros((n_pad,), dtype=np.float32)
         wp[:n] = 1.0
 
-        def local_epoch(centroids, batch):
-            x, w = batch
-            d = _pairwise_sq_dists(x, centroids)
-            assign = jnp.argmin(d, axis=1)
-            cost_local = jnp.sum(jnp.min(d, axis=1) * w)
-            sums = jax.ops.segment_sum(x * w[:, None], assign, num_segments=k)
-            counts = jax.ops.segment_sum(w, assign, num_segments=k)
-            sums = psum(sums, "data")
-            counts = psum(counts, "data")
-            cost = psum(cost_local, "data")
-            new_c = jnp.where(
-                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centroids
-            )
-            delta = jnp.sqrt(jnp.sum((new_c - centroids) ** 2))
-            return new_c, (cost, delta)
-
-        epoch_step = make_data_parallel_step(local_epoch, mesh)
-        batch = shard_batch(mesh, (Xp, wp))
-        c0 = replicate(mesh, jnp.asarray(init, dtype=jnp.float32))
-        tol = self.get_tol()
-
-        def body(centroids, inputs_, epoch):
-            new_c, (cost, delta) = epoch_step(centroids, inputs_["batch"])
-            criteria = None
-            if tol > 0.0:
-                criteria = [1] if float(delta) > tol else []
-            return IterationBodyResult(
-                feedback=new_c,
-                outputs={"cost": cost},
-                termination_criteria=criteria,
-            )
-
-        result = iterate_bounded(
-            c0,
-            ReplayableInputs.replay(batch=batch),
-            body,
-            IterationConfig(max_epochs=self.get_max_iter()),
+        result = train_kmeans(
+            init, Xp, wp, mesh,
+            max_iter=self.get_max_iter(), tol=self.get_tol(), n_rows=n,
+            checkpoint=self._checkpoint_config(),
         )
-        centroids = np.asarray(result.final_variables, dtype=np.float64)
+        centroids = np.asarray(result.params, dtype=np.float64)
 
         model_table = Table.from_rows(
             [(int(i), DenseVector(centroids[i])) for i in range(k)], CENTROID_SCHEMA
@@ -241,6 +293,7 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed):
         model = KMeansModel()
         model.get_params().merge(self.get_params())
         model.set_model_data(model_table)
-        model.train_epochs_ = result.epochs_run
-        model.train_cost_ = float(result.last_output("cost"))
+        model.train_epochs_ = result.epochs
+        model.train_cost_ = float(result.losses[-1]) if result.losses else 0.0
+        model.train_metrics_ = result.metrics
         return model

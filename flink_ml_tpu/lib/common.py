@@ -21,7 +21,7 @@ rows applied in padded power-of-two buckets to bound jit recompiles.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
@@ -36,6 +36,7 @@ from flink_ml_tpu.iteration.bounded import (
 from flink_ml_tpu.iteration.config import IterationConfig
 from flink_ml_tpu.parallel.collectives import make_data_parallel_step, psum
 from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.metrics import StepMetrics
 
 
 def resolve_features(
@@ -73,6 +74,7 @@ class MinibatchStack:
     w: np.ndarray  # (n_dev*steps, mb)
     steps: int
     mb: int
+    n_rows: int = 0  # true (un-padded) row count, for throughput metrics
 
 
 def pack_minibatches(
@@ -107,7 +109,7 @@ def pack_minibatches(
     Xp = Xp.reshape(n_dev, steps, mb, d).reshape(n_dev * steps, mb, d)
     yp = yp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
     wp = wp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
-    return MinibatchStack(x=Xp, y=yp, w=wp, steps=steps, mb=mb)
+    return MinibatchStack(x=Xp, y=yp, w=wp, steps=steps, mb=mb, n_rows=n)
 
 
 # A gradient function: (params, x_mb, y_mb, w_mb) ->
@@ -157,6 +159,7 @@ class SparseMinibatchStack:
     mb: int
     nnz_pad: int
     dim: int
+    n_rows: int = 0  # true (un-padded) row count, for throughput metrics
 
 
 def pack_sparse_minibatches(
@@ -227,7 +230,8 @@ def pack_sparse_minibatches(
             floats[g, nnz_pad + j] = y[i]
             floats[g, nnz_pad + mb + j] = 1.0
     return SparseMinibatchStack(
-        ints=ints, floats=floats, steps=steps, mb=mb, nnz_pad=nnz_pad, dim=dim
+        ints=ints, floats=floats, steps=steps, mb=mb, nnz_pad=nnz_pad, dim=dim,
+        n_rows=n,
     )
 
 
@@ -316,6 +320,11 @@ class TrainResult:
     epochs: int
     losses: list
     final_delta: Optional[float] = None
+    #: StepMetrics recorded by the driver (SURVEY §5.5: samples/sec/chip is
+    #: first-class) — fused runs record one step per device program, host-loop
+    #: runs one step per epoch.  Zero-work resumes carry an empty recorder so
+    #: ``metrics.summary()`` is always safe to call.
+    metrics: StepMetrics = field(default_factory=lambda: StepMetrics("fused_train"))
 
 
 def _combined_view(stack: MinibatchStack) -> np.ndarray:
@@ -329,7 +338,7 @@ def _combined_view(stack: MinibatchStack) -> np.ndarray:
 
 def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
                           max_iter, tol, in_specs=None, out_specs=None,
-                          delta_fn=None):
+                          delta_fn=None, epoch_fn=None):
     """The WHOLE training run as one compiled device program.
 
     Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
@@ -346,7 +355,9 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
     override the default replicated-params/data-sharded-batch placement
     (feature sharding puts the weight leaf on the ``model`` axis) and
     ``delta_fn(params, start)`` overrides the convergence norm when params
-    are sharded.
+    are sharded.  Non-SGD algorithms (KMeans' Lloyd step) pass ``epoch_fn
+    (params, batch) -> (params, loss, delta)`` instead of ``mb_grad_step`` to
+    reuse the identical while_loop/termination/history scaffolding.
     """
     cached = _cache_get(key)
     if cached is not None:
@@ -364,7 +375,7 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
             new_p = sgd_update(p, grads, count)
             return new_p, (loss_sum / count, w_sum)
 
-        def run_epoch(params):
+        def sgd_epoch(params):
             start = params
             params, (losses, counts) = jax.lax.scan(mb_step, params, batch)
             total = jnp.maximum(jnp.sum(counts), 1.0)
@@ -382,6 +393,12 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
                     )
                 )
             return params, loss, delta
+
+        if epoch_fn is not None:
+            def run_epoch(params):
+                return epoch_fn(params, batch)
+        else:
+            run_epoch = sgd_epoch
 
         def cond(carry):
             _, epoch, delta, _ = carry
@@ -419,14 +436,20 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
 
 
 def _run_fused_train(train_fn, init_params, batch, mesh,
-                     place_params=None, batch_preplaced=False) -> TrainResult:
+                     place_params=None, batch_preplaced=False,
+                     n_rows: int = 0) -> TrainResult:
     """Shared epilogue: run the fused program and fetch params + loss
     history + epoch count + final update norm back in ONE transfer.
     ``place_params`` overrides the default replicated placement (feature
     sharding); ``batch_preplaced`` skips the device transfer when the caller
-    already sharded the batch (chunked checkpoint loops place it once)."""
+    already sharded the batch (chunked checkpoint loops place it once).
+    ``n_rows`` (true rows per epoch) feeds the recorded throughput metrics —
+    a fused run is ONE device program, so it records one StepMetrics step
+    covering all epochs (the fetch is the sync point)."""
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
+    metrics = StepMetrics("fused_train")
+    metrics.start_step()
     placed = (
         place_params(init_params) if place_params is not None
         else replicate(mesh, init_params)
@@ -438,12 +461,18 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
         *leaves, loss_hist, jnp.asarray(epochs), jnp.asarray(delta)
     )
     n_epochs = int(fetched[-2])
+    losses = [float(x) for x in fetched[-3][:n_epochs]]
+    metrics.end_step(
+        samples=n_rows * n_epochs, epochs=n_epochs,
+        loss=losses[-1] if losses else 0.0,
+    )
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
     return TrainResult(
         params=host_params,
         epochs=n_epochs,
-        losses=[float(x) for x in fetched[-3][:n_epochs]],
+        losses=losses,
         final_delta=float(fetched[-1]),
+        metrics=metrics,
     )
 
 
@@ -681,19 +710,40 @@ def train_glm_sparse(
             factory(n_epochs), params,
             batch if device_batch is None else device_batch, mesh,
             place_params=place, batch_preplaced=device_batch is not None,
+            n_rows=sstack.n_rows,
         )
         return TrainResult(params=trim(r.params), epochs=r.epochs,
-                           losses=r.losses, final_delta=r.final_delta)
+                           losses=r.losses, final_delta=r.final_delta,
+                           metrics=r.metrics)
 
     if checkpoint is None:
         return run(max_iter, init_params)
+    return run_chunked_checkpoint(
+        run, init_params, max_iter, tol, checkpoint, mesh, batch
+    )
 
+
+def run_chunked_checkpoint(
+    run, init_params, max_iter: int, tol: float, checkpoint, mesh, batch
+) -> TrainResult:
+    """Shared chunked-checkpoint driver for fused training programs.
+
+    Executes ``run(n_epochs, params, device_batch) -> TrainResult`` in fused
+    chunks of ``checkpoint.every_n_epochs`` epochs with a snapshot between
+    chunks; resumes from the latest snapshot in ``checkpoint.directory``.
+    A finished run (recorded tol convergence at this-or-stricter tolerance,
+    or max epochs reached) resumes to a no-op — the fused while_loop always
+    executes a chunk's epoch 0, which would drift from the uninterrupted
+    result.  The batch is placed on the mesh ONCE across all chunks.  Used
+    by the sparse GLM and KMeans paths (one copy of the resume semantics).
+    """
     from flink_ml_tpu.iteration.checkpoint import (
         latest_checkpoint,
         load_checkpoint,
         prune_checkpoints,
         save_checkpoint,
     )
+    from flink_ml_tpu.parallel.mesh import shard_batch
 
     params = init_params
     start_epoch = 0
@@ -704,12 +754,9 @@ def train_glm_sparse(
         start_epoch = int(meta["epoch"]) + 1
         losses = list(meta.get("losses", []))
         if _meta_converged(meta, tol) or start_epoch >= max_iter:
-            # the stored run already finished — re-fitting must not run extra
-            # epochs (the fused while_loop always executes a chunk's epoch 0,
-            # which would drift from the uninterrupted result)
             return TrainResult(params=params, epochs=start_epoch, losses=losses)
-    from flink_ml_tpu.parallel.mesh import shard_batch
 
+    chunk_metrics = StepMetrics("fused_train")
     device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
     while start_epoch < max_iter:
         chunk = min(checkpoint.every_n_epochs, max_iter - start_epoch)
@@ -717,6 +764,7 @@ def train_glm_sparse(
         params = r.params
         losses.extend(r.losses)
         start_epoch += r.epochs
+        chunk_metrics.extend(r.metrics)
         converged = r.epochs < chunk or (  # mid-chunk, or exactly at boundary
             tol > 0.0 and r.final_delta is not None and r.final_delta <= tol
         )
@@ -727,7 +775,8 @@ def train_glm_sparse(
         prune_checkpoints(checkpoint.directory, checkpoint.keep)
         if converged:
             break
-    return TrainResult(params=params, epochs=start_epoch, losses=losses)
+    return TrainResult(params=params, epochs=start_epoch, losses=losses,
+                       metrics=chunk_metrics)
 
 
 def _meta_converged(meta: dict, tol: float) -> bool:
@@ -799,7 +848,10 @@ def train_glm(
         train_fn = make_glm_train_fn(
             grad_fn, mesh, learning_rate, reg, max_iter, tol
         )
-        return _run_fused_train(train_fn, init_params, _combined_view(stack), mesh)
+        return _run_fused_train(
+            train_fn, init_params, _combined_view(stack), mesh,
+            n_rows=stack.n_rows,
+        )
 
     start_epoch = 0
     losses: list = []
@@ -824,10 +876,14 @@ def train_glm(
     batch = shard_batch(mesh, (stack.x, stack.y, stack.w))
     params0 = replicate(mesh, init_params)
     converted: list = list(losses)  # float prefix (resumed history)
+    metrics = StepMetrics("epoch_train")
 
     tol_converged = [False]  # last epoch's delta <= tol (for the final stamp)
 
     def body(params, inputs, epoch):
+        # per-epoch wall time; without a sync (tol/checkpoint off) this times
+        # the async dispatch, which is the honest host-side cost of the epoch
+        metrics.start_step()
         new_params, (loss, delta) = epoch_step(params, inputs["batch"])
         criteria = None
         if tol > 0.0:
@@ -857,6 +913,7 @@ def train_glm(
                     meta={"losses": list(converted)},
                 )
                 prune_checkpoints(checkpoint.directory, checkpoint.keep)
+        metrics.end_step(samples=stack.n_rows)
         return IterationBodyResult(
             feedback=new_params,
             outputs={"loss": loss},
@@ -891,6 +948,7 @@ def train_glm(
         params=final,
         epochs=total_epochs,
         losses=float_losses,
+        metrics=metrics,
     )
 
 

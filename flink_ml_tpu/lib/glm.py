@@ -278,4 +278,5 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         model.set_model_data(make_model_table(w, float(b)))
         model.train_epochs_ = result.epochs
         model.train_losses_ = result.losses
+        model.train_metrics_ = result.metrics
         return model

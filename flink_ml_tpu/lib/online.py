@@ -155,6 +155,7 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
         model.get_params().merge(self.get_params())
         model.set_model_data(make_model_table(w, float(b)))
         model.windows_fired_ = result.windows_fired
+        model.train_metrics_ = result.metrics
         return model, result
 
     # -- bounded convenience (replay a table as a stream) --------------------

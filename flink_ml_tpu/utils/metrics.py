@@ -35,6 +35,13 @@ class StepMetrics:
         self._t0 = None
         return rec
 
+    def extend(self, other: "StepMetrics") -> None:
+        """Append another recorder's steps (chunked/resumed runs), renumbering."""
+        for rec in other.steps:
+            rec = dict(rec)
+            rec["step"] = len(self.steps)
+            self.steps.append(rec)
+
     def summary(self, skip_warmup: int = 1) -> Dict:
         """Aggregate throughput, skipping compile-dominated warmup steps."""
         steady = self.steps[skip_warmup:] if len(self.steps) > skip_warmup else self.steps

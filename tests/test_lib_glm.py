@@ -213,3 +213,16 @@ class TestLogisticRegression:
         (out,) = pmodel.transform(t)
         acc = np.mean(np.asarray(out.col("pred")) == np.asarray(t.col("label")))
         assert acc > 0.9
+
+
+class TestTrainMetrics:
+    def test_fused_fit_records_throughput(self):
+        t, _ = linreg_data(100)
+        from flink_ml_tpu.lib import LinearRegression
+
+        model = (LinearRegression().set_feature_cols(["f0", "f1", "f2"])
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_learning_rate(0.05).set_max_iter(7).fit(t))
+        s = model.train_metrics_.summary(skip_warmup=0)
+        assert s["total_samples"] == 7 * 100
+        assert s["samples_per_sec"] > 0

@@ -232,3 +232,41 @@ class TestReviewRegressions:
         )
         (out2,) = kn.transform(empty)
         assert out2.num_rows() == 0
+
+
+class TestKMeansFusedCheckpoint:
+    def _est(self, max_iter, ckpt=None, tol=0.0):
+        e = (KMeans().set_vector_col("features").set_k(3)
+             .set_max_iter(max_iter).set_prediction_col("c").set_seed(0))
+        if tol:
+            e.set_tol(tol)
+        if ckpt:
+            e.set_checkpoint_dir(str(ckpt)).set_checkpoint_interval(3)
+        return e
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        t, *_ = blob_data(seed=2)
+        full = self._est(10).fit(t)
+        ckpt = tmp_path / "km"
+        self._est(6, ckpt).fit(t)
+        resumed = self._est(10, ckpt).fit(t)
+        assert resumed.train_epochs_ == 10
+        np.testing.assert_allclose(
+            resumed.centroids(), full.centroids(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_converged_refit_is_noop(self, tmp_path):
+        t, *_ = blob_data(seed=3)
+        ckpt = tmp_path / "km2"
+        first = self._est(100, ckpt, tol=1e-4).fit(t)
+        assert first.train_epochs_ < 100
+        again = self._est(100, ckpt, tol=1e-4).fit(t)
+        assert again.train_epochs_ == first.train_epochs_
+        np.testing.assert_array_equal(again.centroids(), first.centroids())
+
+    def test_metrics_recorded(self):
+        t, *_ = blob_data()
+        model = self._est(5).fit(t)
+        s = model.train_metrics_.summary(skip_warmup=0)
+        assert s["total_samples"] == 5 * 180  # epochs * rows
+        assert s["total_seconds"] > 0
