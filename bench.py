@@ -1,105 +1,22 @@
 """Headline benchmark: LogisticRegression.fit samples/sec/chip.
 
-BASELINE.md records no published reference numbers, so the baseline is
-measured here too: the reference-shaped CPU path — per-record gradient
-math exactly like SubUpdate.map (examples-batch/.../LinearRegression.java:
-215-231) / ModelMapperAdapter.map (ModelMapperAdapter.java:58-61), one row
-at a time through numpy — versus the batched-XLA device path.  The printed
-``vs_baseline`` is device-samples-per-sec over per-record-samples-per-sec
-(north star: >= 4x at identical AUC; BASELINE.json).
+Thin wrapper over :func:`bench_all.bench_logreg` (the full matrix lives in
+``bench_all.py`` — all five BASELINE.json configs plus the Criteo-shaped
+sparse path).  Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", ...}
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is against the honest vectorized-numpy minibatch SGD on the
+host CPU (identical update rule); the reference-shaped per-record loop is
+also measured and reported as ``vs_per_record``.  AUC parity against the
+vectorized baseline is computed on held-out rows (``auc_parity``).
+Throughput is read from the training driver's own StepMetrics.
 """
 
-import json
-import time
-
-import numpy as np
-
-
-N_ROWS = 200_000
-N_FEATURES = 28  # HIGGS feature count
-EPOCHS = 20
-BATCH = 8192
-
-
-def make_data(seed=0):
-    rng = np.random.RandomState(seed)
-    X = rng.randn(N_ROWS, N_FEATURES).astype(np.float64)
-    true_w = rng.randn(N_FEATURES)
-    y = ((X @ true_w + 0.5 * rng.randn(N_ROWS)) > 0).astype(np.float64)
-    return X, y
-
-
-def bench_tpu_path(X, y):
-    """Full Estimator.fit through the framework; returns samples/sec/chip."""
-    import jax
-
-    from flink_ml_tpu.lib import LogisticRegression
-    from flink_ml_tpu.table.schema import Schema
-    from flink_ml_tpu.table.table import Table
-
-    schema = Schema.of(
-        *[(f"f{i}", "double") for i in range(N_FEATURES)], ("label", "double")
-    )
-    cols = {f"f{i}": X[:, i] for i in range(N_FEATURES)}
-    cols["label"] = y
-    table = Table.from_columns(schema, cols)
-
-    feature_cols = [f"f{i}" for i in range(N_FEATURES)]
-
-    def fit(iters):
-        return (
-            LogisticRegression()
-            .set_feature_cols(feature_cols)
-            .set_label_col("label")
-            .set_prediction_col("pred")
-            .set_learning_rate(0.5)
-            .set_global_batch_size(BATCH)
-            .set_max_iter(iters)
-            .fit(table)
-        )
-
-    fit(EPOCHS)  # warmup: compile + pack (steady-state measurement below)
-    n_chips = jax.device_count()
-    t0 = time.perf_counter()
-    model = fit(EPOCHS)
-    elapsed = time.perf_counter() - t0
-    sps_per_chip = EPOCHS * N_ROWS / elapsed / n_chips
-    return sps_per_chip, model
-
-
-def bench_per_record_baseline(X, y, budget_rows=20_000):
-    """The reference-shaped hot loop: one row at a time, vector math per row."""
-    w = np.zeros(N_FEATURES)
-    b = 0.0
-    lr = 0.5 / BATCH
-    n = min(budget_rows, len(y))
-    t0 = time.perf_counter()
-    for i in range(n):
-        xi = X[i]
-        p = 1.0 / (1.0 + np.exp(-(xi @ w + b)))
-        err = p - y[i]
-        w -= lr * err * xi
-        b -= lr * err
-    elapsed = time.perf_counter() - t0
-    return n / elapsed
+from bench_all import bench_logreg
 
 
 def main():
-    X, y = make_data()
-    device_sps, _ = bench_tpu_path(X, y)
-    record_sps = bench_per_record_baseline(X, y)
-    print(
-        json.dumps(
-            {
-                "metric": "LogisticRegression.fit samples/sec/chip",
-                "value": round(device_sps, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(device_sps / record_sps, 2),
-            }
-        )
-    )
+    bench_logreg()
 
 
 if __name__ == "__main__":

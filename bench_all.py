@@ -1,0 +1,464 @@
+"""Full benchmark matrix — every BASELINE.json config plus the Criteo-shaped
+sparse path (the north-star workload).
+
+Each workload prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", ...extras}
+
+Two CPU baselines are measured per training workload:
+  * ``per_record``  — the reference-shaped hot loop (one row at a time
+    through numpy, SubUpdate.map / ModelMapperAdapter.map shape,
+    examples-batch/.../LinearRegression.java:215-231) — labeled, not used
+    for the headline ratio;
+  * ``vectorized``  — an honest numpy minibatch SGD / Lloyd / brute-force
+    implementation of the SAME algorithm (full-batch vector math on the
+    host CPU).  ``vs_baseline`` is measured against THIS.
+
+AUC/RMSE parity against the vectorized baseline is asserted inside the
+GLM benches (north star: >=4x at identical AUC, BASELINE.json).
+
+Device throughput is read from the drivers' own StepMetrics (fit is run
+once to compile, then re-run; the second run's metrics are steady-state).
+
+Usage: python bench_all.py [workload ...]   (default: all)
+Workloads: logreg kmeans linreg knn online sparse
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# ---------------------------------------------------------------- utilities
+
+
+def _auc(y: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney)."""
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = y == 1
+    n1 = int(pos.sum())
+    n0 = len(y) - n1
+    if n1 == 0 or n0 == 0:
+        return 0.5
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _emit(record: dict) -> dict:
+    print(json.dumps(record))
+    return record
+
+
+def _n_chips() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def _steady_fit_sps(fit) -> tuple:
+    """Run fit twice (compile, then steady) and read the driver's metrics."""
+    fit()  # warmup: compile + pack
+    model = fit()
+    s = model.train_metrics_.summary(skip_warmup=0)
+    return s["samples_per_sec"], model
+
+
+# ------------------------------------------------------- numpy CPU baselines
+
+
+def _np_sgd_glm(X, y, lr, batch, epochs, kind, time_budget_s=8.0):
+    """Vectorized numpy minibatch SGD — the honest CPU baseline.  Identical
+    update rule to the framework (mean gradient per global batch).  Returns
+    (w, b, rows_per_sec); stops early on the time budget and reports the
+    measured rate (the trajectory for parity always runs >= 1 full epoch)."""
+    n, d = X.shape
+    w = np.zeros(d)
+    b = 0.0
+    t0 = time.perf_counter()
+    rows_done = 0
+    for _ in range(epochs):
+        for lo in range(0, n, batch):
+            xb = X[lo:lo + batch]
+            yb = y[lo:lo + batch]
+            z = xb @ w + b
+            err = (_sigmoid(z) - yb) if kind == "logistic" else (z - yb)
+            w -= lr * (xb.T @ err) / len(yb)
+            b -= lr * err.mean()
+            rows_done += len(yb)
+        if time.perf_counter() - t0 > time_budget_s:
+            break
+    return w, b, rows_done / (time.perf_counter() - t0)
+
+
+def _np_per_record_glm(X, y, lr, batch, kind, budget_rows=20_000):
+    """The reference-shaped per-record loop (one row at a time)."""
+    d = X.shape[1]
+    w = np.zeros(d)
+    b = 0.0
+    lr_r = lr / batch
+    n = min(budget_rows, len(y))
+    t0 = time.perf_counter()
+    for i in range(n):
+        xi = X[i]
+        z = xi @ w + b
+        err = (_sigmoid(z) - y[i]) if kind == "logistic" else (z - y[i])
+        w -= lr_r * err * xi
+        b -= lr_r * err
+    return n / (time.perf_counter() - t0)
+
+
+# ------------------------------------------------------------------ workloads
+
+
+def bench_logreg(n_rows=200_000, n_features=28, epochs=50, batch=8192):
+    """LogisticRegression.fit, HIGGS-shaped (BASELINE configs[0])."""
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_features)
+    true_w = rng.randn(n_features)
+    y = ((X @ true_w + 0.5 * rng.randn(n_rows)) > 0).astype(np.float64)
+    n_train = int(0.8 * n_rows)
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+    t = Table.from_columns(
+        schema,
+        {"features": [DenseVector(r) for r in X[:n_train]], "label": y[:n_train]},
+    )
+    lr = 0.5
+
+    def fit():
+        return (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(lr).set_global_batch_size(batch)
+            .set_max_iter(epochs).fit(t)
+        )
+
+    device_sps, model = _steady_fit_sps(fit)
+    per_record_sps = _np_per_record_glm(X[:n_train], y[:n_train], lr, batch, "logistic")
+    w_np, b_np, vec_sps = _np_sgd_glm(
+        X[:n_train], y[:n_train], lr, batch, epochs, "logistic"
+    )
+
+    # AUC parity on held-out rows (framework vs the vectorized baseline)
+    qt = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR)),
+        {"features": [DenseVector(r) for r in X[n_train:]]},
+    )
+    auc_tpu = _auc(y[n_train:], model.predict_proba(qt))
+    auc_np = _auc(y[n_train:], _sigmoid(X[n_train:] @ w_np + b_np))
+    gb_per_s = device_sps * n_features * 4 / 1e9
+
+    return _emit({
+        "metric": "LogisticRegression.fit samples/sec/chip",
+        "value": round(device_sps / _n_chips(), 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(device_sps / vec_sps, 2),
+        "vs_per_record": round(device_sps / per_record_sps, 2),
+        "baseline_vectorized_sps": round(vec_sps, 1),
+        "baseline_per_record_sps": round(per_record_sps, 1),
+        "auc_tpu": round(auc_tpu, 4),
+        "auc_baseline": round(auc_np, 4),
+        "auc_parity": bool(abs(auc_tpu - auc_np) < 0.005),
+        "effective_gb_per_s": round(gb_per_s, 3),
+        "shape": f"{n_train}x{n_features} f32 batch={batch} epochs={epochs}",
+    })
+
+
+def bench_linreg(n_rows=200_000, n_features=90, epochs=50, batch=8192):
+    """LinearRegression.fit, YearPredictionMSD-shaped (BASELINE configs[2])."""
+    from flink_ml_tpu.lib import LinearRegression
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(n_rows, n_features)
+    true_w = rng.randn(n_features) / np.sqrt(n_features)
+    y = X @ true_w + 0.1 * rng.randn(n_rows)
+    n_train = int(0.8 * n_rows)
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+    t = Table.from_columns(
+        schema,
+        {"features": [DenseVector(r) for r in X[:n_train]], "label": y[:n_train]},
+    )
+    lr = 0.1
+
+    def fit():
+        return (
+            LinearRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(lr).set_global_batch_size(batch)
+            .set_max_iter(epochs).fit(t)
+        )
+
+    device_sps, model = _steady_fit_sps(fit)
+    per_record_sps = _np_per_record_glm(X[:n_train], y[:n_train], lr, batch, "squared")
+    w_np, b_np, vec_sps = _np_sgd_glm(
+        X[:n_train], y[:n_train], lr, batch, epochs, "squared"
+    )
+
+    Xq = X[n_train:]
+    rmse_tpu = float(np.sqrt(np.mean(
+        (Xq @ model.coefficients() + model.intercept() - y[n_train:]) ** 2)))
+    rmse_np = float(np.sqrt(np.mean((Xq @ w_np + b_np - y[n_train:]) ** 2)))
+
+    return _emit({
+        "metric": "LinearRegression.fit samples/sec/chip",
+        "value": round(device_sps / _n_chips(), 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(device_sps / vec_sps, 2),
+        "vs_per_record": round(device_sps / per_record_sps, 2),
+        "rmse_tpu": round(rmse_tpu, 4),
+        "rmse_baseline": round(rmse_np, 4),
+        "rmse_parity": bool(abs(rmse_tpu - rmse_np) < 0.01),
+        "effective_gb_per_s": round(device_sps * n_features * 4 / 1e9, 3),
+        "shape": f"{n_train}x{n_features} f32 batch={batch} epochs={epochs}",
+    })
+
+
+def bench_kmeans(n_rows=200_000, n_features=64, k=100, epochs=10):
+    """KMeans k=100 (BASELINE configs[1])."""
+    from flink_ml_tpu.lib.clustering import KMeans
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    rng = np.random.RandomState(2)
+    centers = 10.0 * rng.randn(k, n_features)
+    X = (centers[rng.randint(k, size=n_rows)] +
+         rng.randn(n_rows, n_features)).astype(np.float64)
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),)
+    t = Table.from_columns(schema, {"features": [DenseVector(r) for r in X]})
+
+    def fit():
+        return (
+            KMeans().set_vector_col("features").set_k(k)
+            .set_max_iter(epochs).set_prediction_col("c").set_seed(0).fit(t)
+        )
+
+    device_sps, model = _steady_fit_sps(fit)
+
+    # vectorized numpy Lloyd baseline: one epoch on a bounded subset,
+    # chunked distance matrix exactly like the device kernel
+    sub = X[:50_000].astype(np.float32)
+    c = model.centroids()[:, :].astype(np.float32)
+    t0 = time.perf_counter()
+    chunk = 8192
+    for lo in range(0, len(sub), chunk):
+        xb = sub[lo:lo + chunk]
+        d2 = (xb * xb).sum(1)[:, None] - 2.0 * xb @ c.T + (c * c).sum(1)
+        assign = np.argmin(d2, axis=1)
+        np.add.at(np.zeros((k, n_features), np.float32), assign, xb)
+    vec_sps = len(sub) / (time.perf_counter() - t0)
+
+    return _emit({
+        "metric": "KMeans.fit samples/sec/chip (k=100)",
+        "value": round(device_sps / _n_chips(), 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(device_sps / vec_sps, 2),
+        "train_cost": round(model.train_cost_, 1),
+        "shape": f"{n_rows}x{n_features} f32 k={k} epochs={epochs}",
+    })
+
+
+def bench_knn(n_train=60_000, n_query=10_000, n_features=784, k=5, n_classes=10):
+    """Knn Model.transform batch inference, MNIST-shaped (BASELINE configs[3])."""
+    from flink_ml_tpu.lib.knn import Knn
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    rng = np.random.RandomState(3)
+    prototypes = rng.randn(n_classes, n_features)
+    labels = rng.randint(n_classes, size=n_train)
+    X = (prototypes[labels] + 0.8 * rng.randn(n_train, n_features)).astype(np.float64)
+    qlabels = rng.randint(n_classes, size=n_query)
+    Q = (prototypes[qlabels] + 0.8 * rng.randn(n_query, n_features)).astype(np.float64)
+
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+    t = Table.from_columns(
+        schema,
+        {"features": [DenseVector(r) for r in X], "label": labels.astype(np.float64)},
+    )
+    qt = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR)),
+        {"features": [DenseVector(r) for r in Q]},
+    )
+    model = (Knn().set_vector_col("features").set_label_col("label")
+             .set_prediction_col("pred").set_k(k).fit(t))
+
+    model.transform(qt)  # warmup: compile + model packing
+    t0 = time.perf_counter()
+    (out,) = model.transform(qt)
+    device_rps = n_query / (time.perf_counter() - t0)
+    acc = float(np.mean(np.asarray(out.col("pred")) == qlabels))
+
+    # numpy brute-force baseline on a query subset, extrapolated
+    n_sub = 500
+    Xf = X.astype(np.float32)
+    t0 = time.perf_counter()
+    for i in range(0, n_sub, 100):
+        qb = Q[i:i + 100].astype(np.float32)
+        d2 = (qb * qb).sum(1)[:, None] - 2.0 * qb @ Xf.T + (Xf * Xf).sum(1)
+        idx = np.argpartition(d2, k, axis=1)[:, :k]
+        np.take(labels, idx)
+    vec_rps = n_sub / (time.perf_counter() - t0)
+
+    return _emit({
+        "metric": "Knn.transform rows/sec/chip",
+        "value": round(device_rps / _n_chips(), 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(device_rps / vec_rps, 2),
+        "accuracy": round(acc, 4),
+        "shape": f"train {n_train}x{n_features}, query {n_query}, k={k}",
+    })
+
+
+def bench_online(n_rows=100_000, n_features=28, rows_per_window=1000):
+    """Online LogisticRegression, streaming mini-batch (BASELINE configs[4])."""
+    from flink_ml_tpu.lib.online import OnlineLogisticRegression
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.sources import GeneratorSource
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(n_rows, n_features)
+    true_w = rng.randn(n_features)
+    y = ((X @ true_w) > 0).astype(np.float64)
+    rows = [(DenseVector(X[i]), y[i]) for i in range(n_rows)]
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+    window_ms = 1000
+    interval = window_ms // rows_per_window
+
+    def run():
+        source = GeneratorSource.linear_timestamps(rows, interval, schema)
+        est = (OnlineLogisticRegression().set_vector_col("features")
+               .set_label_col("label").set_prediction_col("p")
+               .set_learning_rate(0.5).set_window_ms(window_ms))
+        return est.fit_unbounded(source)
+
+    run()  # warmup: compile
+    model, result = run()
+    s = result.metrics.summary(skip_warmup=1)
+    windows_per_sec = s["steady_steps"] / s["total_seconds"]
+    per_record_sps = _np_per_record_glm(X, y, 0.5, rows_per_window, "logistic")
+
+    return _emit({
+        "metric": "OnlineLogisticRegression windows/sec",
+        "value": round(windows_per_sec, 2),
+        "unit": "windows/sec",
+        "vs_baseline": round(s["samples_per_sec"] / per_record_sps, 2),
+        "rows_per_sec": round(s["samples_per_sec"], 1),
+        "windows_fired": result.windows_fired,
+        "shape": f"{n_rows}x{n_features}, {rows_per_window} rows/window",
+    })
+
+
+def bench_sparse(n_rows=100_000, dim=1_000_000, nnz=39, epochs=40, batch=8192):
+    """Criteo-shaped sparse LogisticRegression — the north-star workload:
+    hashed features at >=1M dim through the native LibSVM loader and the
+    fused segment-CSR training path (lib/common.py make_sparse_glm_train_fn).
+    """
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.table.sources import LibSvmSource
+
+    rng = np.random.RandomState(5)
+    # synthetic LibSVM file: power-law-ish hashed indices, ~nnz per row
+    path = os.path.join(tempfile.gettempdir(), f"criteo_shaped_{n_rows}.svm")
+    if not os.path.exists(path):
+        hot = rng.randint(0, 50_000, size=(n_rows, nnz - 10))
+        cold = rng.randint(50_000, dim, size=(n_rows, 10))
+        idx = np.concatenate([hot, cold], axis=1)
+        idx.sort(axis=1)
+        true_w = rng.randn(dim).astype(np.float32) * 0.3
+        with open(path, "w") as f:
+            for i in range(n_rows):
+                ii = np.unique(idx[i])
+                label = 1 if true_w[ii].sum() > 0 else 0
+                f.write(str(label) + " " +
+                        " ".join(f"{j}:1" for j in ii) + "\n")
+
+    t0 = time.perf_counter()
+    table = LibSvmSource(path, n_features=dim, zero_based=True).read()
+    load_s = time.perf_counter() - t0
+
+    def fit():
+        return (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_num_features(dim).set_learning_rate(0.5)
+            .set_global_batch_size(batch).set_max_iter(epochs).fit(table)
+        )
+
+    device_sps, model = _steady_fit_sps(fit)
+
+    # vectorized numpy sparse SGD baseline: concatenated COO arrays,
+    # reduceat forward + add.at scatter — the honest host-CPU formulation
+    vecs = table.col("features")
+    y = np.asarray(table.col("label"), dtype=np.float64)
+    n_base = min(n_rows, 4 * batch)
+    w_np = np.zeros(dim)
+    b_np = 0.0
+    t0 = time.perf_counter()
+    for lo in range(0, n_base, batch):
+        rows_ = vecs[lo:lo + batch]
+        yb = y[lo:lo + batch]
+        flat_idx = np.concatenate([v.indices for v in rows_])
+        flat_val = np.concatenate([v.vals for v in rows_])
+        counts = np.array([len(v.indices) for v in rows_])
+        bounds = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        z = np.add.reduceat(flat_val * w_np[flat_idx], bounds) + b_np
+        err = _sigmoid(z) - yb
+        np.add.at(
+            w_np, flat_idx,
+            (-0.5 / len(rows_)) * np.repeat(err, counts) * flat_val,
+        )
+        b_np -= 0.5 * err.mean()
+    vec_sps = n_base / (time.perf_counter() - t0)
+
+    return _emit({
+        "metric": "Sparse LogisticRegression.fit samples/sec/chip (Criteo-shaped)",
+        "value": round(device_sps / _n_chips(), 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(device_sps / vec_sps, 2),
+        "nnz_per_sec": round(device_sps * nnz, 1),
+        "dim": dim,
+        "native_load_rows_per_sec": round(n_rows / load_s, 1),
+        "shape": f"{n_rows} rows, {dim} features, ~{nnz} nnz/row, "
+                 f"batch={batch} epochs={epochs}",
+    })
+
+
+WORKLOADS = {
+    "logreg": bench_logreg,
+    "kmeans": bench_kmeans,
+    "linreg": bench_linreg,
+    "knn": bench_knn,
+    "online": bench_online,
+    "sparse": bench_sparse,
+}
+
+
+def main(argv):
+    names = argv or list(WORKLOADS)
+    results = {}
+    for name in names:
+        results[name] = WORKLOADS[name]()
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -274,11 +274,20 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         from flink_ml_tpu.parallel.mesh import data_parallel_size
 
         n_dev = data_parallel_size(mesh)
-        n_pad = -(-n // n_dev) * n_dev
-        Xp = np.zeros((n_pad, dim), dtype=np.float32)
-        Xp[:n] = X
-        wp = np.zeros((n_pad,), dtype=np.float32)
-        wp[:n] = 1.0
+
+        def build():
+            n_pad = -(-n // n_dev) * n_dev
+            Xp = np.zeros((n_pad, dim), dtype=np.float32)
+            Xp[:n] = X
+            wp = np.zeros((n_pad,), dtype=np.float32)
+            wp[:n] = 1.0
+            return Xp, wp
+
+        Xp, wp = table.cached_pack(
+            ("kmeans", self.get_vector_col(),
+             tuple(self.get_feature_cols() or ()), n_dev),
+            build,
+        )
 
         result = train_kmeans(
             init, Xp, wp, mesh,

@@ -454,17 +454,36 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
         place_params(init_params) if place_params is not None
         else replicate(mesh, init_params)
     )
+    # the train fn donates its params: when the caller passes already-placed
+    # device arrays, placement may alias their buffers (device_put returns a
+    # view-like Array for no-op placements) and donation would delete the
+    # CALLER's data — a second fit from the same initial params would crash.
+    # Copy any leaf whose origin is a device array (host-sourced leaves were
+    # freshly copied by placement already).
+    placed = jax.tree_util.tree_map(
+        lambda p, o: jnp.copy(p) if isinstance(o, jax.Array) else p,
+        placed, init_params,
+    )
+    import time as _time
+
     device_batch = batch if batch_preplaced else shard_batch(mesh, batch)
+    t_run = _time.perf_counter()
     params, loss_hist, epochs, delta = train_fn(placed, device_batch)
+    dispatch_s = _time.perf_counter() - t_run
+    t_fetch = _time.perf_counter()
     leaves, treedef = jax.tree_util.tree_flatten(params)
     fetched = fetch_flat(
         *leaves, loss_hist, jnp.asarray(epochs), jnp.asarray(delta)
     )
+    # fetch_flat is the single sync point: it absorbs transfer + program +
+    # readback (no extra block_until_ready round-trips on tunneled devices)
+    sync_s = _time.perf_counter() - t_fetch
     n_epochs = int(fetched[-2])
     losses = [float(x) for x in fetched[-3][:n_epochs]]
     metrics.end_step(
         samples=n_rows * n_epochs, epochs=n_epochs,
         loss=losses[-1] if losses else 0.0,
+        dispatch_seconds=dispatch_s, sync_seconds=sync_s,
     )
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
     return TrainResult(

@@ -225,7 +225,11 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             return self._fit_sparse(table, y, mesh, n_dev)
 
         X, dim = resolve_features(table, self)
-        stack = pack_minibatches(X, y, n_dev, self.get_global_batch_size())
+        stack = table.cached_pack(
+            ("dense", vector_col, tuple(self.get_feature_cols() or ()),
+             self.get_label_col(), n_dev, self.get_global_batch_size()),
+            lambda: pack_minibatches(X, y, n_dev, self.get_global_batch_size()),
+        )
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -248,10 +252,14 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             raise NotImplementedError(
                 f"{type(self).__name__} has no sparse loss kind"
             )
-        vectors = list(table.col(self.get_vector_col()))
         num_features = self.get_num_features()
-        sstack = pack_sparse_minibatches(
-            vectors, y, n_dev, self.get_global_batch_size(), dim=num_features
+        sstack = table.cached_pack(
+            ("sparse", self.get_vector_col(), self.get_label_col(), n_dev,
+             self.get_global_batch_size(), num_features),
+            lambda: pack_sparse_minibatches(
+                list(table.col(self.get_vector_col())), y, n_dev,
+                self.get_global_batch_size(), dim=num_features,
+            ),
         )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)

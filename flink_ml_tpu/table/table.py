@@ -23,7 +23,7 @@ from flink_ml_tpu.table.schema import DataTypes, Schema
 
 
 class Table:
-    __slots__ = ("_schema", "_cols", "_num_rows")
+    __slots__ = ("_schema", "_cols", "_num_rows", "_pack_cache")
 
     def __init__(self, schema: Schema, cols: Dict[str, np.ndarray]):
         self._schema = schema
@@ -32,6 +32,22 @@ class Table:
         if len(lengths) > 1:
             raise ValueError(f"ragged columns: lengths {lengths}")
         self._num_rows = lengths.pop() if lengths else 0
+        self._pack_cache: Dict = {}
+
+    def cached_pack(self, key, builder):
+        """Memoize a device-layout packing of this (immutable) table.
+
+        Training drivers pack rows into device-major stacks before the first
+        epoch; re-fitting the same table (hyperparameter sweeps, warmup +
+        measure benches) would otherwise re-pack identical bytes — and, on
+        tunneled devices, re-transfer them (the runtime caches host->device
+        copies by buffer identity, so returning the SAME arrays makes the
+        re-placement nearly free).  ``key`` must capture everything the
+        layout depends on (columns, batch size, mesh width, dtype).
+        """
+        if key not in self._pack_cache:
+            self._pack_cache[key] = builder()
+        return self._pack_cache[key]
 
     # -- construction -------------------------------------------------------
 
