@@ -128,7 +128,8 @@ def make_kmeans_train_fn(mesh, k: int, max_iter: int, tol: float):
 
 
 def train_kmeans(
-    init_centroids: np.ndarray,
+    init_centroids,
+    k: int,
     Xp: np.ndarray,
     wp: np.ndarray,
     mesh,
@@ -136,30 +137,40 @@ def train_kmeans(
     tol: float,
     n_rows: int,
     checkpoint=None,
+    device_batch=None,
 ):
     """Drive fused Lloyd iterations to termination (TrainResult contract).
 
-    With a CheckpointConfig the run executes as fused chunks with centroid
-    snapshots between them, through the same chunked-checkpoint driver as
-    the sparse GLM path (lib/common.py ``run_chunked_checkpoint``)."""
-    from flink_ml_tpu.lib.common import _run_fused_train, run_chunked_checkpoint
+    ``init_centroids`` may be a thunk (the k-means++ host pass): it is only
+    resolved on a fresh start — a checkpoint resume (or a finished-run no-op
+    re-fit) never pays for it.  With a CheckpointConfig the run executes as
+    fused chunks with centroid snapshots between them, through the same
+    chunked-checkpoint driver as the sparse GLM path (lib/common.py
+    ``run_chunked_checkpoint``)."""
+    from flink_ml_tpu.lib.common import (
+        _resolve_thunk,
+        _run_fused_train,
+        run_chunked_checkpoint,
+    )
 
-    k = int(init_centroids.shape[0])
     batch = (Xp, wp)
-    cents0 = np.asarray(init_centroids, dtype=np.float32)
 
-    def run(n_epochs, cents, device_batch=None):
+    def run(n_epochs, cents, dev_batch=None):
         return _run_fused_train(
             make_kmeans_train_fn(mesh, k, n_epochs, tol),
             jnp.asarray(cents, dtype=jnp.float32),
-            batch if device_batch is None else device_batch, mesh,
-            batch_preplaced=device_batch is not None, n_rows=n_rows,
+            batch if dev_batch is None else dev_batch, mesh,
+            batch_preplaced=dev_batch is not None, n_rows=n_rows,
         )
 
     if checkpoint is None:
-        return run(max_iter, cents0)
+        cents0 = np.asarray(_resolve_thunk(init_centroids), dtype=np.float32)
+        return run(max_iter, cents0, _resolve_thunk(device_batch))
+    dim = Xp.shape[1]
     return run_chunked_checkpoint(
-        run, cents0, max_iter, tol, checkpoint, mesh, batch
+        run, init_centroids, max_iter, tol, checkpoint, mesh, batch,
+        device_batch=device_batch,
+        like=np.zeros((k, dim), dtype=np.float32),  # structure template only
     )
 
 
@@ -262,16 +273,21 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         n = X.shape[0]
         if n < k:
             raise ValueError(f"k={k} exceeds number of rows {n}")
-        rng = np.random.RandomState(self.get_seed())
 
-        sample = X if n <= self.INIT_SAMPLE_CAP else X[
-            rng.choice(n, self.INIT_SAMPLE_CAP, replace=False)
-        ]
-        init = kmeans_plus_plus(sample.astype(np.float64), k, rng)
+        checkpoint = self._checkpoint_config()
+
+        def init():
+            # the k-means++ host pass, as a thunk: resolved by train_kmeans
+            # only on a fresh start — a snapshot resume skips it entirely
+            rng = np.random.RandomState(self.get_seed())
+            sample = X if n <= self.INIT_SAMPLE_CAP else X[
+                rng.choice(n, self.INIT_SAMPLE_CAP, replace=False)
+            ]
+            return kmeans_plus_plus(sample.astype(np.float64), k, rng)
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.parallel.mesh import data_parallel_size, shard_batch
 
         n_dev = data_parallel_size(mesh)
 
@@ -283,16 +299,20 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             wp[:n] = 1.0
             return Xp, wp
 
-        Xp, wp = table.cached_pack(
-            ("kmeans", self.get_vector_col(),
-             tuple(self.get_feature_cols() or ()), n_dev),
-            build,
+        layout_key = ("kmeans", self.get_vector_col(),
+                      tuple(self.get_feature_cols() or ()), n_dev)
+        Xp, wp = table.cached_pack(layout_key, build)
+        # a thunk: a no-op resume (finished snapshot) must not pay the
+        # host->device transfer, so placement resolves lazily downstream
+        device_batch = lambda: table.cached_pack(  # noqa: E731
+            layout_key + ("dev", mesh),
+            lambda: shard_batch(mesh, (Xp, wp)),
         )
 
         result = train_kmeans(
-            init, Xp, wp, mesh,
+            init, k, Xp, wp, mesh,
             max_iter=self.get_max_iter(), tol=self.get_tol(), n_rows=n,
-            checkpoint=self._checkpoint_config(),
+            checkpoint=checkpoint, device_batch=device_batch,
         )
         centroids = np.asarray(result.params, dtype=np.float64)
 

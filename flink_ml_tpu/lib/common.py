@@ -670,6 +670,7 @@ def train_glm_sparse(
     tol: float = 0.0,
     with_intercept: bool = True,
     checkpoint=None,
+    device_batch=None,
 ) -> TrainResult:
     """Sparse counterpart of :func:`train_glm` (always the fused device loop).
 
@@ -724,11 +725,11 @@ def train_glm_sparse(
 
     batch = (sstack.ints, sstack.floats)
 
-    def run(n_epochs, params, device_batch=None):
+    def run(n_epochs, params, dev_batch=None):
         r = _run_fused_train(
             factory(n_epochs), params,
-            batch if device_batch is None else device_batch, mesh,
-            place_params=place, batch_preplaced=device_batch is not None,
+            batch if dev_batch is None else dev_batch, mesh,
+            place_params=place, batch_preplaced=dev_batch is not None,
             n_rows=sstack.n_rows,
         )
         return TrainResult(params=trim(r.params), epochs=r.epochs,
@@ -736,20 +737,32 @@ def train_glm_sparse(
                            metrics=r.metrics)
 
     if checkpoint is None:
-        return run(max_iter, init_params)
+        return run(max_iter, init_params, _resolve_thunk(device_batch))
     return run_chunked_checkpoint(
-        run, init_params, max_iter, tol, checkpoint, mesh, batch
+        run, init_params, max_iter, tol, checkpoint, mesh, batch,
+        device_batch=device_batch,
     )
 
 
+def _resolve_thunk(x):
+    """Zero-arg callables stand in for expensive values (k-means++ init,
+    device placement) that must not be computed on paths that skip them
+    (no-op checkpoint resume); everything else passes through unchanged."""
+    return x() if callable(x) else x
+
+
 def run_chunked_checkpoint(
-    run, init_params, max_iter: int, tol: float, checkpoint, mesh, batch
+    run, init_params, max_iter: int, tol: float, checkpoint, mesh, batch,
+    device_batch=None, like=None,
 ) -> TrainResult:
     """Shared chunked-checkpoint driver for fused training programs.
 
     Executes ``run(n_epochs, params, device_batch) -> TrainResult`` in fused
     chunks of ``checkpoint.every_n_epochs`` epochs with a snapshot between
     chunks; resumes from the latest snapshot in ``checkpoint.directory``.
+    ``init_params`` may be a thunk (expensive host init, e.g. k-means++):
+    it is resolved only when there is no snapshot to resume from — pass
+    ``like`` (a structure template; values unused) for the resume load.
     A finished run (recorded tol convergence at this-or-stricter tolerance,
     or max epochs reached) resumes to a no-op — the fused while_loop always
     executes a chunk's epoch 0, which would drift from the uninterrupted
@@ -764,38 +777,54 @@ def run_chunked_checkpoint(
     )
     from flink_ml_tpu.parallel.mesh import shard_batch
 
-    params = init_params
     start_epoch = 0
     losses: list = []
     latest = latest_checkpoint(checkpoint.directory)
-    if latest is not None:
-        params, meta = load_checkpoint(latest, like=init_params)
+    if latest is None:
+        params = _resolve_thunk(init_params)
+    else:
+        template = like if like is not None else init_params
+        params, meta = load_checkpoint(latest, like=template)
         start_epoch = int(meta["epoch"]) + 1
         losses = list(meta.get("losses", []))
         if _meta_converged(meta, tol) or start_epoch >= max_iter:
-            return TrainResult(params=params, epochs=start_epoch, losses=losses)
+            # no-op re-fit: self-describing result from the snapshot meta
+            # (final_delta persisted at save time; metrics default empty)
+            delta = meta.get("final_delta")
+            return TrainResult(
+                params=params, epochs=start_epoch, losses=losses,
+                final_delta=None if delta is None else float(delta),
+            )
 
     chunk_metrics = StepMetrics("fused_train")
-    device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
+    # placement happens AFTER the no-op-resume early return above: a finished
+    # run must not pay the host->device transfer just to return the snapshot.
+    # ``device_batch`` may be a thunk (lazy placement) for the same reason.
+    device_batch = _resolve_thunk(device_batch)
+    if device_batch is None:
+        device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
+    last_delta = None
     while start_epoch < max_iter:
         chunk = min(checkpoint.every_n_epochs, max_iter - start_epoch)
         r = run(chunk, params, device_batch)
         params = r.params
         losses.extend(r.losses)
         start_epoch += r.epochs
+        last_delta = r.final_delta
         chunk_metrics.extend(r.metrics)
         converged = r.epochs < chunk or (  # mid-chunk, or exactly at boundary
             tol > 0.0 and r.final_delta is not None and r.final_delta <= tol
         )
         save_checkpoint(
             checkpoint.directory, start_epoch - 1, params,
-            meta={"losses": losses, "converged": converged, "tol": tol},
+            meta={"losses": losses, "converged": converged, "tol": tol,
+                  "final_delta": r.final_delta},
         )
         prune_checkpoints(checkpoint.directory, checkpoint.keep)
         if converged:
             break
     return TrainResult(params=params, epochs=start_epoch, losses=losses,
-                       metrics=chunk_metrics)
+                       final_delta=last_delta, metrics=chunk_metrics)
 
 
 def _meta_converged(meta: dict, tol: float) -> bool:
@@ -845,6 +874,7 @@ def train_glm(
     tol: float = 0.0,
     listeners: Sequence = (),
     checkpoint=None,
+    device_batch=None,
 ) -> TrainResult:
     """Drive GLM training to termination.
 
@@ -868,7 +898,9 @@ def train_glm(
             grad_fn, mesh, learning_rate, reg, max_iter, tol
         )
         return _run_fused_train(
-            train_fn, init_params, _combined_view(stack), mesh,
+            train_fn, init_params,
+            device_batch if device_batch is not None else _combined_view(stack),
+            mesh, batch_preplaced=device_batch is not None,
             n_rows=stack.n_rows,
         )
 

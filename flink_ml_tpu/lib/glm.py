@@ -225,11 +225,28 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             return self._fit_sparse(table, y, mesh, n_dev)
 
         X, dim = resolve_features(table, self)
+        layout_key = ("dense", vector_col, tuple(self.get_feature_cols() or ()),
+                      self.get_label_col(), n_dev, self.get_global_batch_size())
         stack = table.cached_pack(
-            ("dense", vector_col, tuple(self.get_feature_cols() or ()),
-             self.get_label_col(), n_dev, self.get_global_batch_size()),
+            layout_key,
             lambda: pack_minibatches(X, y, n_dev, self.get_global_batch_size()),
         )
+        # device residency cache: re-fits of the same table (sweeps, benches)
+        # skip the host->device hop — the analog of the CPU path's data
+        # already sitting in RAM.  Keyed by mesh: a different mesh is a
+        # different placement.  Only the fused path consumes this layout;
+        # the checkpointed path shards (x, y, w) itself, so placing the
+        # combined view there would transfer the dataset twice.
+        checkpoint = self._checkpoint_config()
+        device_batch = None
+        if checkpoint is None:
+            from flink_ml_tpu.lib.common import _combined_view
+            from flink_ml_tpu.parallel.mesh import shard_batch
+
+            device_batch = table.cached_pack(
+                layout_key + ("dev", mesh),
+                lambda: shard_batch(mesh, _combined_view(stack)),
+            )
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -242,7 +259,8 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             max_iter=self.get_max_iter(),
             reg=self.get_reg(),
             tol=self.get_tol(),
-            checkpoint=self._checkpoint_config(),
+            checkpoint=checkpoint,
+            device_batch=device_batch,
         )
         return self._finish(result)
 
@@ -253,13 +271,21 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 f"{type(self).__name__} has no sparse loss kind"
             )
         num_features = self.get_num_features()
+        layout_key = ("sparse", self.get_vector_col(), self.get_label_col(),
+                      n_dev, self.get_global_batch_size(), num_features)
         sstack = table.cached_pack(
-            ("sparse", self.get_vector_col(), self.get_label_col(), n_dev,
-             self.get_global_batch_size(), num_features),
+            layout_key,
             lambda: pack_sparse_minibatches(
                 list(table.col(self.get_vector_col())), y, n_dev,
                 self.get_global_batch_size(), dim=num_features,
             ),
+        )
+        from flink_ml_tpu.parallel.mesh import shard_batch
+
+        # thunk: resolved lazily so a no-op checkpoint resume skips the hop
+        device_batch = lambda: table.cached_pack(  # noqa: E731
+            layout_key + ("dev", mesh),
+            lambda: shard_batch(mesh, (sstack.ints, sstack.floats)),
         )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -274,6 +300,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             tol=self.get_tol(),
             with_intercept=self.get_with_intercept(),
             checkpoint=self._checkpoint_config(),
+            device_batch=device_batch,
         )
         return self._finish(result)
 

@@ -30,7 +30,6 @@ from flink_ml_tpu.lib.params import (
     HasLabelCol,
     HasVectorColDefaultAsNull,
 )
-from flink_ml_tpu.ops.vector import DenseVector
 from flink_ml_tpu.params.shared import (
     HasPredictionCol,
     HasPredictionDetailCol,
@@ -99,9 +98,10 @@ def _knn_apply(mesh, k, chunk, n_classes):
     def forward(xq, xt, yt):
         labels, dists = _knn_chunked(xq, xt, yt, k, chunk)
         pred = _majority_vote(labels.astype(jnp.int32), dists, n_classes)
+        # class ids and distances are exact in f32 (ids are small ints);
+        # staying f32 avoids per-call x64 truncation on TPU
         return jnp.concatenate(
-            [pred[:, None].astype(jnp.float64), dists.astype(jnp.float64)],
-            axis=1,
+            [pred[:, None].astype(xq.dtype), dists.astype(xq.dtype)], axis=1
         )
 
     return make_data_parallel_apply(forward, mesh, n_args=3)
@@ -140,7 +140,7 @@ class KnnModelMapper(ModelMapper):
 
     def load_model(self, *model_tables: Table) -> None:
         (t,) = model_tables
-        X = np.stack([v.to_dense().values for v in t.col("features")])
+        X = t.features_dense("features")  # matrix-backed or object column
         y = np.asarray(t.col("label"), dtype=np.float64)
         k = self._model_stage.get_k()
         if k > len(y):
@@ -153,7 +153,8 @@ class KnnModelMapper(ModelMapper):
         n_pad = -(-X.shape[0] // chunk) * chunk
         Xp = np.zeros((n_pad, X.shape[1]), dtype=np.float32)
         Xp[: X.shape[0]] = X
-        yp = np.full((n_pad,), np.inf)  # inf marks padding (never wins top-k)
+        # inf marks padding (never wins top-k); f32 holds class ids exactly
+        yp = np.full((n_pad,), np.inf, dtype=np.float32)
         yp[: y.shape[0]] = y_ids
         self._xt = jnp.asarray(Xp)
         self._yt = jnp.asarray(yp)
@@ -193,8 +194,11 @@ class Knn(Estimator, KnnParams, HasLabelCol):
         (table,) = inputs
         X, dim = resolve_features(table, self)
         y = np.asarray(table.col(self.get_label_col()), dtype=np.float64)
-        rows = [(DenseVector(X[i].astype(np.float64)), float(y[i])) for i in range(len(y))]
         model = KnnModel()
         model.get_params().merge(self.get_params())
-        model.set_model_data(Table.from_rows(rows, KNN_MODEL_SCHEMA))
+        # matrix-backed model column: the training set stays one contiguous
+        # array end-to-end (fit -> model table -> device placement)
+        model.set_model_data(Table.from_columns(
+            KNN_MODEL_SCHEMA, {"features": np.asarray(X), "label": y}
+        ))
         return model

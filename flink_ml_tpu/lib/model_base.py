@@ -25,8 +25,10 @@ MODEL_DATA_FILE = "model_data.jsonl"
 class TableModelBase(Model):
     """Model whose data is one table (set/get/save/load implemented)."""
 
-    # class-level default: Stage.load reconstructs instances bypassing __init__
+    # class-level defaults: Stage.load reconstructs instances bypassing __init__
     _model_table: Optional[Table] = None
+    _mapper_cache: Optional[ModelMapper] = None
+    _mapper_cache_key: Optional[tuple] = None
 
     #: name of a column the model table must contain (None skips the check)
     REQUIRED_MODEL_COL: Optional[str] = None
@@ -34,6 +36,8 @@ class TableModelBase(Model):
     def __init__(self):
         super().__init__()
         self._model_table = None
+        self._mapper_cache = None
+        self._mapper_cache_key = None
 
     def set_model_data(self, *inputs: Table) -> "TableModelBase":
         (table,) = inputs
@@ -41,6 +45,7 @@ class TableModelBase(Model):
         if required is not None and not table.schema.contains(required):
             raise ValueError(f"model table must have a {required!r} column")
         self._model_table = table
+        self._mapper_cache = None  # device-side model state must reload
         return self
 
     def get_model_data(self) -> Tuple[Table, ...]:
@@ -53,6 +58,7 @@ class TableModelBase(Model):
 
     def load_model_data(self, path: str) -> None:
         self._model_table = persistence.load_table(os.path.join(path, MODEL_DATA_FILE))
+        self._mapper_cache = None
 
     # -- transform -----------------------------------------------------------
 
@@ -61,7 +67,19 @@ class TableModelBase(Model):
 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
         (table,) = inputs
-        mapper = self._make_mapper(table.schema)
-        mapper.load_model(*self.get_model_data())
+        # the loaded mapper holds the model packed on DEVICE (the
+        # broadcast-variable analog); reloading it per transform would
+        # re-transfer the whole model — for Knn that is the training set
+        # itself.  Cache it, keyed by everything the mapper captures.
+        key = (
+            tuple(table.schema.field_names),
+            tuple(table.schema.field_types),
+            self.get_params().to_json(),
+        )
+        if self._mapper_cache is None or self._mapper_cache_key != key:
+            mapper = self._make_mapper(table.schema)
+            mapper.load_model(*self.get_model_data())
+            self._mapper_cache = mapper
+            self._mapper_cache_key = key
         batch = MLEnvironmentFactory.get_default().default_batch_size
-        return (mapper.apply(table, batch_size=batch),)
+        return (self._mapper_cache.apply(table, batch_size=batch),)

@@ -13,6 +13,7 @@ column buffers where possible.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -20,6 +21,12 @@ import numpy as np
 from flink_ml_tpu.ops.batch import CsrBatch, dense_batch
 from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
 from flink_ml_tpu.table.schema import DataTypes, Schema
+
+#: Bound on per-table memoized packings: each entry can pin a full
+#: device-layout copy of the dataset (host or HBM), so a hyperparameter sweep
+#: over layout-affecting params (batch size, mesh) must evict old layouts
+#: instead of accumulating one resident copy per config.
+_PACK_CACHE_CAPACITY = 4  # host pack + device placement for ~2 configs
 
 
 class Table:
@@ -32,22 +39,30 @@ class Table:
         if len(lengths) > 1:
             raise ValueError(f"ragged columns: lengths {lengths}")
         self._num_rows = lengths.pop() if lengths else 0
-        self._pack_cache: Dict = {}
+        self._pack_cache: OrderedDict = OrderedDict()
 
     def cached_pack(self, key, builder):
         """Memoize a device-layout packing of this (immutable) table.
 
-        Training drivers pack rows into device-major stacks before the first
-        epoch; re-fitting the same table (hyperparameter sweeps, warmup +
-        measure benches) would otherwise re-pack identical bytes — and, on
-        tunneled devices, re-transfer them (the runtime caches host->device
-        copies by buffer identity, so returning the SAME arrays makes the
-        re-placement nearly free).  ``key`` must capture everything the
-        layout depends on (columns, batch size, mesh width, dtype).
+        Training drivers pack rows into device-major stacks (and place them on
+        the mesh) before the first epoch; re-fitting the same table
+        (hyperparameter sweeps, warmup + measure benches) would otherwise
+        re-pack AND re-transfer identical bytes — on tunneled devices the
+        host->device hop dominates the whole fit.  ``key`` must capture
+        everything the layout depends on (columns, batch size, mesh, dtype).
+
+        LRU-bounded to ``_PACK_CACHE_CAPACITY`` entries: evicting a device
+        placement drops the last reference to its HBM buffers, so sweeps over
+        layout-affecting params cannot pin one dataset copy per config.
         """
-        if key not in self._pack_cache:
-            self._pack_cache[key] = builder()
-        return self._pack_cache[key]
+        if key in self._pack_cache:
+            self._pack_cache.move_to_end(key)
+            return self._pack_cache[key]
+        value = builder()
+        self._pack_cache[key] = value
+        while len(self._pack_cache) > _PACK_CACHE_CAPACITY:
+            self._pack_cache.popitem(last=False)
+        return value
 
     # -- construction -------------------------------------------------------
 
@@ -88,7 +103,9 @@ class Table:
 
     def to_rows(self) -> List[Tuple]:
         names = self._schema.field_names
-        columns = [self._cols[n] for n in names]
+        columns = [
+            _rowwise_view(self._cols[n], self._schema.type_of(n)) for n in names
+        ]
         return [tuple(c[i] for c in columns) for i in range(self._num_rows)]
 
     # -- relational ops ------------------------------------------------------
@@ -136,9 +153,24 @@ class Table:
         for t in tables[1:]:
             if t.schema != schema:
                 raise ValueError("schema mismatch in concat")
-        cols = {
-            n: np.concatenate([t._cols[n] for t in tables]) for n in schema.field_names
-        }
+        cols = {}
+        for n in schema.field_names:
+            arrays = [t._cols[n] for t in tables]
+            ndims = {a.ndim for a in arrays}
+            if len(ndims) > 1:
+                # mixed matrix-backed and object-backed vector columns:
+                # normalize to object rows (correctness over speed — concat
+                # of mixed layouts is not a hot path)
+                typ = schema.type_of(n)
+                parts = []
+                for a in arrays:
+                    view = _rowwise_view(a, typ)
+                    obj = np.empty(len(a), dtype=object)
+                    for i in range(len(a)):
+                        obj[i] = view[i]
+                    parts.append(obj)
+                arrays = parts
+            cols[n] = np.concatenate(arrays)
         return Table(schema, cols)
 
     def iter_batches(self, batch_size: int) -> Iterator["Table"]:
@@ -152,6 +184,20 @@ class Table:
         typ = self._schema.type_of(col)
         values = self.col(col)
         if DataTypes.is_vector(typ):
+            if isinstance(values, np.ndarray) and values.ndim == 2:
+                # matrix-backed column: already the device layout, zero-copy
+                if dim is not None and values.shape[1] != dim:
+                    if values.shape[1] > dim:
+                        # mirror dense_batch: rows wider than the requested
+                        # dim are a loud dimension mismatch, never truncated
+                        raise ValueError(
+                            f"column {col!r} holds {values.shape[1]}-dim "
+                            f"vectors; requested dim={dim}"
+                        )
+                    out = np.zeros((values.shape[0], dim), dtype=values.dtype)
+                    out[:, : values.shape[1]] = values
+                    return out
+                return values
             return dense_batch(list(values), dim)
         return np.asarray(values, dtype=np.float64).reshape(self._num_rows, 1)
 
@@ -185,6 +231,20 @@ class Table:
 def _as_column(values, typ: str) -> np.ndarray:
     dtype = DataTypes.numpy_dtype(typ)
     if dtype is object:
+        if (
+            typ.upper() in (DataTypes.DENSE_VECTOR, DataTypes.VECTOR)
+            and isinstance(values, np.ndarray)
+            and values.ndim == 2
+        ):
+            # matrix fast path is DENSE only: a 2D array for a SPARSE_VECTOR
+            # column would silently reroute fit/persistence to dense codecs
+            # matrix-backed dense-vector column: one contiguous (rows, dim)
+            # float array instead of rows of DenseVector objects.  The fast
+            # path for million-row dense workloads — features_dense returns
+            # it zero-copy; row-level views wrap rows lazily (_rowwise_view).
+            if values.dtype not in (np.float32, np.float64):
+                values = values.astype(np.float64)
+            return values
         arr = np.empty(len(values), dtype=object)
         for i, v in enumerate(values):
             arr[i] = v
@@ -194,3 +254,22 @@ def _as_column(values, typ: str) -> np.ndarray:
                     raise TypeError(f"vector column holds non-vector {type(v).__name__}")
         return arr
     return np.asarray(values, dtype=dtype)
+
+
+class _rowwise_view:
+    """Row accessor over a column buffer: matrix-backed vector columns yield
+    DenseVector rows lazily so row-level consumers (to_rows, codecs) see the
+    same value types as object-backed columns."""
+
+    __slots__ = ("_col", "_wrap")
+
+    def __init__(self, col: np.ndarray, typ: str):
+        self._col = col
+        self._wrap = (
+            DataTypes.is_vector(typ)
+            and isinstance(col, np.ndarray)
+            and col.ndim == 2
+        )
+
+    def __getitem__(self, i):
+        return DenseVector(self._col[i]) if self._wrap else self._col[i]

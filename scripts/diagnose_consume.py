@@ -1,0 +1,39 @@
+"""Is the h2d transfer lazy (paid at first consuming program)?  What's the
+effective bandwidth when a program actually reads freshly-placed data?"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(1), ("data",))
+S = NamedSharding(mesh, P("data"))
+consume = jax.jit(lambda x: jnp.sum(x))
+
+
+def stamp(label, t0):
+    print(f"  {label:<28s} {(time.perf_counter()-t0)*1e3:8.1f}ms")
+    return time.perf_counter()
+
+
+for mb in (20, 100, 400):
+    n = mb * 1024 * 256  # mb MB of f32
+    a = np.random.randn(n).astype(np.float32)
+    print(f"{mb}MB:")
+    t0 = time.perf_counter()
+    d = jax.device_put(a, S)
+    t0 = stamp("device_put (async)", t0)
+    d.block_until_ready()
+    t0 = stamp("block_until_ready", t0)
+    float(consume(d))
+    t0 = stamp("first consume+sync", t0)
+    float(consume(d))
+    t0 = stamp("second consume+sync", t0)
+    # fresh data, fresh buffer: put+consume in one go
+    b = np.random.randn(n).astype(np.float32)
+    t0 = time.perf_counter()
+    d2 = jax.device_put(b, S)
+    float(consume(d2))
+    dt = time.perf_counter() - t0
+    print(f"  put+consume fresh data       {dt*1e3:8.1f}ms  -> {mb/dt:7.1f} MB/s effective")

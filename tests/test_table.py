@@ -247,3 +247,108 @@ def test_tracing_helpers():
         with annotate("step"):
             pass
     assert calls and calls[0][0] == "phase" and calls[0][1] >= 0
+
+
+class TestMatrixBackedColumn:
+    """Matrix-backed dense-vector columns: the million-row fast path — a 2D
+    float array stored directly instead of rows of DenseVector objects."""
+
+    def _table(self):
+        X = np.arange(12, dtype=np.float32).reshape(4, 3)
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+        return X, Table.from_columns(
+            schema, {"features": X, "label": [0.0, 1.0, 0.0, 1.0]}
+        )
+
+    def test_features_dense_zero_copy(self):
+        X, t = self._table()
+        out = t.features_dense("features")
+        assert out is X  # no conversion, no copy
+
+    def test_features_dense_dim_pad(self):
+        X, t = self._table()
+        out = t.features_dense("features", dim=5)
+        assert out.shape == (4, 5)
+        np.testing.assert_allclose(out[:, :3], X)
+        np.testing.assert_allclose(out[:, 3:], 0.0)
+
+    def test_to_rows_wraps_dense_vectors(self):
+        from flink_ml_tpu.ops.vector import DenseVector
+
+        X, t = self._table()
+        rows = t.to_rows()
+        assert isinstance(rows[0][0], DenseVector)
+        np.testing.assert_allclose(rows[2][0].values, X[2])
+        assert rows[2][1] == 0.0
+
+    def test_row_ops_slice_filter(self):
+        X, t = self._table()
+        sub = t.slice_rows(1, 3)
+        np.testing.assert_allclose(sub.features_dense("features"), X[1:3])
+        f = t.filter_rows(np.asarray([True, False, True, False]))
+        np.testing.assert_allclose(f.features_dense("features"), X[[0, 2]])
+
+    def test_train_matches_object_column(self):
+        """A GLM fit over a matrix-backed column bit-matches the same fit
+        over the equivalent DenseVector-object column."""
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.ops.vector import DenseVector
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 5).astype(np.float64)
+        y = (X @ rng.randn(5) > 0).astype(np.float64)
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+        t_mat = Table.from_columns(schema, {"features": X, "label": y})
+        t_obj = Table.from_columns(
+            schema, {"features": [DenseVector(r) for r in X], "label": y}
+        )
+
+        def fit(t):
+            m = (LogisticRegression().set_vector_col("features")
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_learning_rate(0.5).set_max_iter(5).fit(t))
+            return m.coefficients(), m.intercept()
+
+        w1, b1 = fit(t_mat)
+        w2, b2 = fit(t_obj)
+        np.testing.assert_array_equal(w1, w2)
+        assert b1 == b2
+
+
+class TestPackCacheBounds:
+    def test_lru_eviction(self):
+        from flink_ml_tpu.table import table as table_mod
+
+        schema = Schema.of(("x", "double"))
+        t = Table.from_columns(schema, {"x": [1.0]})
+        cap = table_mod._PACK_CACHE_CAPACITY
+        builds = []
+        for i in range(cap + 2):
+            t.cached_pack(("k", i), lambda i=i: builds.append(i) or i)
+        assert len(t._pack_cache) == cap
+        # oldest entries evicted; re-requesting rebuilds
+        t.cached_pack(("k", 0), lambda: builds.append("rebuild") or 0)
+        assert "rebuild" in builds
+
+    def test_hit_returns_same_object(self):
+        schema = Schema.of(("x", "double"))
+        t = Table.from_columns(schema, {"x": [1.0]})
+        a = t.cached_pack("a", lambda: object())
+        assert t.cached_pack("a", lambda: object()) is a
+
+def test_features_dense_narrower_dim_raises():
+    X, t = TestMatrixBackedColumn()._table()
+    with pytest.raises(ValueError):
+        t.features_dense("features", dim=2)
+
+
+def test_concat_mixed_layouts():
+    from flink_ml_tpu.ops.vector import DenseVector
+
+    X, t_mat = TestMatrixBackedColumn()._table()
+    schema = t_mat.schema
+    t_obj = Table.from_rows([(DenseVector([9.0, 9.0, 9.0]), 5.0)], schema)
+    out = Table.concat([t_mat, t_obj])
+    assert out.num_rows() == 5
+    np.testing.assert_allclose(out.features_dense("features")[:4], X)
+    np.testing.assert_allclose(out.features_dense("features")[4], [9.0, 9.0, 9.0])
