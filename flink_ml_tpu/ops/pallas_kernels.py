@@ -18,6 +18,42 @@ future kernels (double-buffered variants, fused sparse segment ops).
 
 Kernels run ``interpret=True`` off-TPU so the CPU test mesh exercises the
 same code path numerically; :func:`use_pallas` gates the real lowering.
+
+Sparse-grad kernel (round-3 item, measured outcome — XLA retained)
+------------------------------------------------------------------
+The sparse GLM minibatch (lib/common.py ``make_sparse_glm_train_fn``:
+gather ``w[idx]`` → segment_sum over rows → gather ``err[rid]`` →
+segment_sum over the 1M-dim feature axis) was micro-benchmarked on v5e at
+the bench shape (mb=8192, nnz=320k, dim=1M); all numbers per op, readback-
+synced and dedup-proofed:
+
+  =============================  =========  ====================
+  op                             time/op    rate
+  =============================  =========  ====================
+  XLA gather 320k from 1M        3.2 ms     ~100 M entries/s
+  XLA segment_sum -> 8192        2.9 ms     ~110 M entries/s
+  XLA segment_sum -> 1M          3.2 ms     ~100 M entries/s
+  XLA dense 1M-dim SGD update    1.1 ms     (7.5 GB/s effective)
+  =============================  =========  ====================
+
+Three Pallas replacements were built and measured:
+  1. scalar-loop scatter into VMEM — rejected by Mosaic
+     ("Cannot store scalars to VMEM");
+  2. scalar-loop with SMEM accumulator + scalar VMEM loads — rejected
+     ("index in dimension 1 must be a multiple of 128": dynamic VMEM
+     access must be tile-aligned);
+  3. SMEM-blocked entry streaming + lane-masked (iota-select) vector
+     loads from a (dim/128, 128) weight tile — compiles, but runs at
+     **8 M entries/s, ~7x slower than XLA** (each random access costs a
+     full 128-lane read-mask-reduce on the VPU).
+
+Conclusion: on v5e (no SparseCore) every programmable path — XLA scatter,
+Mosaic scalar loop, lane-masked vector RMW — is bound by the same ~10
+cycles/random-access wall, and XLA's lowering is already at it.  The
+segment-CSR XLA formulation therefore remains the default and no sparse
+Pallas kernel ships; this note records the measured delta per the
+round-2 verdict contract (VERDICT item 4: "default only if it wins;
+record the delta either way").
 """
 
 from __future__ import annotations
