@@ -22,7 +22,7 @@ from __future__ import annotations
 import importlib
 import json
 import os
-from typing import List, Tuple
+from typing import Tuple
 
 from flink_ml_tpu.params import Params, WithParams
 from flink_ml_tpu.table.table import Table

@@ -14,7 +14,7 @@ the same replicated buffers over ICI-free local HBM.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 from flink_ml_tpu.table.schema import Schema
 from flink_ml_tpu.table.table import Table

@@ -24,7 +24,6 @@ import numpy as np
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
-    apply_batched,
     apply_sharded,
     pack_minibatches,
     pack_sparse_minibatches,

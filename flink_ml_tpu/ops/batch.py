@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+from flink_ml_tpu.ops.vector import SparseVector, Vector
 
 
 def dense_batch(vectors: Sequence[Vector], dim: int = None) -> np.ndarray:

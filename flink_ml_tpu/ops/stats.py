@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from flink_ml_tpu.ops.matrix import DenseMatrix
-from flink_ml_tpu.ops.vector import DenseVector, Vector
+from flink_ml_tpu.ops.vector import Vector
 
 _EPSILON = np.finfo(np.float64).eps
 

@@ -11,7 +11,6 @@ from flink_ml_tpu.api import (
     Model,
     Pipeline,
     PipelineModel,
-    Stage,
     load_stage,
 )
 from flink_ml_tpu.params import param_info

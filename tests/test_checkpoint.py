@@ -7,7 +7,6 @@ import numpy as np
 import pytest
 
 from flink_ml_tpu.iteration.checkpoint import (
-    CheckpointConfig,
     latest_checkpoint,
     load_checkpoint,
     prune_checkpoints,

@@ -8,7 +8,6 @@ import sys
 from contextlib import redirect_stdout
 
 import numpy as np
-import pytest
 
 
 def run_main(module, argv=None):

@@ -4,7 +4,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from flink_ml_tpu.lib.common import (
     pack_sparse_minibatches,

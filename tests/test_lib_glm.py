@@ -8,7 +8,6 @@ training on the virtual 8-device CPU mesh.
 import os
 
 import numpy as np
-import pytest
 
 from flink_ml_tpu.api.core import load_stage
 from flink_ml_tpu.api.pipeline import Pipeline

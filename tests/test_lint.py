@@ -1,0 +1,18 @@
+"""Style gate (checkstyle analog — reference tools/maven/checkstyle.xml wired in
+the root pom.xml).  CI additionally runs ruff; this keeps the gate enforced in
+environments where ruff is unavailable."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_lint_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -18,7 +18,6 @@ from flink_ml_tpu.common import (
 )
 from flink_ml_tpu.operator import (
     BatchOperator,
-    StreamOperator,
     TableSourceBatchOp,
     TableSourceStreamOp,
 )

@@ -1,15 +1,13 @@
 """Native ingestion parity: the C++ CSV/libsvm readers must agree exactly
 with the pure-Python fallbacks through the real table sources."""
 
-import importlib
 import os
-import subprocess
 
 import numpy as np
 import pytest
 
 from flink_ml_tpu import native
-from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.schema import Schema
 from flink_ml_tpu.table.sources import CsvSource, LibSvmSource
 
 pytestmark = pytest.mark.skipif(
