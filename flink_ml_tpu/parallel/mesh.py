@@ -86,3 +86,12 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def shutdown_distributed() -> None:
+    """Tear down the jax.distributed control plane (idempotent)."""
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        # Not initialized — single-host runs never bring the service up.
+        pass

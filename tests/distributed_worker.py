@@ -1,0 +1,61 @@
+"""Worker for the two-process jax.distributed smoke test (test_distributed.py).
+
+Run as: python distributed_worker.py <process_id> <num_processes> <port>
+
+Each process owns 4 virtual CPU devices; after ``initialize_distributed`` the
+global mesh spans 8 devices across both OS processes and a jitted global sum
+exercises one cross-process (DCN-path) collective.  This is the multi-host
+bring-up the reference delegates to Flink's runtime (flink-ml-lib/pom.xml:40-58
+provided deps; job/task managers over TCP), realized as a jax.distributed
+control plane + XLA collective data plane.
+"""
+
+import os
+import sys
+
+process_id = int(sys.argv[1])
+num_processes = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+# Some environments pre-import jax at interpreter startup (see conftest.py), so
+# the platform must be forced via config, not env vars.
+jax.config.update("jax_platforms", "cpu")
+# CPU cross-process collectives need a backend; gloo is the in-tree one.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from flink_ml_tpu.parallel.mesh import default_mesh, initialize_distributed, shutdown_distributed
+
+initialize_distributed(
+    coordinator_address=f"localhost:{port}",
+    num_processes=num_processes,
+    process_id=process_id,
+)
+
+assert jax.process_count() == num_processes, jax.process_count()
+assert len(jax.local_devices()) == 4, jax.local_devices()
+assert len(jax.devices()) == 4 * num_processes, jax.devices()
+
+mesh = default_mesh()  # spans all global devices on the 'data' axis
+
+# Each process contributes its own rows; the global array is sharded over the
+# full mesh, so the jitted sum must reduce across the process boundary.
+local_rows = np.arange(4, dtype=np.float32) + 4.0 * process_id
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local_rows, global_shape=(4 * num_processes,)
+)
+
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+print(f"RESULT {float(total)}", flush=True)
+
+shutdown_distributed()

@@ -1,0 +1,54 @@
+"""Two-process jax.distributed smoke test (SURVEY.md §2.6 comm-backend row, DCN).
+
+The reference scales multi-node through Flink's runtime (job/task managers over
+TCP; flink-ml-lib/pom.xml:40-58 provided deps).  Here the control plane is
+``jax.distributed`` and the data plane is an XLA collective: two OS processes,
+each owning 4 virtual CPU devices, form one 8-device mesh and jointly reduce a
+globally-sharded array.  Run in subprocesses because the parent test process
+already holds an initialized single-process JAX backend.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+WORKER = HERE / "distributed_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_psum():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(pid), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=str(HERE.parent),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        # sum(0..7) reduced across the two-process mesh
+        assert "RESULT 28.0" in out, f"worker {pid} output:\n{out}"
