@@ -83,18 +83,21 @@ def pack_minibatches(
     n_dev: int,
     global_batch_size: int = 0,
     dtype=np.float32,
+    min_steps: int = 0,
 ) -> MinibatchStack:
     """Pack rows into the device-major minibatch layout.
 
     ``global_batch_size`` rows are consumed per SGD step across the whole
     mesh (0 = full batch).  Rows are padded to fill the last minibatch; pad
-    rows carry weight 0 so sums/counts are exact.
+    rows carry weight 0 so sums/counts are exact.  ``min_steps`` floors the
+    step count (whole-pad steps are all-zero-weight) — the out-of-core feed
+    uses it so every chunk shares one compiled program shape.
     """
     n, d = X.shape
     if global_batch_size <= 0:
         global_batch_size = max(n, n_dev)
     mb = max(1, -(-global_batch_size // n_dev))  # per-device minibatch rows
-    steps = max(1, -(-n // (mb * n_dev)))
+    steps = max(max(1, -(-n // (mb * n_dev))), int(min_steps))
     n_pad = steps * mb * n_dev
 
     Xp = np.zeros((n_pad, d), dtype=dtype)
@@ -104,11 +107,16 @@ def pack_minibatches(
     yp[:n] = y
     wp[:n] = 1.0
 
-    # device-major: device k owns rows [k*steps*mb, (k+1)*steps*mb), scanned
-    # as `steps` minibatches — row order within a device is preserved
-    Xp = Xp.reshape(n_dev, steps, mb, d).reshape(n_dev * steps, mb, d)
-    yp = yp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
-    wp = wp.reshape(n_dev, steps, mb).reshape(n_dev * steps, mb)
+    # step-major rows in a device-contiguous layout: global SGD step s
+    # consumes rows [s*G, (s+1)*G) where G = n_dev*mb — the reference's
+    # record order — and device k takes the k-th mb-slice of each step
+    # window.  Dim 0 stays device-contiguous so it shards over the 'data'
+    # axis; crucially the step->rows mapping does not depend on the total
+    # row count, so a chunked (out-of-core) feed cut at G-row boundaries
+    # replays the identical update schedule (lib/out_of_core.py).
+    Xp = Xp.reshape(steps, n_dev, mb, d).transpose(1, 0, 2, 3).reshape(n_dev * steps, mb, d)
+    yp = yp.reshape(steps, n_dev, mb).transpose(1, 0, 2).reshape(n_dev * steps, mb)
+    wp = wp.reshape(steps, n_dev, mb).transpose(1, 0, 2).reshape(n_dev * steps, mb)
     return MinibatchStack(x=Xp, y=yp, w=wp, steps=steps, mb=mb, n_rows=n)
 
 
@@ -169,11 +177,15 @@ def pack_sparse_minibatches(
     global_batch_size: int = 0,
     dim: Optional[int] = None,
     pad_multiple: int = 512,
+    min_nnz_pad: int = 0,
+    min_steps: int = 0,
 ) -> SparseMinibatchStack:
     """Pack SparseVector rows into the device-major sparse layout.
 
     Out-of-range feature indices fail loudly here: XLA's gather clamps and
     segment_sum drops them, which would silently train a corrupted model.
+    ``min_nnz_pad`` floors the padded nnz width — the out-of-core feed uses
+    it to keep one static shape (one compiled program) across chunks.
     """
     n = len(vectors)
     max_idx = -1
@@ -196,26 +208,30 @@ def pack_sparse_minibatches(
     if global_batch_size <= 0:
         global_batch_size = max(n, n_dev)
     mb = max(1, -(-global_batch_size // n_dev))
-    steps = max(1, -(-n // (mb * n_dev)))
+    steps = max(max(1, -(-n // (mb * n_dev))), int(min_steps))
     n_groups = n_dev * steps
+
+    # step-major rows (see pack_minibatches): group g = device k, local step
+    # s covers rows [s*G + k*mb, s*G + (k+1)*mb) with G = n_dev*mb
+    def _group_lo(g: int) -> int:
+        k, s = divmod(g, steps)
+        return s * (n_dev * mb) + k * mb
 
     # max nnz over minibatches, padded to a bucket multiple (shared static shape)
     nnz_max = 1
     for g in range(n_groups):
-        k, s = divmod(g, steps)
-        lo = k * steps * mb + s * mb
+        lo = _group_lo(g)
         nnz_max = max(
             nnz_max,
             sum(len(vectors[i].indices) for i in range(lo, min(lo + mb, n))),
         )
-    nnz_pad = -(-nnz_max // pad_multiple) * pad_multiple
+    nnz_pad = max(-(-nnz_max // pad_multiple) * pad_multiple, int(min_nnz_pad))
 
     ints = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
     ints[:, 1, :] = mb  # pad row id -> dropped segment
     floats = np.zeros((n_groups, nnz_pad + 2 * mb), dtype=np.float32)
     for g in range(n_groups):
-        k, s = divmod(g, steps)
-        lo = k * steps * mb + s * mb
+        lo = _group_lo(g)
         pos = 0
         for j in range(mb):
             i = lo + j
@@ -528,32 +544,17 @@ def _sparse_loss(kind: str, logits, y, w):
     return err, loss_sum
 
 
-def make_sparse_glm_train_fn(
-    kind: str,
-    mesh,
-    mb: int,
-    nnz_pad: int,
-    dim: int,
-    learning_rate: float,
-    reg: float,
-    max_iter: int,
-    tol: float,
-    with_intercept: bool = True,
-):
-    """Fused training over :class:`SparseMinibatchStack` batches.
+def make_sparse_mb_grad_step(kind: str, mb: int, nnz_pad: int, dim: int,
+                             with_intercept: bool = True):
+    """The sparse minibatch gradient: ``(params, (ints, floats) slice) ->
+    (grads, weighted loss sum, weight sum)``.
 
-    ``kind`` picks the loss ('logistic' | 'squared').  The minibatch forward
-    is ``segment_sum(values * gather(w))`` — the batched static-shape
-    replacement for the reference's hand-rolled sparse gemv
+    The forward is ``segment_sum(values * gather(w))`` — the batched
+    static-shape replacement for the reference's hand-rolled sparse gemv
     (BLAS.java:205-233); the gradient scatters back through the same
-    segments.  Program structure is shared with the dense path via
-    :func:`_build_fused_train_fn`.
+    segments.  Shared by the fused in-memory loop and the out-of-core chunk
+    program so the two paths cannot drift.
     """
-    if kind not in ("logistic", "squared"):
-        raise ValueError(f"unknown loss kind {kind!r}")
-    key = ("sparse", kind, mesh, mb, nnz_pad, dim,
-           float(learning_rate), float(reg), int(max_iter), float(tol),
-           bool(with_intercept))
     keep_b = 1.0 if with_intercept else 0.0
 
     def mb_grad_step(params, xs):
@@ -573,6 +574,34 @@ def make_sparse_glm_train_fn(
         )
         g_b = jnp.sum(err) * keep_b
         return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return mb_grad_step
+
+
+def make_sparse_glm_train_fn(
+    kind: str,
+    mesh,
+    mb: int,
+    nnz_pad: int,
+    dim: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+):
+    """Fused training over :class:`SparseMinibatchStack` batches.
+
+    ``kind`` picks the loss ('logistic' | 'squared'); the minibatch math is
+    :func:`make_sparse_mb_grad_step`.  Program structure is shared with the
+    dense path via :func:`_build_fused_train_fn`.
+    """
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    key = ("sparse", kind, mesh, mb, nnz_pad, dim,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept))
+    mb_grad_step = make_sparse_mb_grad_step(kind, mb, nnz_pad, dim, with_intercept)
 
     return _build_fused_train_fn(
         key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol

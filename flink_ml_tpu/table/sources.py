@@ -24,13 +24,28 @@ from flink_ml_tpu.table.table import Table
 
 
 class BoundedSource:
-    """A source whose ``read()`` returns the complete Table."""
+    """A source whose ``read()`` returns the complete Table.
+
+    ``read_chunks(max_rows)`` is the out-of-core protocol: yield the same
+    rows in the same order as ``read()``, as Tables of at most ``max_rows``
+    rows each, without ever materializing the full dataset (file sources
+    stream; the default slices a materialized read for in-memory sources).
+    This is the analog of the reference's partitioned file read
+    (LinearRegression.java:91-102 — `env.readCsvFile` produces a partitioned
+    DataSet so no node holds the whole input).
+    """
 
     def read(self) -> Table:  # pragma: no cover - interface
         raise NotImplementedError
 
     def schema(self) -> Schema:  # pragma: no cover - interface
         raise NotImplementedError
+
+    def read_chunks(self, max_rows: int) -> Iterator[Table]:
+        if max_rows <= 0:
+            raise ValueError("max_rows must be positive")
+        table = self.read()
+        yield from table.iter_batches(max_rows)
 
 
 class CollectionSource(BoundedSource):
@@ -71,6 +86,31 @@ class CsvSource(BoundedSource):
                 cols[name].append(_parse_cell(cell, typ))
         return Table.from_columns(self._schema, cols)
 
+    def read_chunks(self, max_rows: int) -> Iterator[Table]:
+        """Stream the file as Tables of at most ``max_rows`` rows — host
+        residency is bounded by one chunk, never the whole file.  Rows come
+        from the same parser as ``read()``'s pure-Python path
+        (:func:`_iter_csv_rows`), so the streamed and materialized row
+        streams cannot drift."""
+        if max_rows <= 0:
+            raise ValueError("max_rows must be positive")
+        names = self._schema.field_names
+        types = self._schema.field_types
+        cols = {n: [] for n in names}
+        count = 0
+        for raw in _iter_csv_rows(
+            self.path, self.delimiter, self.skip_header, len(names)
+        ):
+            for name, typ, cell in zip(names, types, raw):
+                cols[name].append(_parse_cell(cell, typ))
+            count += 1
+            if count == max_rows:
+                yield Table.from_columns(self._schema, cols)
+                cols = {n: [] for n in names}
+                count = 0
+        if count:
+            yield Table.from_columns(self._schema, cols)
+
 
 class LibSvmSource(BoundedSource):
     """LibSVM/SVMlight text: ``label idx:val idx:val ...`` with 1-based or
@@ -91,24 +131,122 @@ class LibSvmSource(BoundedSource):
             labels, vecs = native.read_libsvm(self.path, self.n_features, self.zero_based)
             return Table.from_columns(self._schema, {"label": labels, "features": vecs})
         labels: List[float] = []
-        vecs: List[SparseVector] = []
+        vecs: List = []
         max_idx = -1
-        offset = 0 if self.zero_based else 1
-        with open(self.path) as f:
-            for line in f:
-                line = line.split("#", 1)[0].strip()
-                if not line:
-                    continue
-                parts = line.split()
-                labels.append(float(parts[0]))
-                idx = np.array([int(p.split(":", 1)[0]) - offset for p in parts[1:]], dtype=np.int64)
-                val = np.array([float(p.split(":", 1)[1]) for p in parts[1:]])
-                if idx.size:
-                    max_idx = max(max_idx, int(idx.max()))
-                vecs.append((idx, val))
+        for label, idx, val in _iter_libsvm_rows(self.path, self.zero_based):
+            labels.append(label)
+            if idx.size:
+                max_idx = max(max_idx, int(idx.max()))
+            vecs.append((idx, val))
         dim = self.n_features if self.n_features is not None else max_idx + 1
         sparse = [SparseVector(dim, i, v) for i, v in vecs]
         return Table.from_columns(self._schema, {"label": labels, "features": sparse})
+
+    def read_chunks(self, max_rows: int) -> Iterator[Table]:
+        """Stream the file as chunks of at most ``max_rows`` rows, via the
+        same parser as ``read()``'s pure-Python path (:func:`_iter_libsvm_rows`).
+
+        Requires ``n_features``: the global dimension cannot be inferred
+        without a full pass, and out-of-core training must know the model
+        width up front (Criteo-style hashed feature spaces fix it anyway).
+        """
+        if max_rows <= 0:
+            raise ValueError("max_rows must be positive")
+        if self.n_features is None:
+            raise ValueError(
+                "chunked LibSVM reads require n_features (the global feature "
+                "dimension cannot be inferred without materializing the file)"
+            )
+        dim = self.n_features
+        labels: List[float] = []
+        vecs: List[SparseVector] = []
+        for label, idx, val in _iter_libsvm_rows(self.path, self.zero_based):
+            labels.append(label)
+            vecs.append(SparseVector(dim, idx, val))
+            if len(labels) == max_rows:
+                yield Table.from_columns(
+                    self._schema, {"label": labels, "features": vecs}
+                )
+                labels, vecs = [], []
+        if labels:
+            yield Table.from_columns(
+                self._schema, {"label": labels, "features": vecs}
+            )
+
+
+class ShardedSource(BoundedSource):
+    """A bounded source over an ordered list of file shards.
+
+    The analog of the reference reading a directory of part-files as one
+    partitioned DataSet: ``read()`` concatenates all shards (only for
+    datasets that fit), ``read_chunks`` streams shard after shard so host
+    residency stays bounded by one chunk regardless of total size.
+
+    ``ShardedSource.glob(pattern, make_source)`` builds one from a filename
+    pattern, sorted for a deterministic row order.
+    """
+
+    def __init__(self, sources: Sequence[BoundedSource]):
+        if not sources:
+            raise ValueError("ShardedSource needs at least one shard")
+        schemas = {
+            (tuple(s.schema().field_names), tuple(s.schema().field_types))
+            for s in sources
+        }
+        if len(schemas) > 1:
+            raise ValueError(f"shard schemas differ: {schemas}")
+        self.sources = list(sources)
+
+    def schema(self) -> Schema:
+        return self.sources[0].schema()
+
+    def read(self) -> Table:
+        return Table.concat([s.read() for s in self.sources])
+
+    def read_chunks(self, max_rows: int) -> Iterator[Table]:
+        for source in self.sources:
+            yield from source.read_chunks(max_rows)
+
+    @staticmethod
+    def glob(pattern: str, make_source: Callable[[str], BoundedSource]) -> "ShardedSource":
+        import glob as _glob
+
+        paths = sorted(_glob.glob(pattern))
+        if not paths:
+            raise FileNotFoundError(f"no files match {pattern!r}")
+        return ShardedSource([make_source(p) for p in paths])
+
+
+class ChunkedTable:
+    """A lazy, source-backed table: the out-of-core input to Estimator.fit.
+
+    Wraps a :class:`BoundedSource` plus a chunk-row cap.  Training drivers
+    iterate ``chunks()`` (each chunk a bounded materialized Table) and never
+    hold more than ~two chunks at once (one being packed, one in flight to
+    the device).  ``materialize()`` exists for small-data escape hatches and
+    tests — production out-of-core paths must not call it.
+    """
+
+    is_chunked = True
+
+    def __init__(self, source: BoundedSource, chunk_rows: int):
+        if chunk_rows <= 0:
+            raise ValueError("chunk_rows must be positive")
+        self.source = source
+        self.chunk_rows = int(chunk_rows)
+
+    @property
+    def schema(self) -> Schema:
+        return self.source.schema()
+
+    def chunks(self) -> Iterator[Table]:
+        return self.source.read_chunks(self.chunk_rows)
+
+    def materialize(self) -> Table:
+        return self.source.read()
+
+    def __repr__(self) -> str:
+        return f"ChunkedTable({type(self.source).__name__}, chunk_rows={self.chunk_rows})"
 
 
 class UnboundedSource:
@@ -165,15 +303,10 @@ def _native_lib():
         return None
 
 
-def _read_csv_cells(path: str, delimiter: str, skip_header: bool, arity: int):
-    native = _native_lib()
-    if native is not None:
-        rows = native.read_csv(path, delimiter, skip_header, arity)
-        if rows is not None:
-            return rows
-        # None: input not representable in the native transport (control
-        # bytes inside quoted cells) — parse it with the pure reader below
-    out = []
+def _iter_csv_rows(path: str, delimiter: str, skip_header: bool, arity: int):
+    """The one pure-Python CSV row stream: ``read()`` (native-loader
+    fallback) and ``read_chunks`` both consume it, so the materialized and
+    streamed row sequences are the same parser's output by construction."""
     with open(path, newline="") as f:
         reader = csv.reader(f, delimiter=delimiter)
         for i, row in enumerate(reader):
@@ -185,8 +318,37 @@ def _read_csv_cells(path: str, delimiter: str, skip_header: bool, arity: int):
                 raise ValueError(
                     f"{path}: row {i} has {len(row)} fields, schema expects {arity}"
                 )
-            out.append(row)
-    return out
+            yield row
+
+
+def _iter_libsvm_rows(path: str, zero_based: bool):
+    """The one pure-Python LibSVM row stream (``label idx:val ...`` with
+    ``#`` comments): yields ``(label, indices, values)``; shared by
+    ``read()``'s fallback and ``read_chunks``."""
+    offset = 0 if zero_based else 1
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            idx = np.array(
+                [int(p.split(":", 1)[0]) - offset for p in parts[1:]],
+                dtype=np.int64,
+            )
+            val = np.array([float(p.split(":", 1)[1]) for p in parts[1:]])
+            yield float(parts[0]), idx, val
+
+
+def _read_csv_cells(path: str, delimiter: str, skip_header: bool, arity: int):
+    native = _native_lib()
+    if native is not None:
+        rows = native.read_csv(path, delimiter, skip_header, arity)
+        if rows is not None:
+            return rows
+        # None: input not representable in the native transport (control
+        # bytes inside quoted cells) — parse it with the pure reader below
+    return list(_iter_csv_rows(path, delimiter, skip_header, arity))
 
 
 def _parse_cell(cell: str, typ: str):

@@ -1,0 +1,251 @@
+"""Out-of-core training tests (VERDICT r02 gap #1).
+
+The contract under test: a fit that streams chunks from a file/source — with
+an in-memory cap far smaller than the dataset — produces the *bit-identical*
+model of the materialized in-memory fit, for any chunk size, because
+step-major packing pins the row->SGD-step mapping regardless of chunking.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.lib import LinearRegression, LogisticRegression
+from flink_ml_tpu.ops.vector import SparseVector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.sources import (
+    ChunkedTable,
+    CollectionSource,
+    CsvSource,
+    LibSvmSource,
+    ShardedSource,
+)
+from flink_ml_tpu.table.table import Table
+
+SCHEMA = Schema.of(
+    ("f0", "double"), ("f1", "double"), ("f2", "double"), ("label", "double")
+)
+
+
+def dense_data(n=5000, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    y = X @ np.array([2.0, -1.0, 0.5]) + 1.0 + 0.01 * rng.randn(n)
+    table = Table.from_columns(
+        SCHEMA, {"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2], "label": y}
+    )
+    return table, X, y
+
+
+def make_estimator(cls=LinearRegression, batch=256, iters=5):
+    return (
+        cls()
+        .set_feature_cols(["f0", "f1", "f2"])
+        .set_label_col("label")
+        .set_prediction_col("pred")
+        .set_learning_rate(0.05)
+        .set_global_batch_size(batch)
+        .set_max_iter(iters)
+    )
+
+
+class _CountingSource(CollectionSource):
+    """Fails the test if anything materializes the full table."""
+
+    def __init__(self, rows, schema):
+        super().__init__(rows, schema)
+        self.full_reads = 0
+
+    def read(self):
+        self.full_reads += 1
+        return super().read()
+
+    def read_chunks(self, max_rows):
+        table = self._table
+        for start in range(0, table.num_rows(), max_rows):
+            yield table.slice_rows(start, min(start + max_rows, table.num_rows()))
+
+
+class TestDenseOutOfCore:
+    def test_bit_matches_in_memory_fit(self):
+        table, X, y = dense_data()
+        in_mem = make_estimator().fit(table)
+        source = _CountingSource(table.to_rows(), SCHEMA)
+        chunked = ChunkedTable(source, chunk_rows=1024)
+        streamed = make_estimator().fit(chunked)
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+        assert streamed.intercept() == in_mem.intercept()
+        assert source.full_reads == 0, "out-of-core fit materialized the table"
+        assert streamed.train_epochs_ == in_mem.train_epochs_
+        np.testing.assert_allclose(
+            streamed.train_losses_, in_mem.train_losses_, rtol=1e-6
+        )
+
+    def test_chunk_size_invariance(self):
+        table, _, _ = dense_data(3000)
+        rows = table.to_rows()
+        results = []
+        for chunk_rows in (257, 1024, 2999, 5000):
+            chunked = ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows)
+            results.append(make_estimator(iters=3).fit(chunked).coefficients())
+        for r in results[1:]:
+            np.testing.assert_array_equal(r, results[0])
+
+    def test_respects_memory_cap_and_trains_larger_dataset(self, tmp_path):
+        """A CSV deliberately larger than the chunk cap streams through
+        bounded chunks and still bit-matches the materialized fit."""
+        table, X, y = dense_data(20000, seed=3)
+        path = tmp_path / "big.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        cap_rows = 2048
+        source = CsvSource(str(path), SCHEMA)
+        max_seen = 0
+        for chunk in source.read_chunks(cap_rows):
+            max_seen = max(max_seen, chunk.num_rows())
+        assert max_seen <= cap_rows
+        in_mem = make_estimator(iters=3).fit(source.read())
+        streamed = make_estimator(iters=3).fit(
+            ChunkedTable(source, chunk_rows=cap_rows)
+        )
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+
+    def test_sharded_source_matches_single_file(self, tmp_path):
+        table, X, y = dense_data(4000, seed=11)
+        data = np.column_stack([X, y])
+        whole = tmp_path / "whole.csv"
+        np.savetxt(whole, data, delimiter=",", fmt="%.17g")
+        for i, lo in enumerate(range(0, 4000, 1000)):
+            np.savetxt(
+                tmp_path / f"part-{i:05d}.csv", data[lo : lo + 1000],
+                delimiter=",", fmt="%.17g",
+            )
+        sharded = ShardedSource.glob(
+            str(tmp_path / "part-*.csv"), lambda p: CsvSource(p, SCHEMA)
+        )
+        m1 = make_estimator(iters=3).fit(
+            ChunkedTable(CsvSource(str(whole), SCHEMA), chunk_rows=640)
+        )
+        m2 = make_estimator(iters=3).fit(ChunkedTable(sharded, chunk_rows=640))
+        np.testing.assert_array_equal(m2.coefficients(), m1.coefficients())
+
+    def test_tol_early_stop_parity(self):
+        table, _, _ = dense_data(2000)
+        est = lambda: make_estimator(iters=200).set_tol(1e-3)  # noqa: E731
+        in_mem = est().fit(table)
+        streamed = est().fit(
+            ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 512)
+        )
+        assert streamed.train_epochs_ == in_mem.train_epochs_
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+
+    def test_checkpoint_resume_matches_uninterrupted(self, tmp_path):
+        table, _, _ = dense_data(2000)
+        rows = table.to_rows()
+        full = make_estimator(iters=6).fit(
+            ChunkedTable(CollectionSource(rows, SCHEMA), 512)
+        )
+        ckpt = str(tmp_path / "ck")
+
+        def est(iters):
+            return (
+                make_estimator(iters=iters)
+                .set_checkpoint_dir(ckpt)
+                .set_checkpoint_interval(2)
+            )
+
+        est(3).fit(ChunkedTable(CollectionSource(rows, SCHEMA), 512))
+        resumed = est(6).fit(ChunkedTable(CollectionSource(rows, SCHEMA), 512))
+        assert resumed.train_epochs_ == 6
+        np.testing.assert_allclose(
+            resumed.coefficients(), full.coefficients(), rtol=1e-6, atol=1e-9
+        )
+
+    def test_requires_explicit_batch_size(self):
+        table, _, _ = dense_data(100)
+        chunked = ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 64)
+        with pytest.raises(ValueError, match="globalBatchSize"):
+            make_estimator(batch=0).fit(chunked)
+
+
+def sparse_data(n=3000, dim=500, nnz=8, seed=5):
+    rng = np.random.RandomState(seed)
+    true_w = rng.randn(dim) * (rng.rand(dim) < 0.2)
+    vectors, labels = [], []
+    for _ in range(n):
+        idx = np.sort(rng.choice(dim, size=nnz, replace=False))
+        vals = rng.randn(nnz)
+        score = float(vals @ true_w[idx])
+        labels.append(1.0 if score + 0.3 * rng.randn() > 0 else 0.0)
+        vectors.append(SparseVector(dim, idx, vals))
+    schema = Schema.of(("features", DataTypes.SPARSE_VECTOR), ("label", "double"))
+    table = Table.from_columns(schema, {"features": vectors, "label": labels})
+    return table, vectors, np.asarray(labels), dim
+
+
+class TestSparseOutOfCore:
+    def make_est(self, dim, iters=4):
+        return (
+            LogisticRegression()
+            .set_vector_col("features")
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_num_features(dim)
+            .set_learning_rate(0.1)
+            .set_global_batch_size(256)
+            .set_max_iter(iters)
+        )
+
+    def test_bit_matches_in_memory_sparse_fit(self):
+        table, vectors, labels, dim = sparse_data()
+        in_mem = self.make_est(dim).fit(table)
+        chunked = ChunkedTable(
+            CollectionSource(table.to_rows(), table.schema), chunk_rows=700
+        )
+        streamed = self.make_est(dim).fit(chunked)
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+        assert streamed.intercept() == in_mem.intercept()
+
+    def test_libsvm_stream_matches_materialized(self, tmp_path):
+        table, vectors, labels, dim = sparse_data(n=1500)
+        path = tmp_path / "data.svm"
+        with open(path, "w") as f:
+            for label, v in zip(labels, vectors):
+                feats = " ".join(
+                    f"{int(i) + 1}:{val:.17g}" for i, val in zip(v.indices, v.vals)
+                )
+                f.write(f"{label:g} {feats}\n")
+        source = LibSvmSource(str(path), n_features=dim)
+        in_mem = self.make_est(dim, iters=3).fit(source.read())
+        streamed = self.make_est(dim, iters=3).fit(
+            ChunkedTable(source, chunk_rows=400)
+        )
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+
+    def test_chunked_libsvm_requires_dim(self, tmp_path):
+        path = tmp_path / "d.svm"
+        path.write_text("1 1:0.5 3:1.0\n0 2:0.25\n")
+        source = LibSvmSource(str(path))
+        with pytest.raises(ValueError, match="n_features"):
+            next(source.read_chunks(10))
+
+    def test_overflowing_nnz_budget_fails_loudly(self):
+        table, vectors, labels, dim = sparse_data(n=600, nnz=4)
+        # densify the tail: the estimate from the stream head undershoots
+        rng = np.random.RandomState(0)
+        rows = table.to_rows()
+        dense_tail = []
+        for _, label in rows[-100:]:
+            idx = np.sort(rng.choice(dim, size=400, replace=False))
+            dense_tail.append((SparseVector(dim, idx, rng.randn(400)), label))
+        source = CollectionSource(rows[:-100] + dense_tail, table.schema)
+        with pytest.raises(ValueError, match="nnz_pad"):
+            self.make_est(dim, iters=2).fit(ChunkedTable(source, chunk_rows=200))
