@@ -458,21 +458,8 @@ def bench_sparse(n_rows=100_000, dim=1_000_000, nnz=39, epochs=40, batch=8192):
     from flink_ml_tpu.lib import LogisticRegression
     from flink_ml_tpu.table.sources import LibSvmSource
 
-    rng = np.random.RandomState(5)
     # synthetic LibSVM file: power-law-ish hashed indices, ~nnz per row
-    path = os.path.join(tempfile.gettempdir(), f"criteo_shaped_{n_rows}.svm")
-    if not os.path.exists(path):
-        hot = rng.randint(0, 50_000, size=(n_rows, nnz - 10))
-        cold = rng.randint(50_000, dim, size=(n_rows, 10))
-        idx = np.concatenate([hot, cold], axis=1)
-        idx.sort(axis=1)
-        true_w = rng.randn(dim).astype(np.float32) * 0.3
-        with open(path, "w") as f:
-            for i in range(n_rows):
-                ii = np.unique(idx[i])
-                label = 1 if true_w[ii].sum() > 0 else 0
-                f.write(str(label) + " " +
-                        " ".join(f"{j}:1" for j in ii) + "\n")
+    path = bench_sparse_file(n_rows, dim, nnz)
 
     t0 = time.perf_counter()
     table = LibSvmSource(path, n_features=dim, zero_based=True).read()
@@ -525,6 +512,99 @@ def bench_sparse(n_rows=100_000, dim=1_000_000, nnz=39, epochs=40, batch=8192):
     })
 
 
+def bench_sparse_ooc(n_rows=100_000, dim=1_000_000, nnz=39, epochs=10,
+                     batch=8192, chunk_rows=16_384):
+    """Larger-than-RAM variant of the Criteo-shaped workload: the same
+    LibSVM file trained through the out-of-core path (lib/out_of_core.py)
+    with host residency capped at ``chunk_rows`` rows (~1/6 of the dataset)
+    — chunks re-parse from disk every epoch and prefetch host->device while
+    the previous chunk trains.  ``vs_in_memory`` is the throughput ratio
+    against the fully-resident fused fit of the identical program (the
+    streaming overhead the chunked feed pays for unbounded scale).
+    """
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.table.sources import ChunkedTable, LibSvmSource
+
+    path = bench_sparse_file(n_rows, dim, nnz)
+    source = LibSvmSource(path, n_features=dim, zero_based=True)
+
+    def est():
+        return (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_num_features(dim).set_learning_rate(0.5)
+            .set_global_batch_size(batch).set_max_iter(epochs)
+        )
+
+    # in-memory reference run (same epochs) for the overhead ratio
+    table = source.read()
+    mem_sps, mem_model = _steady_fit_sps(lambda: est().fit(table))
+
+    # one-epoch run isolates the parse cost; the N-epoch run's remaining
+    # (N-1) epochs stream binary spill, so their per-epoch time decomposes
+    # the steady streaming tax (on this tunneled device it is dominated by
+    # the per-epoch host->device re-transfer the out-of-core contract
+    # requires; in-memory transfers once and stays resident).  The in-memory
+    # reference fit above already compiled the fused program but the chunk
+    # program is distinct — warm it first so neither timed run pays compile.
+    est().set_max_iter(1).fit(ChunkedTable(source, chunk_rows))
+    t0 = time.perf_counter()
+    est().set_max_iter(1).fit(ChunkedTable(source, chunk_rows, spill=True))
+    first_epoch_s = time.perf_counter() - t0
+
+    chunked = ChunkedTable(source, chunk_rows=chunk_rows, spill=True)
+    t0 = time.perf_counter()
+    model = est().fit(chunked)
+    wall = time.perf_counter() - t0
+    ooc_sps = n_rows * epochs / wall
+    steady_epoch_s = max(wall - first_epoch_s, 1e-9) / max(epochs - 1, 1)
+    # bytes a steady epoch moves host->device: segment-CSR ints + floats,
+    # sized with the SAME estimator the fit uses (includes its safety pad)
+    from flink_ml_tpu.lib.out_of_core import estimate_nnz_pad
+
+    mb_per_dev = -(-batch // _n_chips())
+    nnz_pad = estimate_nnz_pad(
+        ChunkedTable(source, chunk_rows), "features", mb_per_dev, _n_chips()
+    )
+    blocks = -(-n_rows // batch)
+    epoch_bytes = blocks * (2 * nnz_pad * 4 + (nnz_pad + 2 * mb_per_dev) * 4)
+
+    drift = float(np.max(np.abs(model.coefficients() - mem_model.coefficients())))
+    return _emit({
+        "metric": "Out-of-core sparse LogisticRegression.fit samples/sec/chip",
+        "value": round(ooc_sps / _n_chips(), 1),
+        "unit": "samples/sec/chip",
+        "vs_in_memory": round(ooc_sps / mem_sps, 3),
+        "host_cap_rows": chunk_rows,
+        "bit_match_in_memory": bool(drift == 0.0),
+        "first_epoch_s": round(first_epoch_s, 2),
+        "steady_epoch_s": round(steady_epoch_s, 3),
+        "steady_epoch_mb": round(epoch_bytes / 1e6, 1),
+        "steady_stream_mb_per_s": round(epoch_bytes / 1e6 / steady_epoch_s, 1),
+        "shape": f"{n_rows} rows, {dim} features, ~{nnz} nnz/row, "
+                 f"batch={batch} epochs={epochs} chunk_rows={chunk_rows}",
+    })
+
+
+def bench_sparse_file(n_rows, dim, nnz):
+    """Create (once) the synthetic Criteo-shaped LibSVM file."""
+    rng = np.random.RandomState(5)
+    path = os.path.join(tempfile.gettempdir(), f"criteo_shaped_{n_rows}.svm")
+    if not os.path.exists(path):
+        hot = rng.randint(0, 50_000, size=(n_rows, nnz - 10))
+        cold = rng.randint(50_000, dim, size=(n_rows, 10))
+        idx = np.concatenate([hot, cold], axis=1)
+        idx.sort(axis=1)
+        true_w = rng.randn(dim).astype(np.float32) * 0.3
+        with open(path, "w") as f:
+            for i in range(n_rows):
+                ii = np.unique(idx[i])
+                label = 1 if true_w[ii].sum() > 0 else 0
+                f.write(str(label) + " " +
+                        " ".join(f"{j}:1" for j in ii) + "\n")
+    return path
+
+
 WORKLOADS = {
     "logreg": bench_logreg,
     "logreg_wide": bench_logreg_wide,
@@ -533,6 +613,7 @@ WORKLOADS = {
     "knn": bench_knn,
     "online": bench_online,
     "sparse": bench_sparse,
+    "sparse_ooc": bench_sparse_ooc,
 }
 
 

@@ -367,7 +367,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 )
 
             blocks = oc.sparse_blocks_factory(
-                table, extract, mesh, n_dev, mb, steps_per_chunk, dim, nnz_pad
+                table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad
             )
             from flink_ml_tpu.lib.common import make_sparse_mb_grad_step
 
@@ -400,7 +400,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 )
 
             blocks = oc.dense_blocks_factory(
-                table, extract, mesh, n_dev, mb, steps_per_chunk
+                table, extract, n_dev, mb, steps_per_chunk
             )
             grad_fn = self._grad_fn()
 
@@ -409,17 +409,29 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
             key = ("chunk-dense", grad_fn, mesh, float(lr), float(reg))
 
+        spill = None
+        # spill pays a full packed disk copy to speed epochs 2+; a
+        # single-epoch fit has no later epoch to amortize it
+        if getattr(table, "spill", False) and self.get_max_iter() > 1:
+            import tempfile
+
+            spill = oc.BlockSpill(tempfile.mkdtemp(prefix="fmt_spill_"))
+            blocks = spill.wrap(blocks)
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        result = oc.train_out_of_core(
-            (w0, b0),
-            blocks,
-            lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
-            mesh,
-            max_iter=self.get_max_iter(),
-            tol=self.get_tol(),
-            checkpoint=checkpoint,
-        )
+        try:
+            result = oc.train_out_of_core(
+                (w0, b0),
+                blocks,
+                lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
+                mesh,
+                max_iter=self.get_max_iter(),
+                tol=self.get_tol(),
+                checkpoint=checkpoint,
+            )
+        finally:
+            if spill is not None:
+                spill.close()
         return self._finish(result)
 
     def _finish(self, result) -> GlmModelBase:

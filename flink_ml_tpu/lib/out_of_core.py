@@ -211,13 +211,14 @@ def train_out_of_core(
     """The streaming epoch engine.
 
     ``blocks_factory()`` restarts the chunk stream for an epoch, yielding
-    ``(placed_batch, n_real_rows)`` (already on the mesh — the prefetch
-    thread does pack + device_put).  ``chunk_fn_factory()`` returns the
-    compiled chunk program.  Convergence (update-norm vs ``tol``) and
-    checkpoint/resume semantics mirror the fused in-memory loop; with
-    ``tol == 0`` and no checkpoint, the whole run syncs once at the end.
+    host ``(batch, n_real_rows)``; the prefetch thread places each block on
+    the mesh (async DMA) while the device runs the previous one.
+    ``chunk_fn_factory()`` returns the compiled chunk program.  Convergence
+    (update-norm vs ``tol``) and checkpoint/resume semantics mirror the
+    fused in-memory loop; with ``tol == 0`` and no checkpoint, the whole
+    run syncs once at the end.
     """
-    from flink_ml_tpu.parallel.mesh import replicate
+    from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
     start_epoch = 0
     losses: list = []
@@ -260,7 +261,12 @@ def train_out_of_core(
         zero = jnp.zeros((), dtype=jnp.float32)
         carry = (params, zero, jnp.zeros((), dtype=jnp.float32))
         n_rows = 0
-        for placed, real_rows in _prefetch(blocks_factory()):
+
+        def placed_blocks():
+            for batch, real in blocks_factory():
+                yield shard_batch(mesh, batch), real
+
+        for placed, real_rows in _prefetch(placed_blocks()):
             carry = chunk_fn(carry, placed)
             n_rows += real_rows
         params, loss_sum, w_sum = carry
@@ -334,15 +340,13 @@ def _drain_pending(pending: list):
 def dense_blocks_factory(
     chunked_table,
     extract: Callable[[Table], Tuple[np.ndarray, np.ndarray]],
-    mesh,
     n_dev: int,
     mb: int,
     steps_per_chunk: int,
 ):
     """Blocks of ``steps_per_chunk`` global steps in the combined dense
-    layout, packed step-major and placed on the mesh by the prefetch thread."""
-    from flink_ml_tpu.parallel.mesh import shard_batch
-
+    layout, packed step-major; yields host ``(batch, n_rows)`` (the engine's
+    prefetch thread does the mesh placement)."""
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
@@ -356,8 +360,7 @@ def dense_blocks_factory(
                     X, y, n_dev, global_batch_size=mb * n_dev,
                     min_steps=steps_per_chunk,
                 )
-                placed = shard_batch(mesh, _combined_view(stack))
-                yield placed, stack.n_rows
+                yield _combined_view(stack), stack.n_rows
 
         return gen()
 
@@ -367,7 +370,6 @@ def dense_blocks_factory(
 def sparse_blocks_factory(
     chunked_table,
     extract: Callable[[Table], Tuple[list, np.ndarray]],
-    mesh,
     n_dev: int,
     mb: int,
     steps_per_chunk: int,
@@ -378,8 +380,6 @@ def sparse_blocks_factory(
     ``nnz_pad`` so every block reuses one compiled program.  A block denser
     than ``nnz_pad`` fails loudly — callers size it from the data
     (``estimate_nnz_pad``) rather than silently recompiling per block."""
-    from flink_ml_tpu.parallel.mesh import shard_batch
-
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
@@ -399,12 +399,79 @@ def sparse_blocks_factory(
                         f"lower the batch size) so one compiled program "
                         f"covers the stream"
                     )
-                placed = shard_batch(mesh, (stack.ints, stack.floats))
-                yield placed, stack.n_rows
+                yield (stack.ints, stack.floats), stack.n_rows
 
         return gen()
 
     return factory
+
+
+class BlockSpill:
+    """Parse once, stream binary thereafter.
+
+    Text parsing (CSV/LibSVM) is orders of magnitude slower than the device
+    program, so re-parsing the source every epoch leaves the chip idle.
+    Wrapping a host-block factory in a BlockSpill writes each packed block
+    to an ``.npz`` during the first epoch and streams those binary files —
+    a near-bandwidth ``np.load`` per block — on every later epoch.  Host
+    memory stays bounded at one block; disk pays one packed copy of the
+    dataset (the same trade Flink's runtime makes when it spills partitions
+    to local disk between supersteps).
+
+    The spill directory is owned by the caller and deleted via ``close()``
+    (the estimator uses a per-fit temporary directory).
+    """
+
+    def __init__(self, directory: str):
+        import os
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.complete = False
+        self._meta: list = []  # (n_rows) per block
+        self._treedef = None
+
+    def wrap(self, factory: Callable[[], Iterator]) -> Callable[[], Iterator]:
+        def wrapped():
+            if self.complete:
+                return self._load_iter()
+            return self._save_iter(factory())
+
+        return wrapped
+
+    def _path(self, i: int) -> str:
+        import os
+
+        return os.path.join(self.directory, f"block-{i:06d}.npz")
+
+    def _save_iter(self, items: Iterator):
+        import os
+
+        i = 0
+        for batch, n_rows in items:
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            self._treedef = treedef
+            tmp = self._path(i) + ".tmp"
+            with open(tmp, "wb") as f:  # file handle: savez can't rename it
+                np.savez(
+                    f, **{f"a{j:03d}": np.asarray(x) for j, x in enumerate(leaves)}
+                )
+            os.replace(tmp, self._path(i))
+            self._meta.append(int(n_rows))
+            i += 1
+            yield batch, n_rows
+        self.complete = True
+
+    def _load_iter(self):
+        for i, n_rows in enumerate(self._meta):
+            with np.load(self._path(i)) as z:
+                leaves = [z[k] for k in sorted(z.files)]
+            yield jax.tree_util.tree_unflatten(self._treedef, leaves), n_rows
+
+    def close(self):
+        import shutil
+
+        shutil.rmtree(self.directory, ignore_errors=True)
 
 
 def estimate_nnz_pad(

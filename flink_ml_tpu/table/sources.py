@@ -225,15 +225,21 @@ class ChunkedTable:
     hold more than ~two chunks at once (one being packed, one in flight to
     the device).  ``materialize()`` exists for small-data escape hatches and
     tests — production out-of-core paths must not call it.
+
+    ``spill=True`` lets multi-epoch trainers write packed binary blocks to
+    local disk on the first epoch and stream those on later epochs instead
+    of re-parsing text (lib/out_of_core.BlockSpill) — one packed copy of
+    the dataset on disk buys near-device-rate epochs after the first.
     """
 
     is_chunked = True
 
-    def __init__(self, source: BoundedSource, chunk_rows: int):
+    def __init__(self, source: BoundedSource, chunk_rows: int, spill: bool = False):
         if chunk_rows <= 0:
             raise ValueError("chunk_rows must be positive")
         self.source = source
         self.chunk_rows = int(chunk_rows)
+        self.spill = bool(spill)
 
     @property
     def schema(self) -> Schema:

@@ -165,6 +165,21 @@ class TestDenseOutOfCore:
             resumed.coefficients(), full.coefficients(), rtol=1e-6, atol=1e-9
         )
 
+    def test_spill_bit_matches_direct_stream(self, tmp_path):
+        """spill=True (binary blocks re-streamed from disk after epoch 1)
+        replays the identical schedule: bit-equal to the direct stream."""
+        table, X, y = dense_data(6000, seed=13)
+        path = tmp_path / "d.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        source = CsvSource(str(path), SCHEMA)
+        direct = make_estimator(iters=4).fit(ChunkedTable(source, 1500))
+        spilled = make_estimator(iters=4).fit(
+            ChunkedTable(source, 1500, spill=True)
+        )
+        np.testing.assert_array_equal(
+            spilled.coefficients(), direct.coefficients()
+        )
+
     def test_requires_explicit_batch_size(self):
         table, _, _ = dense_data(100)
         chunked = ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 64)
@@ -236,6 +251,26 @@ class TestSparseOutOfCore:
         source = LibSvmSource(str(path))
         with pytest.raises(ValueError, match="n_features"):
             next(source.read_chunks(10))
+
+    def test_sparse_spill_bit_matches_direct_stream(self, tmp_path):
+        """The two-leaf (ints, floats) sparse batch survives the npz
+        round-trip bit-exactly."""
+        table, vectors, labels, dim = sparse_data(n=1200)
+        path = tmp_path / "s.svm"
+        with open(path, "w") as f:
+            for label, v in zip(labels, vectors):
+                feats = " ".join(
+                    f"{int(i) + 1}:{val:.17g}" for i, val in zip(v.indices, v.vals)
+                )
+                f.write(f"{label:g} {feats}\n")
+        source = LibSvmSource(str(path), n_features=dim)
+        direct = self.make_est(dim, iters=3).fit(ChunkedTable(source, 500))
+        spilled = self.make_est(dim, iters=3).fit(
+            ChunkedTable(source, 500, spill=True)
+        )
+        np.testing.assert_array_equal(
+            spilled.coefficients(), direct.coefficients()
+        )
 
     def test_overflowing_nnz_budget_fails_loudly(self):
         table, vectors, labels, dim = sparse_data(n=600, nnz=4)
