@@ -7,11 +7,28 @@ window updates the model (PartialModelBuilder:161-174); a concurrent
 prediction stream is served by the *freshest* model (Predictor CoMap:182-211).
 
 TPU-first realization: the driver merges the timestamped streams
-deterministically on the host, fires windows when the watermark (max event
-time seen) passes the window end, and batches all prediction records that fall
-between two model updates into one device call — behaviorally identical to
-per-record CoMap (every record sees exactly the model that was current at its
-event time) but executed as batched XLA instead of a per-record hot loop.
+deterministically on the host, fires windows when the watermark passes the
+window end, and batches all prediction records that fall between two model
+updates into one device call — behaviorally identical to per-record CoMap
+(every record sees exactly the model that was current at its event time) but
+executed as batched XLA instead of a per-record hot loop.
+
+Robustness (the two pieces the reference delegates to Flink's runtime):
+
+* **Bounded out-of-orderness** — ``allowed_lateness_ms`` holds the watermark
+  ``L`` behind the max event time seen (the
+  BoundedOutOfOrdernessTimestampExtractor the reference's examples assign,
+  IncrementalLearningSkeleton.java:144-158 assigns timestamps + watermarks),
+  so multiple windows stay open concurrently and a record up to ``L`` late
+  still lands in its correct window; records later than that are routed to
+  ``StreamingResult.late_records`` (Flink's late-data side output) instead
+  of silently corrupting a window.
+* **Checkpoint/resume** — with a
+  :class:`~flink_ml_tpu.iteration.checkpoint.CheckpointConfig` the driver
+  snapshots (model state, watermark, open window buffers, pending
+  predictions, stream position) every N fired windows; a killed run resumed
+  over the same (replayable) sources fast-forwards to the recorded position
+  and continues bit-identically.
 
 Epoch accounting: window N's model update is epoch N; listeners receive epoch
 watermarks exactly as in the bounded runtime.
@@ -37,6 +54,22 @@ class StreamingResult:
     model_updates: List[Tuple[int, Any]] = field(default_factory=list)  # (window_end, state)
     #: per-window StepMetrics (SURVEY §5.5): wall time + rows per fired window
     metrics: Any = None
+    #: training records that arrived after their window closed (beyond the
+    #: allowed lateness) — the late-data side output, never silently dropped
+    late_records: List[Tuple[int, Tuple]] = field(default_factory=list)
+
+
+def _merge_streams(streams: Sequence[Iterator]) -> Iterator:
+    """Deterministic k-way merge by (event_time, kind), stream-stable ties.
+
+    For time-ordered sources this is an exact event-time merge (training
+    sorts before prediction at equal timestamps, so a model update at time T
+    serves a prediction at time T — matching connect() delivering the model
+    first).  For out-of-order sources ``heapq.merge`` degrades gracefully to
+    a deterministic head-of-stream arrival order, which the watermark
+    machinery then handles; rows are never compared (the key excludes them).
+    """
+    return heapq.merge(*streams, key=lambda e: (e[0], e[1]))
 
 
 class StreamingDriver:
@@ -53,14 +86,18 @@ class StreamingDriver:
         window_ms: int,
         keep_model_history: bool = False,
         prediction_flush_rows: int = 8192,
+        allowed_lateness_ms: int = 0,
     ):
         if window_ms <= 0:
             raise ValueError("window_ms must be positive")
+        if allowed_lateness_ms < 0:
+            raise ValueError("allowed_lateness_ms must be >= 0")
         self.window_ms = int(window_ms)
         self.keep_model_history = keep_model_history
         # predictions sharing one model version can flush early in batches of
         # this size — bounds prediction latency on long-running streams
         self.prediction_flush_rows = prediction_flush_rows
+        self.allowed_lateness_ms = int(allowed_lateness_ms)
 
     def run(
         self,
@@ -71,6 +108,7 @@ class StreamingDriver:
         predict: Optional[Callable[[Any, Table], Sequence]] = None,
         listeners: Sequence[IterationListener] = (),
         max_windows: Optional[int] = None,
+        checkpoint=None,
     ) -> StreamingResult:
         if (prediction_source is None) != (predict is None):
             raise ValueError("prediction_source and predict must be given together")
@@ -80,54 +118,79 @@ class StreamingDriver:
         context = ListenerContext()
         state = initial_state
         window_ms = self.window_ms
+        lateness = self.allowed_lateness_ms
         train_schema = training_source.schema()
         metrics = StepMetrics("stream_train")
 
-        # merge the two timestamped streams; training sorts before prediction
-        # at equal timestamps so a model update at time T serves a prediction
-        # at time T (matching connect() delivering the model first)
         TRAIN, PREDICT = 0, 1
         streams: List[Iterator] = [
             ((ts, TRAIN, row) for ts, row in training_source.stream())
         ]
         if prediction_source is not None:
             streams.append(((ts, PREDICT, row) for ts, row in prediction_source.stream()))
-        merged = heapq.merge(*streams, key=lambda e: (e[0], e[1]))
+        merged = _merge_streams(streams)
 
-        window_rows: List[Tuple] = []
-        window_end: Optional[int] = None  # current window is [window_end-w, window_end)
+        # open windows keyed by window end; several stay open when the
+        # watermark lags max event time by the allowed lateness
+        open_windows: dict = {}
         pending_predictions: List[Tuple[int, Tuple]] = []
         predictions: List[Tuple[int, Any]] = []
         model_updates: List[Tuple[int, Any]] = []
+        late_records: List[Tuple[int, Tuple]] = []
+        watermark: Optional[int] = None
         epoch = 0
+        consumed = 0  # records taken from the merged stream (for resume)
+        last_snapshot_epoch = -1
         stopped = False
 
-        def flush_predictions():
-            if not pending_predictions or predict is None:
+        if checkpoint is not None:
+            restored = self._restore(checkpoint, state, train_schema,
+                                     prediction_source)
+            if restored is not None:
+                (state, epoch, watermark, open_windows,
+                 pending_predictions, late_records, skip) = restored
+                for _ in range(skip):
+                    if next(merged, None) is None:
+                        break  # replayed stream shorter than the snapshot cut
+                consumed = skip
+
+        def flush_predictions(before_ts: Optional[int] = None):
+            """Serve pending predictions with the current model; with
+            ``before_ts`` only those event-timed before it (they precede the
+            imminent model update in event time)."""
+            if predict is None or not pending_predictions:
                 return
+            if before_ts is None:
+                batch_items = list(pending_predictions)
+                pending_predictions.clear()
+            else:
+                batch_items = [p for p in pending_predictions if p[0] < before_ts]
+                if not batch_items:
+                    return
+                pending_predictions[:] = [
+                    p for p in pending_predictions if p[0] >= before_ts
+                ]
             batch = Table.from_rows(
-                [row for _, row in pending_predictions], prediction_source.schema()
+                [row for _, row in batch_items], prediction_source.schema()
             )
             outs = list(predict(state, batch))
-            if len(outs) != len(pending_predictions):
+            if len(outs) != len(batch_items):
                 raise ValueError(
                     f"predict returned {len(outs)} values for a batch of "
-                    f"{len(pending_predictions)} rows"
+                    f"{len(batch_items)} rows"
                 )
-            for (ts, _), out in zip(pending_predictions, outs):
+            for (ts, _), out in zip(batch_items, outs):
                 predictions.append((ts, out))
-            pending_predictions.clear()
 
         def fire_window(end_ts: int):
             nonlocal state, epoch, stopped
             # predictions timestamped before this window's close see the old model
-            flush_predictions()
+            flush_predictions(before_ts=end_ts)
+            rows = open_windows.pop(end_ts)
             metrics.start_step()
-            n_rows = len(window_rows)
-            table = Table.from_rows(window_rows, train_schema)
-            window_rows.clear()
+            table = Table.from_rows(rows, train_schema)
             state = update(state, table, epoch)
-            metrics.end_step(samples=n_rows, window_end=end_ts)
+            metrics.end_step(samples=len(rows), window_end=end_ts)
             if self.keep_model_history:
                 model_updates.append((end_ts, state))
             for listener in listeners:
@@ -136,27 +199,60 @@ class StreamingDriver:
             if max_windows is not None and epoch >= max_windows:
                 stopped = True
 
+        def fire_ready():
+            """Fire every open window whose end the watermark passed, in
+            event-time order."""
+            while not stopped:
+                ready = [e for e in open_windows if watermark is not None and e <= watermark]
+                if not ready:
+                    return
+                fire_window(min(ready))
+
         for ts, kind, row in merged:
-            if window_end is None:
-                window_end = (ts // window_ms + 1) * window_ms
-            # the watermark (= ts, streams are time-ordered) may close windows
-            while ts >= window_end and not stopped:
-                if window_rows:
-                    fire_window(window_end)
-                # empty window: no model update, the watermark still advances
-                window_end += window_ms
-            if stopped:
-                break
+            consumed += 1
+            new_wm = ts - lateness
+            if watermark is None or new_wm > watermark:
+                watermark = new_wm
             if kind == TRAIN:
-                window_rows.append(tuple(row))
+                end = (ts // window_ms + 1) * window_ms
+                if watermark is not None and end <= watermark:
+                    # the watermark passed this window's end (it fired, or
+                    # would have fired empty): beyond the allowed lateness —
+                    # side output, loudly kept (Flink's isWindowLate rule)
+                    late_records.append((ts, tuple(row)))
+                else:
+                    open_windows.setdefault(end, []).append(tuple(row))
             else:
                 pending_predictions.append((ts, tuple(row)))
                 if len(pending_predictions) >= self.prediction_flush_rows:
                     flush_predictions()
+            fire_ready()
+            if stopped:
+                break
+            if (
+                checkpoint is not None
+                and epoch > 0
+                and epoch % checkpoint.every_n_epochs == 0
+                and epoch != last_snapshot_epoch
+            ):
+                pred_schema = (
+                    prediction_source.schema()
+                    if prediction_source is not None else None
+                )
+                self._snapshot(checkpoint, state, epoch, watermark,
+                               open_windows, pending_predictions,
+                               late_records, consumed,
+                               train_schema, pred_schema)
+                last_snapshot_epoch = epoch
 
-        # end of streams: fire the final partial window, then flush predictions
-        if not stopped and window_rows:
-            fire_window(window_end if window_end is not None else window_ms)
+        # end of streams: every still-open window fires (the watermark
+        # advances to infinity), then remaining predictions flush
+        if not stopped:
+            watermark = None
+            for end in sorted(open_windows):
+                if stopped:
+                    break
+                fire_window(end)
         flush_predictions()
 
         for listener in listeners:
@@ -168,6 +264,82 @@ class StreamingDriver:
             listener_context=context,
             model_updates=model_updates,
             metrics=metrics,
+            late_records=late_records,
+        )
+
+    # -- snapshot/restore -----------------------------------------------------
+
+    def _snapshot(self, checkpoint, state, epoch, watermark,
+                  open_windows, pending_predictions, late_records, consumed,
+                  train_schema, pred_schema):
+        """Persist a consistent cut of the stream computation: everything
+        needed to continue as if never killed (model state as npz leaves;
+        positions and codec-encoded buffers in the JSON sidecar)."""
+        from flink_ml_tpu.iteration.checkpoint import (
+            prune_checkpoints,
+            save_checkpoint,
+        )
+        from flink_ml_tpu.utils.persistence import encode_row
+
+        meta = {
+            "stream": {
+                "watermark": watermark,
+                "consumed": consumed,
+                "windows": {
+                    str(end): [encode_row(r, train_schema) for r in rows]
+                    for end, rows in open_windows.items()
+                },
+                "pending_predictions": [
+                    [ts, encode_row(r, pred_schema)]
+                    for ts, r in pending_predictions
+                ],
+                # side output so far: carried so a resumed run's result
+                # equals the uninterrupted run's (lates are rare by
+                # definition — beyond the allowed disorder bound)
+                "late": [
+                    [ts, encode_row(r, train_schema)] for ts, r in late_records
+                ],
+            }
+        }
+        save_checkpoint(checkpoint.directory, epoch - 1, state, meta=meta)
+        prune_checkpoints(checkpoint.directory, checkpoint.keep)
+
+    def _restore(self, checkpoint, like_state, train_schema, prediction_source):
+        from flink_ml_tpu.iteration.checkpoint import (
+            latest_checkpoint,
+            load_checkpoint,
+        )
+        from flink_ml_tpu.utils.persistence import decode_row
+
+        latest = latest_checkpoint(checkpoint.directory)
+        if latest is None:
+            return None
+        state, meta = load_checkpoint(latest, like=like_state)
+        stream = meta.get("stream", {})
+        epoch = int(meta["epoch"]) + 1
+        pred_schema = (
+            prediction_source.schema() if prediction_source is not None else None
+        )
+        open_windows = {
+            int(end): [decode_row(r, train_schema) for r in rows]
+            for end, rows in stream.get("windows", {}).items()
+        }
+        pending = [
+            (int(ts), decode_row(r, pred_schema))
+            for ts, r in stream.get("pending_predictions", [])
+        ]
+        late = [
+            (int(ts), decode_row(r, train_schema))
+            for ts, r in stream.get("late", [])
+        ]
+        return (
+            state,
+            epoch,
+            stream.get("watermark"),
+            open_windows,
+            pending,
+            late,
+            int(stream.get("consumed", 0)),
         )
 
 
@@ -178,6 +350,7 @@ def iterate_unbounded(
     window_ms: int = 5000,
     keep_model_history: bool = False,
     prediction_flush_rows: int = 8192,
+    allowed_lateness_ms: int = 0,
     **run_kwargs,
 ) -> StreamingResult:
     """Functional entry point (Iterations.iterateUnboundedStreams analog)."""
@@ -185,5 +358,6 @@ def iterate_unbounded(
         window_ms,
         keep_model_history=keep_model_history,
         prediction_flush_rows=prediction_flush_rows,
+        allowed_lateness_ms=allowed_lateness_ms,
     )
     return driver.run(initial_state, training_source, update, **run_kwargs)

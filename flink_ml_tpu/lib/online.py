@@ -25,7 +25,7 @@ from flink_ml_tpu.iteration.unbounded import StreamingDriver, StreamingResult
 from flink_ml_tpu.lib.classification import LogisticRegressionModel, _log_loss_grads
 from flink_ml_tpu.lib.common import bucket_rows, make_sgd_update, resolve_features
 from flink_ml_tpu.lib.glm import GlmTrainParams, make_model_table
-from flink_ml_tpu.lib.params import HasWindowMs
+from flink_ml_tpu.lib.params import HasAllowedLateness, HasWindowMs
 from flink_ml_tpu.table.sources import UnboundedSource
 from flink_ml_tpu.table.table import Table
 
@@ -48,7 +48,7 @@ class _PeekedSource(UnboundedSource):
         return self._inner.schema()
 
 
-class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
+class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowedLateness):
     """Streaming binary LR: one SGD step per fired event-time window.
 
     ``fit`` consumes a *bounded* table by replaying it as a timestamped
@@ -140,8 +140,18 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
             jnp.zeros((), dtype=jnp.float32),
         )
         driver = StreamingDriver(
-            window_ms=self.get_window_ms(), keep_model_history=keep_model_history
+            window_ms=self.get_window_ms(),
+            keep_model_history=keep_model_history,
+            allowed_lateness_ms=self.get_allowed_lateness_ms(),
         )
+        checkpoint = None
+        if self.get_checkpoint_dir() is not None:
+            from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+            checkpoint = CheckpointConfig(
+                directory=self.get_checkpoint_dir(),
+                every_n_epochs=self.get_checkpoint_interval(),
+            )
         result = driver.run(
             params0,
             training_source,
@@ -149,6 +159,7 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs):
             prediction_source=prediction_source,
             predict=predict if prediction_source is not None else None,
             max_windows=max_windows,
+            checkpoint=checkpoint,
         )
         w, b = (np.asarray(a) for a in result.final_state)
         model = LogisticRegressionModel()

@@ -201,6 +201,23 @@ class HasWindowMs(WithParams):
         return self.set(self.WINDOW_MS, value)
 
 
+class HasAllowedLateness(WithParams):
+    ALLOWED_LATENESS_MS: ParamInfo = param_info(
+        "allowedLatenessMs",
+        "Bounded event-time out-of-orderness: the watermark trails the max "
+        "event time seen by this much, so records up to this late still land "
+        "in their window (later ones go to the late-data side output).",
+        default=0, value_type=int,
+        validator=lambda v: v >= 0,
+    )
+
+    def get_allowed_lateness_ms(self) -> int:
+        return self.get(self.ALLOWED_LATENESS_MS)
+
+    def set_allowed_lateness_ms(self, value: int):
+        return self.set(self.ALLOWED_LATENESS_MS, value)
+
+
 class HasK(WithParams):
     K: ParamInfo = param_info(
         "k", "Number of clusters / neighbors.", default=2, value_type=int,

@@ -32,7 +32,7 @@ def save_table(table: Table, path: str) -> None:
     with open(path, "w") as f:
         f.write(json.dumps({"schema": schema.to_dict()}) + "\n")
         for row in table.to_rows():
-            f.write(json.dumps([_encode_value(v, t) for v, t in zip(row, schema.field_types)]) + "\n")
+            f.write(json.dumps(encode_row(row, schema)) + "\n")
 
 
 def load_table(path: str) -> Table:
@@ -45,10 +45,22 @@ def load_table(path: str) -> Table:
             if not line:
                 continue
             raw = json.loads(line)
-            rows.append(
-                tuple(_decode_value(v, t) for v, t in zip(raw, schema.field_types))
-            )
+            rows.append(decode_row(raw, schema))
     return Table.from_rows(rows, schema)
+
+
+def encode_row(row, schema: Schema) -> list:
+    """One row tuple as a JSON-serializable list (vectors via the codec).
+
+    The row-level unit of the jsonl layout, exposed for consumers that embed
+    rows in their own JSON documents (the streaming driver's window-buffer
+    snapshots)."""
+    return [_encode_value(v, t) for v, t in zip(row, schema.field_types)]
+
+
+def decode_row(raw, schema: Schema) -> tuple:
+    """Inverse of :func:`encode_row`."""
+    return tuple(_decode_value(v, t) for v, t in zip(raw, schema.field_types))
 
 
 def _encode_value(v, typ: str):

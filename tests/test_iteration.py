@@ -228,3 +228,151 @@ class TestStreaming:
             StreamingDriver(1000).run(
                 0, _train_source([(1.0,)]), lambda s, t, e: s, predict=lambda s, b: []
             )
+
+
+class TestStreamingRobustness:
+    """Bounded out-of-orderness + streaming checkpoint (VERDICT r02 gaps
+    #3/#4): the watermark machinery the reference gets from Flink
+    (IncrementalLearningSkeleton.java:144-158 assigns timestamps AND
+    watermarks; checkpointing.randomization in the root pom surefire)."""
+
+    SCHEMA = Schema(["v"], [DataTypes.DOUBLE])
+
+    def _collecting_update(self, store):
+        def update(state, table, epoch):
+            store.append((epoch, sorted(table.col("v").tolist())))
+            return state + table.num_rows()
+
+        return update
+
+    def test_shuffled_within_lateness_lands_in_correct_window(self):
+        # event times shuffled with <=2000ms disorder; windows of 5000ms
+        order = [0, 3000, 1000, 6000, 4000, 2000, 9000, 7000, 5000, 8000]
+        src = GeneratorSource(
+            lambda: iter([(t, (float(t // 1000),)) for t in order]), self.SCHEMA
+        )
+        got = []
+        res = iterate_unbounded(
+            0, src, self._collecting_update(got), window_ms=5000,
+            allowed_lateness_ms=2000,
+        )
+        assert res.late_records == []
+        assert res.windows_fired == 2
+        assert got[0] == (0, [0.0, 1.0, 2.0, 3.0, 4.0])
+        assert got[1] == (1, [5.0, 6.0, 7.0, 8.0, 9.0])
+
+    def test_beyond_lateness_goes_to_side_output(self):
+        def gen():
+            yield 0, (0.0,)
+            yield 7000, (7.0,)  # watermark -> 7000, window [0,5000) fires
+            yield 1000, (1.0,)  # >5000 late: its window already closed
+
+        src = GeneratorSource(gen, self.SCHEMA)
+        got = []
+        res = iterate_unbounded(
+            0, src, self._collecting_update(got), window_ms=5000,
+            allowed_lateness_ms=0,
+        )
+        assert res.late_records == [(1000, (1.0,))]
+        assert got[0] == (0, [0.0])  # the late record never corrupted a window
+
+    def test_late_record_for_unfired_window_is_still_late(self):
+        """Flink's isWindowLate rule: lateness is judged against the
+        watermark, not against which windows happened to fire — a record
+        whose (empty, never-fired) window the watermark already passed must
+        not spawn a fresh one-record window."""
+        def gen():
+            yield 1000, (1.0,)    # opens [0,5000)
+            yield 12000, (12.0,)  # wm=12000: fires [0,5000); [5000,10000) empty
+            yield 6000, (6.0,)    # its window end 10000 <= wm: late
+
+        src = GeneratorSource(gen, self.SCHEMA)
+        got = []
+        res = iterate_unbounded(
+            0, src, self._collecting_update(got), window_ms=5000,
+        )
+        assert res.late_records == [(6000, (6.0,))]
+        assert [g[1] for g in got] == [[1.0], [12.0]]
+
+    def test_lateness_zero_in_order_behavior_unchanged(self):
+        rows = [(float(i),) for i in range(10)]
+        src = GeneratorSource.linear_timestamps(rows, 1000, self.SCHEMA)
+        got = []
+        res = iterate_unbounded(
+            0, src, self._collecting_update(got), window_ms=5000
+        )
+        assert res.windows_fired == 2 and res.late_records == []
+
+    def test_kill_resume_matches_uninterrupted(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        rows = [(float(i),) for i in range(40)]
+
+        def make_src():
+            return GeneratorSource.linear_timestamps(rows, 1000, self.SCHEMA)
+
+        def update(state, table, epoch):
+            return state + float(sum((i + 1) * v for i, v in enumerate(table.col("v"))))
+
+        baseline = iterate_unbounded(0.0, make_src(), update, window_ms=5000)
+
+        cfg = CheckpointConfig(directory=str(tmp_path / "ck"), every_n_epochs=2)
+
+        calls = {"n": 0}
+
+        def crashing_update(state, table, epoch):
+            calls["n"] += 1
+            if epoch == 5:
+                raise RuntimeError("killed mid-stream")
+            return update(state, table, epoch)
+
+        with pytest.raises(RuntimeError, match="killed"):
+            iterate_unbounded(
+                0.0, make_src(), crashing_update, window_ms=5000, checkpoint=cfg
+            )
+        resumed = iterate_unbounded(
+            0.0, make_src(), update, window_ms=5000, checkpoint=cfg
+        )
+        assert resumed.windows_fired == baseline.windows_fired
+        assert float(resumed.final_state) == float(baseline.final_state)
+
+    def test_snapshot_restores_open_windows_and_watermark(self, tmp_path):
+        """A snapshot taken while out-of-order windows are still open
+        round-trips buffers through the codec and resumes bit-identically."""
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        # disorder keeps window N open while window N+1 accumulates
+        times = []
+        for base in range(0, 60000, 10000):
+            times.extend([base + 6000, base + 1000, base + 9000, base + 4000])
+
+        def make_src():
+            return GeneratorSource(
+                lambda: iter([(t, (float(t),)) for t in times]), self.SCHEMA
+            )
+
+        def update(state, table, epoch):
+            return state + float(sum(table.col("v"))) * (epoch + 1)
+
+        baseline = iterate_unbounded(
+            0.0, make_src(), update, window_ms=5000, allowed_lateness_ms=4000
+        )
+        cfg = CheckpointConfig(directory=str(tmp_path / "ck"), every_n_epochs=3)
+
+        def crashing_update(state, table, epoch):
+            if epoch == 7:
+                raise RuntimeError("killed")
+            return update(state, table, epoch)
+
+        with pytest.raises(RuntimeError, match="killed"):
+            iterate_unbounded(
+                0.0, make_src(), crashing_update, window_ms=5000,
+                allowed_lateness_ms=4000, checkpoint=cfg,
+            )
+        resumed = iterate_unbounded(
+            0.0, make_src(), update, window_ms=5000,
+            allowed_lateness_ms=4000, checkpoint=cfg,
+        )
+        assert resumed.windows_fired == baseline.windows_fired
+        assert float(resumed.final_state) == float(baseline.final_state)
+        assert resumed.late_records == baseline.late_records
