@@ -28,6 +28,7 @@ from flink_ml_tpu.lib.params import (
     HasFeatureColsDefaultAsNull,
     HasK,
     HasLabelCol,
+    HasShardModelData,
     HasVectorColDefaultAsNull,
 )
 from flink_ml_tpu.params.shared import (
@@ -47,6 +48,7 @@ class KnnParams(
     HasVectorColDefaultAsNull,
     HasFeatureColsDefaultAsNull,
     HasK,
+    HasShardModelData,
     HasReservedCols,
     HasPredictionCol,
     HasPredictionDetailCol,
@@ -79,12 +81,64 @@ def _knn_chunked(xq, xt, yt, k, chunk):
         neg_top, pos = jax.lax.top_k(-cat_d, k)
         return (-neg_top, jnp.take_along_axis(cat_y, pos, axis=1)), None
 
+    # the +0 broadcasts inherit the inputs' varying-manual-axes (vma) status,
+    # so the scan carry type-checks both under plain jit and inside a
+    # shard_map where xt/yt vary over the mesh
     init = (
-        jnp.full((n, k), jnp.inf, dtype=xq.dtype),
-        jnp.zeros((n, k), dtype=yt.dtype),
+        jnp.full((n, k), jnp.inf, dtype=xq.dtype) + 0.0 * xq[:, :1],
+        jnp.zeros((n, k), dtype=yt.dtype) + 0.0 * yt[:1],
     )
     (best_d, best_y), _ = jax.lax.scan(scan_chunk, init, jnp.arange(n_chunks))
     return best_y, best_d
+
+
+@lru_cache(maxsize=32)
+def _knn_apply_model_sharded(mesh, k, chunk, n_classes):
+    """Reference-set-sharded kNN: the model (xt/yt) shards over 'data' so it
+    need not fit one chip's HBM; queries replicate.
+
+    Each device computes the full query batch's top-k against its local
+    reference shard (the per-shard candidates), then one ``all_gather`` of
+    the (n, k) candidate sets over ICI merges them into the global top-k —
+    broadcast-variable semantics (ModelMapperAdapter.java:53-61) scaled past
+    one device's memory.  Work parallelizes over the reference dimension
+    instead of the query dimension; total FLOPs are identical to the
+    replicated path and the candidate exchange is k/|shard| of the distance
+    traffic a naive gather of distances would move.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def local_candidates(xq, xt_local, yt_local):
+        # queries are replicated (unvarying) but meet the varying reference
+        # shard inside the top-k scan carry: mark them varying up front
+        xq = jax.lax.pcast(xq, ("data",), to="varying")
+        labels, dists = _knn_chunked(xq, xt_local, yt_local, k, chunk)
+        # leading size-1 axis: the shard_map output gather stacks shards
+        # there, giving (n_dev, n, k, 2) without any in-program collective
+        return jnp.stack([labels, dists], axis=2)[None]
+
+    sharded = jax.shard_map(
+        local_candidates,
+        mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=P("data"),
+        check_vma=True,
+    )
+
+    def apply(xq, xt, yt):
+        cand = sharded(xq, xt, yt)  # (n_dev, n, k, 2) per-shard candidates
+        n = xq.shape[0]
+        cat_y = jnp.transpose(cand[..., 0], (1, 0, 2)).reshape(n, -1)
+        cat_d = jnp.transpose(cand[..., 1], (1, 0, 2)).reshape(n, -1)
+        neg_top, pos = jax.lax.top_k(-cat_d, k)
+        best_d = -neg_top
+        best_y = jnp.take_along_axis(cat_y, pos, axis=1)
+        pred = _majority_vote(best_y.astype(jnp.int32), best_d, n_classes)
+        return jnp.concatenate(
+            [pred[:, None].astype(xq.dtype), best_d.astype(xq.dtype)], axis=1
+        )
+
+    return jax.jit(apply)
 
 
 @lru_cache(maxsize=32)
@@ -149,15 +203,33 @@ class KnnModelMapper(ModelMapper):
         self._classes = np.unique(y)
         y_ids = np.searchsorted(self._classes, y)
 
-        chunk = min(8192, max(256, 1 << int(np.ceil(np.log2(max(X.shape[0], 1))))))
-        n_pad = -(-X.shape[0] // chunk) * chunk
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        mesh = MLEnvironmentFactory.get_default().get_mesh()
+        n_dev = data_parallel_size(mesh)
+        self._sharded = (
+            bool(self._model_stage.get_shard_model_data()) and n_dev > 1
+        )
+        shards = n_dev if self._sharded else 1
+        # chunk bounds the per-device distance-matrix slice; under model
+        # sharding it is sized on the LOCAL shard, so per-device HBM holds
+        # 1/n_dev of the reference set
+        local = -(-max(X.shape[0], 1) // shards)
+        chunk = min(8192, max(256, 1 << int(np.ceil(np.log2(local)))))
+        n_pad = shards * (-(-local // chunk) * chunk)
         Xp = np.zeros((n_pad, X.shape[1]), dtype=np.float32)
         Xp[: X.shape[0]] = X
         # inf marks padding (never wins top-k); f32 holds class ids exactly
         yp = np.full((n_pad,), np.inf, dtype=np.float32)
         yp[: y.shape[0]] = y_ids
-        self._xt = jnp.asarray(Xp)
-        self._yt = jnp.asarray(yp)
+        if self._sharded:
+            from flink_ml_tpu.parallel.mesh import shard_batch
+
+            self._xt, self._yt = shard_batch(mesh, (Xp, yp))
+        else:
+            self._xt = jnp.asarray(Xp)
+            self._yt = jnp.asarray(yp)
         self._chunk = chunk
 
     def map_batch(self, batch: Table):
@@ -166,10 +238,20 @@ class KnnModelMapper(ModelMapper):
         X, _ = resolve_features(batch, model, dim=int(self._xt.shape[1]))
         X = X.astype(np.float32)
         n = X.shape[0]
-        out = apply_sharded(
-            lambda mesh: _knn_apply(mesh, k, self._chunk, len(self._classes)),
-            X, self._xt, self._yt,
-        )
+        if self._sharded:
+            from flink_ml_tpu.lib.common import apply_batched
+            from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+            mesh = MLEnvironmentFactory.get_default().get_mesh()
+            out = apply_batched(
+                _knn_apply_model_sharded(mesh, k, self._chunk, len(self._classes)),
+                X, self._xt, self._yt,
+            )
+        else:
+            out = apply_sharded(
+                lambda mesh: _knn_apply(mesh, k, self._chunk, len(self._classes)),
+                X, self._xt, self._yt,
+            )
         pred_ids = out[:n, 0].astype(np.int64)
         result = {model.get_prediction_col(): self._classes[pred_ids]}
         detail = model.get_prediction_detail_col()

@@ -70,11 +70,14 @@ class TableModelBase(Model):
         # the loaded mapper holds the model packed on DEVICE (the
         # broadcast-variable analog); reloading it per transform would
         # re-transfer the whole model — for Knn that is the training set
-        # itself.  Cache it, keyed by everything the mapper captures.
+        # itself.  Cache it, keyed by everything the mapper captures — the
+        # mesh included: load-time placement can be mesh-committed
+        # (shardModelData), so a mesh change must rebuild the mapper.
         key = (
             tuple(table.schema.field_names),
             tuple(table.schema.field_types),
             self.get_params().to_json(),
+            MLEnvironmentFactory.get_default().get_mesh(),
         )
         if self._mapper_cache is None or self._mapper_cache_key != key:
             mapper = self._make_mapper(table.schema)

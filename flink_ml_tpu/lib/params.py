@@ -201,6 +201,22 @@ class HasWindowMs(WithParams):
         return self.set(self.WINDOW_MS, value)
 
 
+class HasShardModelData(WithParams):
+    SHARD_MODEL_DATA: ParamInfo = param_info(
+        "shardModelData",
+        "Shard the model data over the mesh's data axis instead of "
+        "replicating it, for models (e.g. a Knn reference set) too large "
+        "for one device's memory.",
+        default=False, value_type=bool,
+    )
+
+    def get_shard_model_data(self) -> bool:
+        return self.get(self.SHARD_MODEL_DATA)
+
+    def set_shard_model_data(self, value: bool):
+        return self.set(self.SHARD_MODEL_DATA, value)
+
+
 class HasAllowedLateness(WithParams):
     ALLOWED_LATENESS_MS: ParamInfo = param_info(
         "allowedLatenessMs",
