@@ -284,3 +284,90 @@ class TestSparseOutOfCore:
         source = CollectionSource(rows[:-100] + dense_tail, table.schema)
         with pytest.raises(ValueError, match="nnz_pad"):
             self.make_est(dim, iters=2).fit(ChunkedTable(source, chunk_rows=200))
+
+
+class TestKMeansOutOfCore:
+    def make_est(self, iters=8, tol=0.0):
+        from flink_ml_tpu.lib import KMeans
+
+        return (
+            KMeans().set_feature_cols(["f0", "f1", "f2"])
+            .set_prediction_col("cluster").set_k(5)
+            .set_max_iter(iters).set_tol(tol).set_seed(7)
+        )
+
+    def test_matches_in_memory_fit(self):
+        """Same init (stream-head sample == full sample under the cap), same
+        Lloyd schedule; centroids agree to accumulation-order tolerance."""
+        table, _, _ = dense_data(4000, seed=21)
+        in_mem = self.make_est().fit(table)
+        chunked = ChunkedTable(
+            CollectionSource(table.to_rows(), SCHEMA), chunk_rows=900
+        )
+        streamed = self.make_est().fit(chunked)
+        assert streamed.train_epochs_ == in_mem.train_epochs_
+        np.testing.assert_allclose(
+            np.sort(streamed.centroids(), axis=0),
+            np.sort(in_mem.centroids(), axis=0),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            streamed.train_cost_, in_mem.train_cost_, rtol=1e-4
+        )
+
+    def test_streams_larger_than_cap_csv(self, tmp_path):
+        table, X, y = dense_data(15000, seed=22)
+        path = tmp_path / "km.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        source = CsvSource(str(path), SCHEMA)
+        in_mem = self.make_est(iters=5).fit(source.read())
+        streamed = self.make_est(iters=5).fit(
+            ChunkedTable(source, chunk_rows=2048, spill=True)
+        )
+        np.testing.assert_allclose(
+            np.sort(streamed.centroids(), axis=0),
+            np.sort(in_mem.centroids(), axis=0),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_checkpoint_resume(self, tmp_path):
+        table, _, _ = dense_data(3000, seed=23)
+        rows = table.to_rows()
+        full = self.make_est(iters=6).fit(
+            ChunkedTable(CollectionSource(rows, SCHEMA), 800)
+        )
+        ckpt = str(tmp_path / "ck")
+
+        def est(iters):
+            return (
+                self.make_est(iters=iters)
+                .set_checkpoint_dir(ckpt)
+                .set_checkpoint_interval(2)
+            )
+
+        est(3).fit(ChunkedTable(CollectionSource(rows, SCHEMA), 800))
+        resumed = est(6).fit(ChunkedTable(CollectionSource(rows, SCHEMA), 800))
+        assert resumed.train_epochs_ == 6
+        np.testing.assert_allclose(
+            resumed.centroids(), full.centroids(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_init_sample_is_uniform_over_grouped_stream(self):
+        """Over-cap, cluster-grouped data: the reservoir init sample must
+        cover the whole stream, not just its head."""
+        from flink_ml_tpu.lib.out_of_core import reservoir_sample_rows
+
+        rows = [(float(i), 0.0, 0.0, 0.0) for i in range(10000)]
+        table_src = CollectionSource(rows, SCHEMA)
+        chunked = ChunkedTable(table_src, chunk_rows=1000)
+        rng = np.random.RandomState(0)
+        sample, seen = reservoir_sample_rows(
+            chunked.chunks(),
+            lambda t: (t.numeric_matrix(["f0"]),),
+            cap=500, rng=rng,
+        )
+        assert seen == 10000 and sample.shape == (500, 1)
+        # head-biased sampling would put everything under 500; uniform
+        # sampling spreads across [0, 10000)
+        assert np.median(sample) > 3000
+        assert sample.max() > 9000
