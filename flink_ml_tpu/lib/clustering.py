@@ -266,8 +266,10 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             directory=directory, every_n_epochs=self.get_checkpoint_interval()
         )
 
-    def fit(self, *inputs: Table) -> KMeansModel:
+    def fit(self, *inputs) -> KMeansModel:
         (table,) = inputs
+        if getattr(table, "is_chunked", False):
+            return self._fit_out_of_core(table)
         X, dim = resolve_features(table, self)
         k = self.get_k()
         n = X.shape[0]
@@ -314,10 +316,13 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             max_iter=self.get_max_iter(), tol=self.get_tol(), n_rows=n,
             checkpoint=checkpoint, device_batch=device_batch,
         )
-        centroids = np.asarray(result.params, dtype=np.float64)
+        return self._finish(result, k)
 
+    def _finish(self, result, k: int) -> KMeansModel:
+        centroids = np.asarray(result.params, dtype=np.float64)
         model_table = Table.from_rows(
-            [(int(i), DenseVector(centroids[i])) for i in range(k)], CENTROID_SCHEMA
+            [(int(i), DenseVector(centroids[i])) for i in range(k)],
+            CENTROID_SCHEMA,
         )
         model = KMeansModel()
         model.get_params().merge(self.get_params())
@@ -326,3 +331,74 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         model.train_cost_ = float(result.losses[-1]) if result.losses else 0.0
         model.train_metrics_ = result.metrics
         return model
+
+    def _fit_out_of_core(self, table) -> KMeansModel:
+        """Streaming Lloyd over a ChunkedTable: per-epoch passes accumulate
+        cluster sums/counts chunk by chunk on device (lib/out_of_core.py),
+        so the dataset never materializes on the host.
+
+        Matches the in-memory fit to float accumulation order: chunked
+        partial segment-sums add in a different order than one whole-shard
+        segment_sum, so centroids agree to ~1e-5 relative, not bit-for-bit
+        (unlike the GLM paths, whose minibatch structure chunking preserves
+        exactly).  The k-means++ init draws a UNIFORM reservoir sample of
+        up to INIT_SAMPLE_CAP rows over one full stream pass (sorted or
+        grouped files must not bias the seeding); under the cap the sample
+        is the whole dataset, matching the in-memory path.
+        """
+        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+
+        env = MLEnvironmentFactory.get_default()
+        mesh = env.get_mesh()
+        n_dev = data_parallel_size(mesh)
+        if data_parallel_size(mesh, "model") > 1:
+            raise ValueError(
+                "out-of-core KMeans supports data-parallel meshes only"
+            )
+        k = self.get_k()
+        checkpoint = self._checkpoint_config()
+
+        def extract(t):
+            X, _ = resolve_features(t, self)
+            return (np.asarray(X),)
+
+        # init from a uniform reservoir sample; skipped entirely on resume
+        resuming = False
+        if checkpoint is not None:
+            from flink_ml_tpu.iteration.checkpoint import latest_checkpoint
+
+            resuming = latest_checkpoint(checkpoint.directory) is not None
+        rng = np.random.RandomState(self.get_seed())
+        if resuming:
+            first = next(iter(table.chunks()), None)
+            if first is None:
+                raise ValueError("empty source")
+            dim = extract(first)[0].shape[1]
+            cents0 = np.zeros((k, dim), dtype=np.float32)  # template only
+        else:
+            sample, n_seen = oc.reservoir_sample_rows(
+                table.chunks(), extract, self.INIT_SAMPLE_CAP, rng
+            )
+            dim = sample.shape[1]
+            if n_seen < k:
+                raise ValueError(f"k={k} exceeds number of rows {n_seen}")
+            cents0 = kmeans_plus_plus(sample.astype(np.float64), k, rng)
+
+        rows_per_block = max(n_dev, (table.chunk_rows // n_dev) * n_dev)
+        blocks = oc.rows_blocks_factory(table, extract, n_dev, rows_per_block)
+        key = ("chunk-kmeans", mesh, int(k), rows_per_block, dim)
+        use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
+        with oc.maybe_spill(blocks, use_spill) as blocks:
+            result = oc.train_out_of_core(
+                jnp.asarray(cents0, dtype=jnp.float32),
+                blocks,
+                lambda: oc.make_kmeans_chunk_fn(key, k, mesh),
+                mesh,
+                max_iter=self.get_max_iter(),
+                tol=self.get_tol(),
+                checkpoint=checkpoint,
+                make_carry=oc.kmeans_make_carry,
+                finalize=oc.kmeans_finalize,
+            )
+        return self._finish(result, k)

@@ -409,17 +409,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
             key = ("chunk-dense", grad_fn, mesh, float(lr), float(reg))
 
-        spill = None
-        # spill pays a full packed disk copy to speed epochs 2+; a
-        # single-epoch fit has no later epoch to amortize it
-        if getattr(table, "spill", False) and self.get_max_iter() > 1:
-            import tempfile
-
-            spill = oc.BlockSpill(tempfile.mkdtemp(prefix="fmt_spill_"))
-            blocks = spill.wrap(blocks)
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        try:
+        use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
+        with oc.maybe_spill(blocks, use_spill) as blocks:
             result = oc.train_out_of_core(
                 (w0, b0),
                 blocks,
@@ -429,9 +422,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 tol=self.get_tol(),
                 checkpoint=checkpoint,
             )
-        finally:
-            if spill is not None:
-                spill.close()
         return self._finish(result)
 
     def _finish(self, result) -> GlmModelBase:

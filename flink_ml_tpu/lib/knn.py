@@ -238,20 +238,13 @@ class KnnModelMapper(ModelMapper):
         X, _ = resolve_features(batch, model, dim=int(self._xt.shape[1]))
         X = X.astype(np.float32)
         n = X.shape[0]
-        if self._sharded:
-            from flink_ml_tpu.lib.common import apply_batched
-            from flink_ml_tpu.utils.environment import MLEnvironmentFactory
-
-            mesh = MLEnvironmentFactory.get_default().get_mesh()
-            out = apply_batched(
-                _knn_apply_model_sharded(mesh, k, self._chunk, len(self._classes)),
-                X, self._xt, self._yt,
-            )
-        else:
-            out = apply_sharded(
-                lambda mesh: _knn_apply(mesh, k, self._chunk, len(self._classes)),
-                X, self._xt, self._yt,
-            )
+        apply_factory = (
+            _knn_apply_model_sharded if self._sharded else _knn_apply
+        )
+        out = apply_sharded(
+            lambda mesh: apply_factory(mesh, k, self._chunk, len(self._classes)),
+            X, self._xt, self._yt,
+        )
         pred_ids = out[:n, 0].astype(np.int64)
         result = {model.get_prediction_col(): self._classes[pred_ids]}
         detail = model.get_prediction_detail_col()

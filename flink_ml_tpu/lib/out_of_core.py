@@ -31,6 +31,7 @@ feature-sharded 2-D path keeps its in-memory driver, ``train_glm_sparse``).
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 from typing import Callable, Iterator, Optional, Tuple
@@ -207,6 +208,8 @@ def train_out_of_core(
     max_iter: int,
     tol: float,
     checkpoint=None,
+    make_carry: Optional[Callable] = None,
+    finalize: Optional[Callable] = None,
 ) -> TrainResult:
     """The streaming epoch engine.
 
@@ -217,6 +220,13 @@ def train_out_of_core(
     (update-norm vs ``tol``) and checkpoint/resume semantics mirror the
     fused in-memory loop; with ``tol == 0`` and no checkpoint, the whole
     run syncs once at the end.
+
+    SGD-shaped algorithms use the default carry ``(params, loss_sum,
+    weight_sum)`` updated per minibatch.  Accumulate-then-finalize
+    algorithms (KMeans' Lloyd step) pass ``make_carry(params) -> carry``
+    (fresh per-epoch accumulators) and ``finalize(carry, epoch_start) ->
+    (params, loss_sum, weight_sum, delta)`` (the per-epoch reduction, e.g.
+    centroid division), both running on device.
     """
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
@@ -258,8 +268,11 @@ def train_out_of_core(
         epoch_start = jax.tree_util.tree_map(jnp.copy, params)
         # fresh accumulators every epoch: the chunk program donates its
         # carry, so a reused zero scalar would be a deleted buffer
-        zero = jnp.zeros((), dtype=jnp.float32)
-        carry = (params, zero, jnp.zeros((), dtype=jnp.float32))
+        if make_carry is not None:
+            carry = make_carry(params)
+        else:
+            carry = (params, jnp.zeros((), dtype=jnp.float32),
+                     jnp.zeros((), dtype=jnp.float32))
         n_rows = 0
 
         def placed_blocks():
@@ -269,8 +282,13 @@ def train_out_of_core(
         for placed, real_rows in _prefetch(placed_blocks()):
             carry = chunk_fn(carry, placed)
             n_rows += real_rows
-        params, loss_sum, w_sum = carry
-        last_delta_dev = _l2_delta(params, epoch_start)
+        if finalize is not None:
+            params, loss_sum, w_sum, last_delta_dev = finalize(
+                carry, epoch_start
+            )
+        else:
+            params, loss_sum, w_sum = carry
+            last_delta_dev = _l2_delta(params, epoch_start)
         pending.append((loss_sum, w_sum))
         total_rows += n_rows
         epoch += 1
@@ -404,6 +422,159 @@ def sparse_blocks_factory(
         return gen()
 
     return factory
+
+
+def rows_blocks_factory(
+    chunked_table,
+    extract: Callable[[Table], Tuple[np.ndarray]],
+    n_dev: int,
+    rows_per_block: int,
+):
+    """Plain padded row blocks ``(X, w)`` for whole-batch epoch algorithms
+    (KMeans' Lloyd step): every block has exactly ``rows_per_block`` rows
+    (multiple of ``n_dev``; the final block zero-weight-pads), so one
+    compiled program covers the stream."""
+    if rows_per_block % n_dev:
+        raise ValueError("rows_per_block must be a multiple of n_dev")
+
+    def factory():
+        def gen():
+            for (X,) in _block_rows(
+                chunked_table.chunks(), extract, rows_per_block
+            ):
+                X = np.asarray(X, dtype=np.float32)
+                n = X.shape[0]
+                Xp = np.zeros((rows_per_block, X.shape[1]), dtype=np.float32)
+                wp = np.zeros((rows_per_block,), dtype=np.float32)
+                Xp[:n] = X
+                wp[:n] = 1.0
+                yield (Xp, wp), n
+
+        return gen()
+
+    return factory
+
+
+def make_kmeans_chunk_fn(key, k: int, mesh):
+    """Lloyd accumulation over one row block as a compiled device call:
+    ``chunk_fn(carry, (x, w)) -> carry`` with ``carry = (centroids,
+    sums, counts, cost)``.  Assignments are against the epoch's centroids
+    (held fixed in the carry); per-cluster sums/counts/cost ``psum`` over
+    the data axis and accumulate across blocks; the per-epoch centroid
+    division happens in :func:`kmeans_finalize`.  Zero-weight padding rows
+    contribute nothing exactly."""
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+
+    def local_chunk(carry, batch):
+        from flink_ml_tpu.lib.clustering import _pairwise_sq_dists
+
+        c, sums, counts, cost = carry
+        x, w = batch  # local shard: (rows_local, d), (rows_local,)
+        d = _pairwise_sq_dists(x, c)
+        assign = jnp.argmin(d, axis=1)
+        cost = cost + psum(jnp.sum(jnp.min(d, axis=1) * w), "data")
+        sums = sums + psum(
+            jax.ops.segment_sum(x * w[:, None], assign, num_segments=k), "data"
+        )
+        counts = counts + psum(
+            jax.ops.segment_sum(w, assign, num_segments=k), "data"
+        )
+        return (c, sums, counts, cost)
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.shard_map(
+        local_chunk,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P(),
+        check_vma=True,
+    )
+    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
+
+
+def kmeans_make_carry(centroids):
+    """Fresh per-epoch Lloyd accumulators (sums, counts, cost)."""
+    k, d = centroids.shape
+    return (
+        centroids,
+        jnp.zeros((k, d), dtype=jnp.float32),
+        jnp.zeros((k,), dtype=jnp.float32),
+        jnp.zeros((), dtype=jnp.float32),
+    )
+
+
+@jax.jit
+def kmeans_finalize(carry, epoch_start):
+    """Per-epoch Lloyd reduction: divide sums by counts (empty clusters
+    keep their previous centroid), centroid-shift norm for convergence.
+    Returns the engine's ``(params, loss_sum, weight_sum, delta)``; the
+    weight of 1 makes the drained epoch loss the total cost, matching the
+    in-memory fused path."""
+    c, sums, counts, cost = carry
+    new_c = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
+    )
+    delta = jnp.sqrt(jnp.sum((new_c - epoch_start) ** 2))
+    return new_c, cost, jnp.ones((), dtype=jnp.float32), delta
+
+
+@contextlib.contextmanager
+def maybe_spill(blocks_factory, enabled: bool):
+    """Wrap a block factory in a :class:`BlockSpill` with a per-fit
+    temporary directory, cleaned up on exit.  The single spill lifecycle
+    shared by every out-of-core estimator; a no-op when ``enabled`` is
+    false (single-epoch fits have no later epoch to amortize the disk
+    copy)."""
+    if not enabled:
+        yield blocks_factory
+        return
+    import tempfile
+
+    spill = BlockSpill(tempfile.mkdtemp(prefix="fmt_spill_"))
+    try:
+        yield spill.wrap(blocks_factory)
+    finally:
+        spill.close()
+
+
+def reservoir_sample_rows(chunks: Iterator[Table], extract, cap: int, rng):
+    """Uniform sample of ``cap`` rows over a chunk stream (vectorized
+    Algorithm R), plus the true row count.
+
+    The out-of-core replacement for ``rng.choice`` over a materialized
+    array: one pass, O(cap) memory.  When the stream holds <= cap rows the
+    sample IS the dataset (in order).  Used for k-means++ seeding, where
+    the in-memory path draws a uniform subsample — a stream-head sample
+    would bias the init toward the file's leading rows whenever the data
+    is sorted or grouped.
+    """
+    sample: Optional[np.ndarray] = None
+    filled = 0
+    seen = 0
+    for t in chunks:
+        (X,) = extract(t)
+        X = np.asarray(X)
+        m = X.shape[0]
+        if sample is None:
+            sample = np.empty((cap, X.shape[1]), dtype=X.dtype)
+        take = min(m, cap - filled)
+        if take > 0:
+            sample[filled : filled + take] = X[:take]
+            filled += take
+        if take < m:
+            rest = X[take:]
+            # row with global index i replaces a slot with prob cap/(i+1)
+            idx = np.arange(seen + take, seen + m)
+            j = (rng.random_sample(rest.shape[0]) * (idx + 1)).astype(np.int64)
+            hit = j < cap
+            sample[j[hit]] = rest[hit]
+        seen += m
+    if sample is None:
+        raise ValueError("empty source")
+    return sample[:filled] if filled < cap else sample, seen
 
 
 class BlockSpill:
