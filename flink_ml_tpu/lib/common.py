@@ -608,6 +608,44 @@ def make_sparse_glm_train_fn(
     )
 
 
+def make_sparse_mb_grad_step_2d(kind: str, mb: int, nnz_pad: int,
+                                dim_local: int, with_intercept: bool = True):
+    """Feature-sharded counterpart of :func:`make_sparse_mb_grad_step`:
+    shard i of the ``model`` axis owns features [i*dim_local, (i+1)*dim_local);
+    partial logits complete with one ``psum`` over ``model`` (the TP
+    allreduce riding ICI) and gradients scatter only into the local shard.
+    Shared by the fused in-memory 2-D loop and the out-of-core chunk
+    program."""
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def mb_grad_step(params, xs):
+        ints, floats = xs
+        idx = ints[0]
+        rid = ints[1]
+        vals = floats[:nnz_pad]
+        y = floats[nnz_pad : nnz_pad + mb]
+        w = floats[nnz_pad + mb :]
+        wts_local, b = params
+        lo = jax.lax.axis_index("model") * dim_local
+        local_idx = idx - lo
+        mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
+        safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
+        contrib = jnp.where(
+            mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
+        )
+        partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
+        # the TP allreduce: complete logits across feature shards
+        logits = jax.lax.psum(partial, "model") + b
+        err, loss_sum = _sparse_loss(kind, logits, y, w)
+        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+        scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
+        g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
+        g_b = jnp.sum(err) * keep_b
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return mb_grad_step
+
+
 def make_sparse_glm_train_fn_2d(
     kind: str,
     mesh,
@@ -643,32 +681,9 @@ def make_sparse_glm_train_fn_2d(
     key = ("sparse2d", kind, mesh, mb, nnz_pad, dim,
            float(learning_rate), float(reg), int(max_iter), float(tol),
            bool(with_intercept))
-    keep_b = 1.0 if with_intercept else 0.0
-
-    def mb_grad_step(params, xs):
-        ints, floats = xs
-        idx = ints[0]
-        rid = ints[1]
-        vals = floats[:nnz_pad]
-        y = floats[nnz_pad : nnz_pad + mb]
-        w = floats[nnz_pad + mb :]
-        wts_local, b = params
-        lo = jax.lax.axis_index("model") * dim_local
-        local_idx = idx - lo
-        mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
-        safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
-        contrib = jnp.where(
-            mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
-        )
-        partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
-        # the TP allreduce: complete logits across feature shards
-        logits = jax.lax.psum(partial, "model") + b
-        err, loss_sum = _sparse_loss(kind, logits, y, w)
-        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
-        scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
-        g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
-        g_b = jnp.sum(err) * keep_b
-        return (g_w, g_b), loss_sum, jnp.sum(w)
+    mb_grad_step = make_sparse_mb_grad_step_2d(
+        kind, mb, nnz_pad, dim_local, with_intercept
+    )
 
     def delta_fn(params, start):
         # shard-local weight squares summed across 'model'; the replicated
@@ -686,6 +701,37 @@ def make_sparse_glm_train_fn_2d(
         out_specs=((P("model"), P()), P(), P(), P()),
         delta_fn=delta_fn,
     )
+
+
+def make_feature_shard_placer(mesh, dim: int, model_size: int):
+    """Placement for a ``model``-axis-sharded GLM parameter pytree.
+
+    Returns ``(place, trim, dim_pad)``: ``place`` pads the weight vector up
+    to ``dim_pad`` (the model-axis multiple) and device_puts (w sharded over
+    'model', intercept replicated); ``trim`` slices the padding back off.
+    The ONE copy of this logic — the in-memory 2-D driver and the
+    out-of-core 2-D path both use it, so their placements cannot drift.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dim_pad = -(-dim // model_size) * model_size
+
+    def place(params):
+        w0, b0 = params
+        w0 = jnp.asarray(w0)
+        if dim_pad != int(w0.shape[0]):
+            w0 = jnp.concatenate(
+                [w0, jnp.zeros((dim_pad - w0.shape[0],), w0.dtype)]
+            )
+        return (
+            jax.device_put(w0, NamedSharding(mesh, P("model"))),
+            jax.device_put(jnp.asarray(b0), NamedSharding(mesh, P())),
+        )
+
+    def trim(params):
+        return (params[0][:dim], params[1])
+
+    return place, trim, dim_pad
 
 
 def train_glm_sparse(
@@ -710,33 +756,16 @@ def train_glm_sparse(
     executes as fused chunks of ``every_n_epochs`` epochs with a snapshot
     between chunks (and resumes from the latest snapshot).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     model_size = dict(mesh.shape).get("model", 1)
     dim = sstack.dim
     if model_size > 1:
-        dim_pad = -(-dim // model_size) * model_size
-
-        def place(params):
-            w0, b0 = params
-            w0 = jnp.asarray(w0)
-            if dim_pad != int(w0.shape[0]):
-                w0 = jnp.concatenate(
-                    [w0, jnp.zeros((dim_pad - w0.shape[0],), w0.dtype)]
-                )
-            return (
-                jax.device_put(w0, NamedSharding(mesh, P("model"))),
-                jax.device_put(jnp.asarray(b0), NamedSharding(mesh, P())),
-            )
+        place, trim, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
 
         def factory(n_epochs):
             return make_sparse_glm_train_fn_2d(
                 kind, mesh, sstack.mb, sstack.nnz_pad, dim_pad,
                 learning_rate, reg, n_epochs, tol, with_intercept,
             )
-
-        def trim(params):
-            return (params[0][:dim], params[1])
     else:
         def place(params):
             from flink_ml_tpu.parallel.mesh import replicate

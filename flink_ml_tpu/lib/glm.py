@@ -321,11 +321,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
         n_dev = data_parallel_size(mesh)
-        if data_parallel_size(mesh, "model") > 1:
-            raise ValueError(
-                "out-of-core training supports data-parallel meshes; "
-                "feature-sharded (2-D) training uses the in-memory path"
-            )
+        model_size = data_parallel_size(mesh, "model")
         gbs = self.get_global_batch_size()
         if gbs is None or gbs <= 0:
             raise ValueError(
@@ -369,13 +365,38 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             blocks = oc.sparse_blocks_factory(
                 table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad
             )
-            from flink_ml_tpu.lib.common import make_sparse_mb_grad_step
+            if model_size > 1:
+                # the north-star 2-D configuration: rows stream over 'data'
+                # while the weight vector shards over 'model' — Criteo-scale
+                # data AND a wider-than-one-chip model at once
+                from jax.sharding import PartitionSpec as P
 
-            mb_grad = make_sparse_mb_grad_step(
-                self.LOSS_KIND, mb, nnz_pad, dim, self.get_with_intercept()
-            )
-            key = ("chunk-sparse", self.LOSS_KIND, mesh, mb, nnz_pad, dim,
-                   float(lr), float(reg), self.get_with_intercept())
+                from flink_ml_tpu.lib.common import (
+                    make_feature_shard_placer,
+                    make_sparse_mb_grad_step_2d,
+                )
+
+                place_params, _trim, dim_pad = make_feature_shard_placer(
+                    mesh, dim, model_size
+                )
+                mb_grad = make_sparse_mb_grad_step_2d(
+                    self.LOSS_KIND, mb, nnz_pad, dim_pad // model_size,
+                    self.get_with_intercept(),
+                )
+                param_spec = (P("model"), P())
+                key = ("chunk-sparse2d", self.LOSS_KIND, mesh, mb, nnz_pad,
+                       dim_pad, float(lr), float(reg),
+                       self.get_with_intercept())
+            else:
+                from flink_ml_tpu.lib.common import make_sparse_mb_grad_step
+
+                mb_grad = make_sparse_mb_grad_step(
+                    self.LOSS_KIND, mb, nnz_pad, dim, self.get_with_intercept()
+                )
+                param_spec = None
+                place_params = None
+                key = ("chunk-sparse", self.LOSS_KIND, mesh, mb, nnz_pad, dim,
+                       float(lr), float(reg), self.get_with_intercept())
         else:
             dim = self.get_num_features()
             if dim is None and self.get_feature_cols() is not None:
@@ -407,6 +428,8 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             def mb_grad(p, mbs):
                 return grad_fn(p, mbs[..., :-2], mbs[..., -2], mbs[..., -1])
 
+            param_spec = None
+            place_params = None
             key = ("chunk-dense", grad_fn, mesh, float(lr), float(reg))
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
@@ -416,12 +439,18 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             result = oc.train_out_of_core(
                 (w0, b0),
                 blocks,
-                lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
+                lambda: oc.make_chunk_step_fn(
+                    key, mb_grad, mesh, lr, reg, param_spec=param_spec
+                ),
                 mesh,
                 max_iter=self.get_max_iter(),
                 tol=self.get_tol(),
                 checkpoint=checkpoint,
+                place_params=place_params,
             )
+        w_fit = np.asarray(result.params[0])
+        if w_fit.shape[0] > dim:  # trim 2-D feature padding
+            result.params = (w_fit[:dim], result.params[1])
         return self._finish(result)
 
     def _finish(self, result) -> GlmModelBase:

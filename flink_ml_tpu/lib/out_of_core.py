@@ -25,8 +25,11 @@ TPU-first replacement:
   * per-epoch loss/delta stay on device; with ``tol == 0`` the entire
     multi-epoch run syncs exactly once, at the final fetch.
 
-Data-parallel meshes only: the weight pytree stays replicated (the
-feature-sharded 2-D path keeps its in-memory driver, ``train_glm_sparse``).
+Works on 1-D (data) and 2-D (data x model) meshes: by default the weight
+pytree replicates; the feature-sharded 2-D configuration passes a
+``param_spec``/``place_params`` pair so rows stream over ``data`` while the
+weight vector stays sharded over ``model`` — Criteo-scale data and a
+wider-than-one-chip model at once.
 """
 
 from __future__ import annotations
@@ -56,7 +59,8 @@ from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
 
 
-def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float):
+def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float,
+                       param_spec=None):
     """One chunk — a ``lax.scan`` over its minibatch groups — as a single
     compiled device call: ``chunk_fn(carry, batch) -> carry`` with
     ``carry = (params, loss_sum, weight_sum)``.
@@ -65,7 +69,9 @@ def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float
     fused loop uses (``mb_grad_step``, :func:`make_sgd_update`), so a live
     step's update is bit-identical; a whole-pad step (``weight sum == 0``,
     only possible in the final block's tail) is gated to a no-op so padding
-    can never apply an extra decay step.
+    can never apply an extra decay step.  ``param_spec`` overrides the
+    replicated param placement (feature-sharded weights on the ``model``
+    axis — the 2-D Criteo configuration).
     """
     cached = _cache_get(key)
     if cached is not None:
@@ -97,11 +103,12 @@ def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float
 
     from jax.sharding import PartitionSpec as P
 
+    carry_spec = (param_spec if param_spec is not None else P(), P(), P())
     sharded = jax.shard_map(
         local_chunk,
         mesh=mesh,
-        in_specs=(P(), P("data")),
-        out_specs=P(),
+        in_specs=(carry_spec, P("data")),
+        out_specs=carry_spec,
         check_vma=True,
     )
     return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
@@ -210,6 +217,7 @@ def train_out_of_core(
     checkpoint=None,
     make_carry: Optional[Callable] = None,
     finalize: Optional[Callable] = None,
+    place_params: Optional[Callable] = None,
 ) -> TrainResult:
     """The streaming epoch engine.
 
@@ -226,7 +234,10 @@ def train_out_of_core(
     algorithms (KMeans' Lloyd step) pass ``make_carry(params) -> carry``
     (fresh per-epoch accumulators) and ``finalize(carry, epoch_start) ->
     (params, loss_sum, weight_sum, delta)`` (the per-epoch reduction, e.g.
-    centroid division), both running on device.
+    centroid division), both running on device.  ``place_params`` overrides
+    the default replicated placement (feature-sharded weights live on the
+    ``model`` axis); the default delta/loss math operates on global arrays,
+    so it is sharding-agnostic.
     """
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
@@ -252,7 +263,10 @@ def train_out_of_core(
 
     metrics = StepMetrics("stream_train")
     metrics.start_step()
-    params = replicate(mesh, init_params)
+    params = (
+        place_params(init_params) if place_params is not None
+        else replicate(mesh, init_params)
+    )
     params = jax.tree_util.tree_map(
         lambda p, o: jnp.copy(p) if isinstance(o, jax.Array) else p,
         params, init_params,

@@ -371,3 +371,85 @@ class TestKMeansOutOfCore:
         # sampling spreads across [0, 10000)
         assert np.median(sample) > 3000
         assert sample.max() > 9000
+
+
+class TestOutOfCore2D:
+    """The north-star configuration: rows stream over the 'data' axis while
+    the sparse weight vector shards over 'model' (Criteo-scale data AND a
+    wider-than-one-chip model at once)."""
+
+    def _mesh(self, data, model):
+        import contextlib
+
+        import jax
+
+        from flink_ml_tpu.parallel.mesh import create_mesh
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        @contextlib.contextmanager
+        def ctx():
+            env = MLEnvironmentFactory.get_default()
+            old = env.get_mesh()
+            env.set_mesh(
+                create_mesh({"data": data, "model": model},
+                            jax.devices()[: data * model])
+            )
+            try:
+                yield
+            finally:
+                env.set_mesh(old)
+
+        return ctx()
+
+    def test_sparse_2d_stream_matches_in_memory_2d(self):
+        table, vectors, labels, dim = sparse_data(n=2000, dim=501)
+
+        def est():
+            return (
+                LogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("p")
+                .set_num_features(dim).set_learning_rate(0.1)
+                .set_global_batch_size(256).set_max_iter(4)
+            )
+
+        with self._mesh(4, 2):
+            in_mem = est().fit(table)
+            streamed = est().fit(
+                ChunkedTable(CollectionSource(table.to_rows(), table.schema), 700)
+            )
+        assert streamed.coefficients().shape == (dim,)
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+        assert streamed.intercept() == in_mem.intercept()
+
+    def test_sparse_2d_matches_1d_result(self):
+        table, vectors, labels, dim = sparse_data(n=1600, dim=500)
+
+        def est():
+            return (
+                LogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("p")
+                .set_num_features(dim).set_learning_rate(0.1)
+                .set_global_batch_size(256).set_max_iter(3)
+            )
+
+        chunked = lambda: ChunkedTable(  # noqa: E731
+            CollectionSource(table.to_rows(), table.schema), 600
+        )
+        with self._mesh(4, 2):
+            w2 = est().fit(chunked()).coefficients()
+        with self._mesh(8, 1):
+            w1 = est().fit(chunked()).coefficients()
+        np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-7)
+
+    def test_dense_stream_on_2d_mesh(self):
+        table, _, _ = dense_data(3000)
+        with self._mesh(4, 2):
+            streamed = make_estimator(iters=3).fit(
+                ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 800)
+            )
+            in_mem = make_estimator(iters=3).fit(table)
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
