@@ -1,0 +1,89 @@
+"""Out-of-core training example — Criteo-shaped scale on bounded memory.
+
+The reference reads its training CSV as a partitioned DataSet so no node
+holds the whole input (examples-batch/.../LinearRegression.java:91-102);
+this example is that capability on the TPU path: a directory of part-files
+streams through ``Estimator.fit`` via a ``ChunkedTable`` with
+
+  * host residency bounded by the chunk cap (never the dataset),
+  * host→device prefetch one block ahead of device compute,
+  * a binary spill cache so only the first epoch pays text parsing,
+  * a model bit-identical to the in-memory fit of the same rows.
+
+Run: python examples/out_of_core_training.py [--rows N] [--chunk-rows N]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from flink_ml_tpu.lib import LogisticRegression
+from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.sources import ChunkedTable, CsvSource, ShardedSource
+
+TRUE_W = np.array([1.5, -2.0, 0.5, 3.0, -1.0])
+
+
+def write_part_files(directory: str, rows: int, shards: int = 4) -> str:
+    """A directory of part-files, the way bulk exports arrive."""
+    rng = np.random.RandomState(0)
+    per = -(-rows // shards)
+    for i in range(shards):
+        n = min(per, rows - i * per)
+        X = rng.randn(n, len(TRUE_W))
+        y = ((X @ TRUE_W + 0.3 * rng.randn(n)) > 0).astype(np.float64)
+        np.savetxt(
+            os.path.join(directory, f"part-{i:05d}.csv"),
+            np.column_stack([X, y]), delimiter=",", fmt="%.9g",
+        )
+    return os.path.join(directory, "part-*.csv")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=200_000)
+    parser.add_argument("--chunk-rows", type=int, default=16_384)
+    args = parser.parse_args()
+
+    schema = Schema.of(
+        *[(f"f{i}", "double") for i in range(len(TRUE_W))], ("label", "double")
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        pattern = write_part_files(tmp, args.rows)
+        source = ShardedSource.glob(pattern, lambda p: CsvSource(p, schema))
+        table = ChunkedTable(source, chunk_rows=args.chunk_rows, spill=True)
+
+        model = (
+            LogisticRegression()
+            .set_feature_cols([f"f{i}" for i in range(len(TRUE_W))])
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_learning_rate(0.5)
+            .set_global_batch_size(8192)
+            .set_max_iter(5)
+            .fit(table)
+        )
+
+        w = model.coefficients()
+        direction = w / np.linalg.norm(w) * np.linalg.norm(TRUE_W)
+        print(
+            f"trained on {args.rows} rows with host residency capped at "
+            f"{args.chunk_rows} rows/chunk ({model.train_epochs_} epochs)"
+        )
+        print(f"true weights:      {np.round(TRUE_W, 2)}")
+        print(f"fitted (rescaled): {np.round(direction, 2)}")
+        summary = model.train_metrics_.summary()
+        print(
+            f"throughput: {summary['samples_per_sec']:.0f} samples/sec "
+            f"({summary['total_samples']} samples in "
+            f"{summary['total_seconds']:.2f}s)"
+        )
+
+
+if __name__ == "__main__":
+    main()
