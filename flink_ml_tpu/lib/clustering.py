@@ -352,10 +352,8 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
         n_dev = data_parallel_size(mesh)
-        if data_parallel_size(mesh, "model") > 1:
-            raise ValueError(
-                "out-of-core KMeans supports data-parallel meshes only"
-            )
+        # on a 2-D mesh the centroids replicate over 'model' (like the
+        # in-memory Lloyd path); rows shard over 'data' only
         k = self.get_k()
         checkpoint = self._checkpoint_config()
 

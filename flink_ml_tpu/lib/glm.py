@@ -376,7 +376,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     make_sparse_mb_grad_step_2d,
                 )
 
-                place_params, _trim, dim_pad = make_feature_shard_placer(
+                place_params, trim, dim_pad = make_feature_shard_placer(
                     mesh, dim, model_size
                 )
                 mb_grad = make_sparse_mb_grad_step_2d(
@@ -395,6 +395,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 )
                 param_spec = None
                 place_params = None
+                trim = None
                 key = ("chunk-sparse", self.LOSS_KIND, mesh, mb, nnz_pad, dim,
                        float(lr), float(reg), self.get_with_intercept())
         else:
@@ -430,6 +431,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
             param_spec = None
             place_params = None
+            trim = None
             key = ("chunk-dense", grad_fn, mesh, float(lr), float(reg))
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
@@ -448,9 +450,9 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 checkpoint=checkpoint,
                 place_params=place_params,
             )
-        w_fit = np.asarray(result.params[0])
-        if w_fit.shape[0] > dim:  # trim 2-D feature padding
-            result.params = (w_fit[:dim], result.params[1])
+        if trim is not None:  # the placer's own inverse: trim 2-D padding
+            w_t, b_t = trim(result.params)
+            result.params = (np.asarray(w_t), b_t)
         return self._finish(result)
 
     def _finish(self, result) -> GlmModelBase:

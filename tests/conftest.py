@@ -11,20 +11,27 @@ enough — the jax config must be updated before the first backend use.
 
 import os
 
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+#: FMT_TEST_TPU=1 runs the suite on the real TPU backend instead of the
+#: virtual CPU mesh — the only way to exercise the Mosaic-lowered (non-
+#: interpret) Pallas tests, which are skipped on CPU.
+_ON_TPU = os.environ.get("FMT_TEST_TPU", "").lower() in ("1", "true", "yes")
+
+os.environ.setdefault("JAX_ENABLE_X64", "0" if _ON_TPU else "1")
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _ON_TPU and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update(
     "jax_enable_x64",
     os.environ["JAX_ENABLE_X64"].lower() not in ("0", "false", "f", "no", "off"),
 )
 
-assert jax.device_count() == 8, (
-    f"expected 8 virtual CPU devices, got {jax.device_count()} on "
-    f"{jax.default_backend()}; backend was initialized before conftest"
-)
+if not _ON_TPU:
+    assert jax.device_count() == 8, (
+        f"expected 8 virtual CPU devices, got {jax.device_count()} on "
+        f"{jax.default_backend()}; backend was initialized before conftest"
+    )

@@ -54,3 +54,20 @@ class TestIncrementalLearningExample:
         assert m and int(m.group(1)) == 20  # 2000 records / 100-per-window
         m = re.search(r"accuracy ([\d.]+)", out)
         assert m and float(m.group(1)) > 0.9
+
+
+class TestOutOfCoreExample:
+    def test_streams_part_files_and_recovers_direction(self):
+        from examples import out_of_core_training
+
+        out = run_main(
+            out_of_core_training, ["--rows", "20000", "--chunk-rows", "2048"]
+        )
+        assert "host residency capped at 2048 rows/chunk" in out
+        fitted = re.search(r"fitted \(rescaled\): \[(.*)\]", out)
+        assert fitted, out
+        w = np.array([float(v) for v in fitted.group(1).split()])
+        true_w = np.array([1.5, -2.0, 0.5, 3.0, -1.0])
+        # logistic loss recovers the direction of the separating hyperplane
+        np.testing.assert_allclose(w, true_w, atol=0.35)
+        assert re.search(r"throughput: \d+ samples/sec", out)

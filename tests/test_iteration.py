@@ -376,3 +376,39 @@ class TestStreamingRobustness:
         assert resumed.windows_fired == baseline.windows_fired
         assert float(resumed.final_state) == float(baseline.final_state)
         assert resumed.late_records == baseline.late_records
+
+    def test_out_of_order_predictions_see_event_time_model(self):
+        """A prediction record's result must reflect the model that was
+        current at its EVENT time, even when it arrives out of order
+        relative to training records (within the lateness bound)."""
+        PRED_SCHEMA = Schema(["q"], [DataTypes.DOUBLE])
+
+        def train_gen():
+            yield 1000, (1.0,)
+            yield 2000, (2.0,)
+            yield 9000, (9.0,)   # fires window [0,5000) once wm passes
+
+        def pred_gen():
+            # arrives after the ts=9000 training record merged it late, but
+            # its event time 3000 precedes window [0,5000)'s close
+            yield 3000, (30.0,)
+            yield 12000, (120.0,)
+
+        def update(state, table, epoch):
+            return state + table.num_rows()
+
+        def predict(state, batch):
+            return [state] * batch.num_rows()
+
+        res = StreamingDriver(
+            window_ms=5000, allowed_lateness_ms=4000
+        ).run(
+            0,
+            GeneratorSource(train_gen, self.SCHEMA),
+            update,
+            prediction_source=GeneratorSource(pred_gen, PRED_SCHEMA),
+            predict=predict,
+        )
+        by_ts = dict(res.predictions)
+        assert by_ts[3000] == 0   # before window [0,5000) fired
+        assert by_ts[12000] == 3  # after both windows fired (2 + 1 rows)

@@ -453,3 +453,24 @@ class TestOutOfCore2D:
         np.testing.assert_array_equal(
             streamed.coefficients(), in_mem.coefficients()
         )
+
+    def test_kmeans_stream_on_2d_mesh(self):
+        table, _, _ = dense_data(2400, seed=31)
+        from flink_ml_tpu.lib import KMeans
+
+        def est():
+            return (
+                KMeans().set_feature_cols(["f0", "f1", "f2"])
+                .set_prediction_col("c").set_k(4).set_max_iter(4).set_seed(2)
+            )
+
+        chunked = lambda: ChunkedTable(  # noqa: E731
+            CollectionSource(table.to_rows(), SCHEMA), 600
+        )
+        with self._mesh(4, 2):
+            c2 = est().fit(chunked()).centroids()
+        with self._mesh(8, 1):
+            c1 = est().fit(chunked()).centroids()
+        np.testing.assert_allclose(
+            np.sort(c2, axis=0), np.sort(c1, axis=0), rtol=1e-4, atol=1e-5
+        )

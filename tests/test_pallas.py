@@ -67,7 +67,7 @@ class TestPallasGradFnIntegration:
         assert float(g_b0) == 0.0
 
     @pytest.mark.skipif(
-        jax.default_backend() != "tpu",
+        jax.devices()[0].platform != "tpu",
         reason="interpret-mode Pallas inside strict shard_map hits JAX-"
         "internal vma limits; the real Mosaic lowering works (verified on "
         "v5e) — run this on a TPU backend",
