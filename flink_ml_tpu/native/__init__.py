@@ -10,6 +10,15 @@ and the table sources fall back to pure Python when it is unavailable —
       (None = input not representable in the native transport — control
       bytes inside quoted cells — caller must fall back to the pure parser)
   read_libsvm(path, n_features, zero_based) -> (labels ndarray, [SparseVector])
+
+Streaming (bounded memory — the out-of-core path):
+
+  iter_csv_doubles(path, delimiter, skip_header, arity, max_rows)
+      -> yields (rows, arity) float64 ndarrays; raises NativeFallback on the
+      first non-numeric cell with .rows_delivered so the caller can resume
+      the pure parser from that row
+  iter_libsvm_chunks(path, n_features, zero_based, max_rows)
+      -> yields (labels ndarray, [SparseVector]) per chunk
 """
 
 from __future__ import annotations
@@ -82,8 +91,54 @@ def _load():
         ]
         lib.fml_free.restype = None
         lib.fml_free.argtypes = [ctypes.c_void_p]
+        # the streaming symbols arrived later: a stale prebuilt .so (no
+        # compiler to rebuild) must keep the whole-file fast paths working
+        # and only lose streaming, not all native acceleration
+        try:
+            lib.fml_open_libsvm_stream.restype = ctypes.c_void_p
+            lib.fml_open_libsvm_stream.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.fml_next_libsvm_chunk.restype = ctypes.c_int64
+            lib.fml_next_libsvm_chunk.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.fml_close_libsvm_stream.restype = None
+            lib.fml_close_libsvm_stream.argtypes = [ctypes.c_void_p]
+            lib.fml_open_csv_stream.restype = ctypes.c_void_p
+            lib.fml_open_csv_stream.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ]
+            lib.fml_next_csv_doubles.restype = ctypes.c_int64
+            lib.fml_next_csv_doubles.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ]
+            lib.fml_close_csv_stream.restype = None
+            lib.fml_close_csv_stream.argtypes = [ctypes.c_void_p]
+            lib._fml_streaming = True
+        except AttributeError:
+            lib._fml_streaming = False
         _lib = lib
         return _lib
+
+
+def streaming_available() -> bool:
+    lib = _load()
+    return lib is not None and getattr(lib, "_fml_streaming", False)
+
+
+class NativeFallback(Exception):
+    """The native numeric-CSV stream hit a non-numeric cell; the caller must
+    continue with the pure parser, skipping ``rows_delivered`` rows."""
+
+    def __init__(self, rows_delivered: int):
+        super().__init__(f"non-numeric cell after {rows_delivered} rows")
+        self.rows_delivered = rows_delivered
 
 
 def available() -> bool:
@@ -156,9 +211,102 @@ def read_libsvm(path: str, n_features: Optional[int], zero_based: bool):
         lib.fml_free(values_p)
 
     dim = n_features if n_features is not None else int(max_idx.value) + 1
-    vecs = [
+    return labels, _csr_to_vectors(SparseVector, dim, nr, indptr, indices, values)
+
+
+def _csr_to_vectors(SparseVector, dim, nr, indptr, indices, values):
+    return [
         SparseVector(dim, indices[indptr[i]:indptr[i + 1]],
                      values[indptr[i]:indptr[i + 1]])
         for i in range(nr)
     ]
-    return labels, vecs
+
+
+def iter_csv_doubles(path: str, delimiter: str, skip_header: bool,
+                     arity: int, max_rows: int):
+    """Stream an all-numeric CSV as ``(rows, arity)`` float64 chunks.
+
+    On the first non-numeric cell, raises :class:`NativeFallback` carrying
+    how many rows were already yielded — the caller resumes the pure parser
+    from there (rows consumed by the failed native call re-parse cleanly
+    because the fallback re-reads the file).
+    """
+    lib = _load()
+    handle = lib.fml_open_csv_stream(
+        path.encode(), delimiter.encode()[:1], 1 if skip_header else 0
+    )
+    if not handle:
+        raise IOError(f"cannot read {path}")
+    delivered = 0
+    try:
+        while True:
+            out = ctypes.POINTER(ctypes.c_double)()
+            n = lib.fml_next_csv_doubles(handle, max_rows, arity,
+                                         ctypes.byref(out))
+            if n == -2:
+                raise NativeFallback(delivered)
+            if n == -1:
+                raise MemoryError(f"native CSV chunk alloc failed for {path}")
+            if n == 0:
+                return
+            try:
+                chunk = np.ctypeslib.as_array(
+                    out, shape=(int(n), arity)
+                ).copy()
+            finally:
+                lib.fml_free(out)
+            delivered += int(n)
+            yield chunk
+    finally:
+        lib.fml_close_csv_stream(handle)
+
+
+def iter_libsvm_chunks(path: str, n_features: int, zero_based: bool,
+                       max_rows: int):
+    """Stream a LibSVM file as ``(labels, [SparseVector])`` chunks."""
+    from flink_ml_tpu.ops.vector import SparseVector
+
+    lib = _load()
+    handle = lib.fml_open_libsvm_stream(path.encode(), 1 if zero_based else 0)
+    if not handle:
+        raise IOError(f"cannot read {path}")
+    try:
+        while True:
+            labels_p = ctypes.POINTER(ctypes.c_double)()
+            indptr_p = ctypes.POINTER(ctypes.c_int64)()
+            indices_p = ctypes.POINTER(ctypes.c_int64)()
+            values_p = ctypes.POINTER(ctypes.c_double)()
+            nnz = ctypes.c_int64(0)
+            max_idx = ctypes.c_int64(0)
+            n = lib.fml_next_libsvm_chunk(
+                handle, max_rows,
+                ctypes.byref(labels_p), ctypes.byref(indptr_p),
+                ctypes.byref(indices_p), ctypes.byref(values_p),
+                ctypes.byref(nnz), ctypes.byref(max_idx),
+            )
+            if n == -2:
+                raise ValueError(f"{path}: malformed libsvm input")
+            if n == -1:
+                raise MemoryError(f"native libsvm chunk alloc failed for {path}")
+            if n == 0:
+                return
+            try:
+                nr, nz = int(n), int(nnz.value)
+                labels = np.ctypeslib.as_array(labels_p, shape=(nr,)).copy()
+                indptr = np.ctypeslib.as_array(indptr_p, shape=(nr + 1,)).copy()
+                indices = np.ctypeslib.as_array(
+                    indices_p, shape=(max(nz, 1),)
+                )[:nz].copy()
+                values = np.ctypeslib.as_array(
+                    values_p, shape=(max(nz, 1),)
+                )[:nz].copy()
+            finally:
+                lib.fml_free(labels_p)
+                lib.fml_free(indptr_p)
+                lib.fml_free(indices_p)
+                lib.fml_free(values_p)
+            yield labels, _csr_to_vectors(
+                SparseVector, n_features, nr, indptr, indices, values
+            )
+    finally:
+        lib.fml_close_libsvm_stream(handle)

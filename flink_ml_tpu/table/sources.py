@@ -88,19 +88,43 @@ class CsvSource(BoundedSource):
 
     def read_chunks(self, max_rows: int) -> Iterator[Table]:
         """Stream the file as Tables of at most ``max_rows`` rows — host
-        residency is bounded by one chunk, never the whole file.  Rows come
-        from the same parser as ``read()``'s pure-Python path
-        (:func:`_iter_csv_rows`), so the streamed and materialized row
-        streams cannot drift."""
+        residency is bounded by one chunk, never the whole file.
+
+        All-float schemas stream through the native C++ doubles parser
+        (one (rows, arity) float64 matrix per chunk, no per-cell Python);
+        a non-numeric cell mid-stream falls back to the pure parser from
+        that exact row.  Other schemas use the same pure-Python parser as
+        ``read()``'s fallback (:func:`_iter_csv_rows`), so the streamed and
+        materialized row streams cannot drift."""
         if max_rows <= 0:
             raise ValueError("max_rows must be positive")
         names = self._schema.field_names
         types = self._schema.field_types
+        skip_rows = 0
+        native = _native_lib()
+        if native is not None and native.streaming_available() and all(
+            t in (DataTypes.DOUBLE, DataTypes.FLOAT) for t in types
+        ):
+            try:
+                for chunk in native.iter_csv_doubles(
+                    self.path, self.delimiter, self.skip_header,
+                    len(names), max_rows,
+                ):
+                    yield Table.from_columns(
+                        self._schema,
+                        {n: chunk[:, j] for j, n in enumerate(names)},
+                    )
+                return
+            except native.NativeFallback as fb:
+                skip_rows = fb.rows_delivered  # resume with the pure parser
+
         cols = {n: [] for n in names}
         count = 0
-        for raw in _iter_csv_rows(
+        for i, raw in enumerate(_iter_csv_rows(
             self.path, self.delimiter, self.skip_header, len(names)
-        ):
+        )):
+            if i < skip_rows:
+                continue
             for name, typ, cell in zip(names, types, raw):
                 cols[name].append(_parse_cell(cell, typ))
             count += 1
@@ -158,6 +182,15 @@ class LibSvmSource(BoundedSource):
                 "dimension cannot be inferred without materializing the file)"
             )
         dim = self.n_features
+        native = _native_lib()
+        if native is not None and native.streaming_available():
+            for labels, vecs in native.iter_libsvm_chunks(
+                self.path, dim, self.zero_based, max_rows
+            ):
+                yield Table.from_columns(
+                    self._schema, {"label": labels, "features": vecs}
+                )
+            return
         labels: List[float] = []
         vecs: List[SparseVector] = []
         for label, idx, val in _iter_libsvm_rows(self.path, self.zero_based):

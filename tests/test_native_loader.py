@@ -112,3 +112,106 @@ class TestControlByteFallback:
         rows = CsvSource(str(p), schema, skip_header=True).read().to_rows()
         assert rows == [(1.5, "a\x1fb")]
         assert native.read_csv(str(p), ",", False, 2) is None
+
+
+class TestNativeChunkedReaders:
+    """The streaming handles must deliver the same rows in the same order
+    as read(), in bounded chunks."""
+
+    def test_csv_doubles_chunks_match_read(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.randn(997, 4)
+        data[5, 2] = np.nan
+        path = tmp_path / "n.csv"
+        np.savetxt(path, data, delimiter=",", fmt="%.17g")
+        schema = Schema.of(*[(f"c{i}", "double") for i in range(4)])
+        src = CsvSource(str(path), schema)
+        whole = src.read()
+        chunks = list(src.read_chunks(100))
+        assert all(c.num_rows() <= 100 for c in chunks)
+        assert sum(c.num_rows() for c in chunks) == 997
+        streamed = np.concatenate(
+            [np.stack([c.col(f"c{i}") for i in range(4)], axis=1) for c in chunks]
+        )
+        ref = np.stack([whole.col(f"c{i}") for i in range(4)], axis=1)
+        np.testing.assert_array_equal(streamed, ref)
+
+    def test_csv_quoted_crlf_header(self, tmp_path):
+        path = tmp_path / "q.csv"
+        path.write_bytes(b'a,b\r\n"1.5",2\r\n"-2.25",\r\n3,4\r\n')
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        chunks = list(CsvSource(str(path), schema, skip_header=True).read_chunks(2))
+        got = np.concatenate(
+            [np.stack([c.col("a"), c.col("b")], axis=1) for c in chunks]
+        )
+        np.testing.assert_array_equal(
+            got, [[1.5, 2.0], [-2.25, np.nan], [3.0, 4.0]]
+        )
+
+    def test_csv_fallback_resumes_pure_parser(self, tmp_path, monkeypatch):
+        """A cell the native strtod rejects but Python's float() accepts
+        ('1_000') triggers mid-stream fallback with no row lost or doubled."""
+        path = tmp_path / "f.csv"
+        lines = [f"{i},{i * 2}" for i in range(50)]
+        lines[30] = "1_000,60"
+        path.write_text("\n".join(lines) + "\n")
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        chunks = list(CsvSource(str(path), schema).read_chunks(7))
+        a = np.concatenate([np.asarray(c.col("a")) for c in chunks])
+        expected = np.arange(50.0)
+        expected[30] = 1000.0
+        np.testing.assert_array_equal(a, expected)
+
+    def test_libsvm_chunks_match_read(self, tmp_path):
+        rng = np.random.RandomState(1)
+        path = tmp_path / "n.svm"
+        with open(path, "w") as f:
+            for i in range(333):
+                idx = np.sort(rng.choice(50, 4, replace=False))
+                pairs = " ".join(f"{j + 1}:{rng.randn():.9g}" for j in idx)
+                f.write(f"{i % 2} {pairs}\n")
+        src = LibSvmSource(str(path), n_features=50)
+        whole = src.read()
+        chunks = list(src.read_chunks(64))
+        assert sum(c.num_rows() for c in chunks) == 333
+        assert all(c.num_rows() <= 64 for c in chunks)
+        whole_rows = whole.to_rows()
+        streamed_rows = [r for c in chunks for r in c.to_rows()]
+        assert len(whole_rows) == len(streamed_rows)
+        for (l1, v1), (l2, v2) in zip(whole_rows, streamed_rows):
+            assert l1 == l2
+            np.testing.assert_array_equal(v1.indices, v2.indices)
+            np.testing.assert_array_equal(v1.vals, v2.vals)
+
+    def test_python_fallback_forced_matches_native(self, tmp_path, monkeypatch):
+        rng = np.random.RandomState(2)
+        data = rng.randn(200, 3)
+        path = tmp_path / "p.csv"
+        np.savetxt(path, data, delimiter=",", fmt="%.17g")
+        schema = Schema.of(*[(f"c{i}", "double") for i in range(3)])
+        native_chunks = list(CsvSource(str(path), schema).read_chunks(33))
+        monkeypatch.setenv("FLINK_ML_TPU_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_tried", False)
+        monkeypatch.setattr(native, "_lib", None)
+        pure_chunks = list(CsvSource(str(path), schema).read_chunks(33))
+        monkeypatch.setattr(native, "_tried", False)
+        monkeypatch.setattr(native, "_lib", None)
+        assert len(native_chunks) == len(pure_chunks)
+        for cn, cp in zip(native_chunks, pure_chunks):
+            for c in schema.field_names:
+                np.testing.assert_array_equal(
+                    np.asarray(cn.col(c)), np.asarray(cp.col(c))
+                )
+
+    def test_hex_and_nan_payload_route_to_fallback_error(self, tmp_path):
+        """strtod-only forms (hex floats, nan(payload)) must not silently
+        parse: the stream falls back to the pure parser, which raises the
+        same error read() raises."""
+        path = tmp_path / "h.csv"
+        path.write_text("1.0,2.0\n0x10,3.0\n")
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        src = CsvSource(str(path), schema)
+        with pytest.raises(ValueError):
+            src.read()
+        with pytest.raises(ValueError):
+            list(src.read_chunks(10))
