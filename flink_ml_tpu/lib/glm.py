@@ -277,7 +277,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         sstack = table.cached_pack(
             layout_key,
             lambda: pack_sparse_minibatches(
-                list(table.col(self.get_vector_col())), y, n_dev,
+                table.col(self.get_vector_col()), y, n_dev,
                 self.get_global_batch_size(), dim=num_features,
             ),
         )
@@ -357,8 +357,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             nnz_pad = oc.estimate_nnz_pad(table, vector_col, mb, n_dev)
 
             def extract(t):
+                # the column passes through as-is: CsrRows (native stream)
+                # stays vectorized end-to-end, object columns stay lists
                 return (
-                    list(t.col(vector_col)),
+                    t.col(vector_col),
                     np.asarray(t.col(label), dtype=np.float64),
                 )
 

@@ -54,6 +54,7 @@ from flink_ml_tpu.lib.common import (
     pack_minibatches,
     pack_sparse_minibatches,
 )
+from flink_ml_tpu.ops.batch import CsrRows
 from flink_ml_tpu.parallel.collectives import psum
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
@@ -159,6 +160,8 @@ def _block_rows(chunks: Iterator[Table], extract, rows_per_block: int):
 def _join(parts: list):
     if len(parts) == 1:
         return parts[0]
+    if all(isinstance(p, CsrRows) for p in parts):
+        return CsrRows.concat(parts)
     if isinstance(parts[0], np.ndarray) and parts[0].dtype != object:
         return np.concatenate(parts)
     out = []
@@ -419,8 +422,10 @@ def sparse_blocks_factory(
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
+                if not isinstance(vectors, CsrRows):
+                    vectors = list(vectors)
                 stack = pack_sparse_minibatches(
-                    list(vectors), np.asarray(y), n_dev,
+                    vectors, np.asarray(y), n_dev,
                     global_batch_size=mb * n_dev, dim=dim,
                     min_nnz_pad=nnz_pad, min_steps=steps_per_chunk,
                 )
@@ -682,8 +687,12 @@ def estimate_nnz_pad(
             t = next(chunks, None)
             if t is None:
                 break
-            for v in t.col(vector_col):
-                counts.append(len(v.indices))
+            col = t.col(vector_col)
+            if isinstance(col, CsrRows):
+                counts.extend(col.nnz_per_row().tolist())
+            else:
+                for v in col:
+                    counts.append(len(v.indices))
     finally:
         close = getattr(chunks, "close", None)
         if close is not None:

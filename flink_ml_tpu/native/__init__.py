@@ -18,7 +18,7 @@ Streaming (bounded memory — the out-of-core path):
       first non-numeric cell with .rows_delivered so the caller can resume
       the pure parser from that row
   iter_libsvm_chunks(path, n_features, zero_based, max_rows)
-      -> yields (labels ndarray, [SparseVector]) per chunk
+      -> yields raw CSR chunks (labels, indptr, indices, values)
 """
 
 from __future__ import annotations
@@ -211,15 +211,12 @@ def read_libsvm(path: str, n_features: Optional[int], zero_based: bool):
         lib.fml_free(values_p)
 
     dim = n_features if n_features is not None else int(max_idx.value) + 1
-    return labels, _csr_to_vectors(SparseVector, dim, nr, indptr, indices, values)
-
-
-def _csr_to_vectors(SparseVector, dim, nr, indptr, indices, values):
-    return [
+    vecs = [
         SparseVector(dim, indices[indptr[i]:indptr[i + 1]],
                      values[indptr[i]:indptr[i + 1]])
         for i in range(nr)
     ]
+    return labels, vecs
 
 
 def iter_csv_doubles(path: str, delimiter: str, skip_header: bool,
@@ -248,6 +245,7 @@ def iter_csv_doubles(path: str, delimiter: str, skip_header: bool,
             if n == -1:
                 raise MemoryError(f"native CSV chunk alloc failed for {path}")
             if n == 0:
+                lib.fml_free(out)  # the EOF call still allocated its buffer
                 return
             try:
                 chunk = np.ctypeslib.as_array(
@@ -263,9 +261,9 @@ def iter_csv_doubles(path: str, delimiter: str, skip_header: bool,
 
 def iter_libsvm_chunks(path: str, n_features: int, zero_based: bool,
                        max_rows: int):
-    """Stream a LibSVM file as ``(labels, [SparseVector])`` chunks."""
-    from flink_ml_tpu.ops.vector import SparseVector
-
+    """Stream a LibSVM file as raw CSR chunks
+    ``(labels, indptr, indices, values)`` — callers wrap them (CsrRows)
+    without any per-row Python."""
     lib = _load()
     handle = lib.fml_open_libsvm_stream(path.encode(), 1 if zero_based else 0)
     if not handle:
@@ -289,6 +287,9 @@ def iter_libsvm_chunks(path: str, n_features: int, zero_based: bool,
             if n == -1:
                 raise MemoryError(f"native libsvm chunk alloc failed for {path}")
             if n == 0:
+                # the EOF call still allocated its (empty) buffers
+                for p in (labels_p, indptr_p, indices_p, values_p):
+                    lib.fml_free(p)
                 return
             try:
                 nr, nz = int(n), int(nnz.value)
@@ -305,8 +306,6 @@ def iter_libsvm_chunks(path: str, n_features: int, zero_based: bool,
                 lib.fml_free(indptr_p)
                 lib.fml_free(indices_p)
                 lib.fml_free(values_p)
-            yield labels, _csr_to_vectors(
-                SparseVector, n_features, nr, indptr, indices, values
-            )
+            yield labels, indptr, indices, values
     finally:
         lib.fml_close_libsvm_stream(handle)

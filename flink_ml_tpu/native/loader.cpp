@@ -490,12 +490,15 @@ int64_t fml_next_csv_doubles(void* handle, int64_t max_rows, int64_t arity,
         }
         const char* b = s->ts.buf.c_str() + s->ts.pos;
         const char* e = s->ts.buf.c_str() + row_end;
-        if (b == e) {  // blank line: skipped, like csv.reader's empty row
+        // the header skip consumes physical row 0 even when blank (the pure
+        // parser enumerates csv.reader rows, so a blank first line IS the
+        // skipped header) — check before the blank-line skip
+        if (s->skip_pending) {
+            s->skip_pending = false;
             s->ts.pos = next_pos;
             continue;
         }
-        if (s->skip_pending) {
-            s->skip_pending = false;
+        if (b == e) {  // blank line: skipped, like csv.reader's empty row
             s->ts.pos = next_pos;
             continue;
         }

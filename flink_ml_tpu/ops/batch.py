@@ -43,6 +43,124 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+class CsrRows:
+    """A host-side CSR container of sparse rows — the sparse counterpart of
+    the matrix-backed dense-vector column.
+
+    Three contiguous arrays instead of one Python ``SparseVector`` object
+    per row: the native streaming loader emits these directly, bulk
+    consumers (minibatch packing, ``CsrBatch`` construction) read the
+    arrays without touching Python per row, and row-level consumers see
+    lazy ``SparseVector`` views through ``__getitem__``.  ``indptr`` is
+    always re-based to start at 0, so slices of slices stay O(rows).
+    """
+
+    __slots__ = ("dim", "indptr", "indices", "values")
+
+    def __init__(self, dim: int, indptr, indices, values):
+        self.dim = int(dim)
+        indptr = np.asarray(indptr, dtype=np.int64)
+        if indptr.size == 0:
+            indptr = np.zeros(1, dtype=np.int64)  # zero rows
+        if indptr[0] != 0:
+            raise ValueError("indptr must start at 0")
+        self.indptr = indptr
+        self.indices = np.asarray(indices)
+        self.values = np.asarray(values)
+
+    def __len__(self) -> int:
+        return len(self.indptr) - 1
+
+    def nnz_per_row(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, key):
+        if isinstance(key, (int, np.integer)):
+            i = int(key)
+            if i < 0:
+                i += len(self)
+            a, b = int(self.indptr[i]), int(self.indptr[i + 1])
+            return SparseVector(self.dim, self.indices[a:b], self.values[a:b])
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            if step != 1:
+                key = np.arange(start, stop, step)
+            else:
+                stop = max(stop, start)  # empty slice, like ndarray columns
+                a, b = int(self.indptr[start]), int(self.indptr[stop])
+                return CsrRows(
+                    self.dim,
+                    self.indptr[start : stop + 1] - a,
+                    self.indices[a:b],
+                    self.values[a:b],
+                )
+        idx = np.asarray(key)
+        if idx.dtype == bool:
+            idx = np.nonzero(idx)[0]
+        if idx.size == 0:
+            return CsrRows(
+                self.dim, np.zeros(1, dtype=np.int64),
+                self.indices[:0], self.values[:0],
+            )
+        idx = idx.astype(np.int64)
+        idx = np.where(idx < 0, idx + len(self), idx)  # ndarray semantics
+        counts = self.indptr[idx + 1] - self.indptr[idx]
+        total = int(counts.sum())
+        ends = np.cumsum(counts)
+        within = np.arange(total) - np.repeat(ends - counts, counts)
+        src = np.repeat(self.indptr[idx], counts) + within
+        return CsrRows(
+            self.dim,
+            np.concatenate([[0], ends]),
+            self.indices[src],
+            self.values[src],
+        )
+
+    @staticmethod
+    def concat(parts: Sequence["CsrRows"]) -> "CsrRows":
+        if not parts:
+            raise ValueError("concat of zero CsrRows")
+        dim = max(p.dim for p in parts)
+        pieces = [parts[0].indptr]
+        base = int(parts[0].indptr[-1])
+        for p in parts[1:]:
+            pieces.append(p.indptr[1:] + base)
+            base += int(p.indptr[-1])
+        return CsrRows(
+            dim,
+            np.concatenate(pieces),
+            np.concatenate([p.indices for p in parts]),
+            np.concatenate([p.values for p in parts]),
+        )
+
+    @staticmethod
+    def from_vectors(vectors: Sequence[SparseVector], dim: int = None) -> "CsrRows":
+        counts = np.fromiter(
+            (len(v.indices) for v in vectors), dtype=np.int64, count=len(vectors)
+        )
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        indices = (
+            np.concatenate([np.asarray(v.indices) for v in vectors])
+            if len(vectors) else np.zeros((0,), dtype=np.int64)
+        )
+        values = (
+            np.concatenate([np.asarray(v.vals) for v in vectors])
+            if len(vectors) else np.zeros((0,))
+        )
+        if dim is None:
+            dim = max((v.size() for v in vectors), default=0)
+            if indices.size:
+                dim = max(dim, int(indices.max()) + 1)
+        return CsrRows(dim, indptr, indices, values)
+
+    def __repr__(self) -> str:
+        return f"CsrRows(rows={len(self)}, dim={self.dim}, nnz={self.indices.size})"
+
+
 @jax.tree_util.register_pytree_node_class
 class CsrBatch:
     """A batch of sparse rows in padded segment-COO layout.
@@ -92,6 +210,28 @@ class CsrBatch:
             row_ids[:nnz] = np.concatenate(row_parts)
         return CsrBatch(jnp.asarray(indices), jnp.asarray(values), jnp.asarray(row_ids),
                         n_rows=len(vectors), n_cols=n_cols)
+
+    @staticmethod
+    def from_csr_rows(rows: "CsrRows", n_cols: int, pad_multiple: int = 1024) -> "CsrBatch":
+        """Vectorized CsrBatch construction from a CSR column — no per-row
+        Python; same layout and validation as :meth:`from_vectors`."""
+        nnz = int(rows.indptr[-1])
+        if nnz and (
+            int(rows.indices.max()) >= n_cols or int(rows.indices.min()) < 0
+        ):
+            raise ValueError(f"feature index out of range for n_cols={n_cols}")
+        nnz_pad = max(_round_up(max(nnz, 1), pad_multiple), pad_multiple)
+        indices = np.zeros(nnz_pad, dtype=np.int32)
+        values = np.zeros(nnz_pad, dtype=np.float32)
+        row_ids = np.full(nnz_pad, len(rows), dtype=np.int32)  # pad segment
+        if nnz:
+            indices[:nnz] = rows.indices
+            values[:nnz] = rows.values
+            row_ids[:nnz] = np.repeat(
+                np.arange(len(rows), dtype=np.int32), rows.nnz_per_row()
+            )
+        return CsrBatch(jnp.asarray(indices), jnp.asarray(values),
+                        jnp.asarray(row_ids), n_rows=len(rows), n_cols=n_cols)
 
     @staticmethod
     def from_arrays(indices, values, row_ids, n_rows: int, n_cols: int) -> "CsrBatch":

@@ -184,11 +184,23 @@ class LibSvmSource(BoundedSource):
         dim = self.n_features
         native = _native_lib()
         if native is not None and native.streaming_available():
-            for labels, vecs in native.iter_libsvm_chunks(
+            from flink_ml_tpu.ops.batch import CsrRows
+
+            for labels, indptr, indices, values in native.iter_libsvm_chunks(
                 self.path, dim, self.zero_based, max_rows
             ):
+                # the pure path's SparseVector constructor rejects indices
+                # beyond the declared size at parse time; match it
+                if indices.size and int(indices.max()) >= dim:
+                    raise ValueError(
+                        f"{self.path}: feature index {int(indices.max())} out "
+                        f"of range for declared size {dim}"
+                    )
+                # CSR-backed column: zero per-row Python between the C++
+                # parser and the vectorized minibatch packer
+                rows = CsrRows(dim, indptr, indices, values)
                 yield Table.from_columns(
-                    self._schema, {"label": labels, "features": vecs}
+                    self._schema, {"label": labels, "features": rows}
                 )
             return
         labels: List[float] = []

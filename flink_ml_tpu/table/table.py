@@ -18,7 +18,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from flink_ml_tpu.ops.batch import CsrBatch, dense_batch
+from flink_ml_tpu.ops.batch import CsrBatch, CsrRows, dense_batch
 from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
 from flink_ml_tpu.table.schema import DataTypes, Schema
 
@@ -35,7 +35,7 @@ class Table:
     def __init__(self, schema: Schema, cols: Dict[str, np.ndarray]):
         self._schema = schema
         self._cols = cols
-        lengths = {len(c) for c in cols.values()}
+        lengths = {len(c) for c in cols.values()}  # CsrRows defines __len__
         if len(lengths) > 1:
             raise ValueError(f"ragged columns: lengths {lengths}")
         self._num_rows = lengths.pop() if lengths else 0
@@ -156,6 +156,18 @@ class Table:
         cols = {}
         for n in schema.field_names:
             arrays = [t._cols[n] for t in tables]
+            if any(isinstance(a, CsrRows) for a in arrays):
+                if all(isinstance(a, CsrRows) for a in arrays):
+                    cols[n] = CsrRows.concat(arrays)
+                else:  # mixed CSR/object sparse columns: normalize to objects
+                    obj = np.empty(sum(len(a) for a in arrays), dtype=object)
+                    i = 0
+                    for a in arrays:
+                        for v in a:
+                            obj[i] = v
+                            i += 1
+                    cols[n] = obj
+                continue
             ndims = {a.ndim for a in arrays}
             if len(ndims) > 1:
                 # mixed matrix-backed and object-backed vector columns:
@@ -203,6 +215,11 @@ class Table:
 
     def features_csr(self, col: str, n_cols: int, pad_multiple: int = 1024) -> CsrBatch:
         """A (sparse-)vector column as a CsrBatch for the device sparse path."""
+        column = self.col(col)
+        if isinstance(column, CsrRows):
+            return CsrBatch.from_csr_rows(
+                column, n_cols=n_cols, pad_multiple=pad_multiple
+            )
         vectors = []
         for v in self.col(col):
             if isinstance(v, SparseVector):
@@ -231,6 +248,10 @@ class Table:
 def _as_column(values, typ: str) -> np.ndarray:
     dtype = DataTypes.numpy_dtype(typ)
     if dtype is object:
+        if typ.upper() == DataTypes.SPARSE_VECTOR and isinstance(values, CsrRows):
+            # CSR-backed sparse column: contiguous arrays, lazy row views —
+            # the sparse counterpart of the matrix-backed dense fast path
+            return values
         if (
             typ.upper() in (DataTypes.DENSE_VECTOR, DataTypes.VECTOR)
             and isinstance(values, np.ndarray)

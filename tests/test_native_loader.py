@@ -215,3 +215,32 @@ class TestNativeChunkedReaders:
             src.read()
         with pytest.raises(ValueError):
             list(src.read_chunks(10))
+
+    def test_blank_first_line_consumed_as_header(self, tmp_path):
+        """Pure csv.reader treats physical row 0 as the header even when
+        blank; the native stream must match (same rows, same errors)."""
+        path = tmp_path / "bh.csv"
+        path.write_bytes(b"\na,b\n1,2\n")
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        src = CsvSource(str(path), schema, skip_header=True)
+        with pytest.raises(ValueError):
+            src.read()  # 'a' is a data row once the blank header is skipped
+        with pytest.raises(ValueError):
+            list(src.read_chunks(10))
+
+    def test_out_of_range_index_raises_like_pure_path(self, tmp_path):
+        path = tmp_path / "oor.svm"
+        path.write_text("1 7:2.0\n")
+        src = LibSvmSource(str(path), n_features=3)
+        with pytest.raises(ValueError, match="out of range|declared size"):
+            list(src.read_chunks(10))
+
+    def test_stream_generators_free_eof_buffers(self, tmp_path):
+        """Exhausting the streams must not leak the EOF call's buffers
+        (smoke: run many iterations; correctness asserted by valgrind-less
+        proxy — the wrappers call fml_free on the n==0 path)."""
+        path = tmp_path / "t.csv"
+        path.write_text("1.0,2.0\n")
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        for _ in range(50):
+            assert sum(c.num_rows() for c in CsvSource(str(path), schema).read_chunks(4)) == 1

@@ -352,3 +352,87 @@ def test_concat_mixed_layouts():
     assert out.num_rows() == 5
     np.testing.assert_allclose(out.features_dense("features")[:4], X)
     np.testing.assert_allclose(out.features_dense("features")[4], [9.0, 9.0, 9.0])
+
+
+class TestCsrRowsColumn:
+    """CSR-backed sparse columns: the contiguous-array counterpart of the
+    matrix-backed dense column (native streaming feeds these)."""
+
+    def _rows(self, n=20, dim=30, seed=0):
+        from flink_ml_tpu.ops.batch import CsrRows
+        from flink_ml_tpu.ops.vector import SparseVector
+
+        rng = np.random.RandomState(seed)
+        vecs = []
+        for _ in range(n):
+            k = rng.randint(0, 5)
+            idx = np.sort(rng.choice(dim, k, replace=False))
+            vecs.append(SparseVector(dim, idx, rng.randn(k)))
+        return CsrRows.from_vectors(vecs, dim=dim), vecs
+
+    def test_round_trip_and_indexing(self):
+        rows, vecs = self._rows()
+        assert len(rows) == len(vecs)
+        for i in (0, 5, len(vecs) - 1, -1):
+            got, want = rows[i], vecs[i]
+            np.testing.assert_array_equal(got.indices, want.indices)
+            np.testing.assert_array_equal(got.vals, want.vals)
+        sub = rows[3:11]
+        assert len(sub) == 8
+        np.testing.assert_array_equal(sub[0].indices, vecs[3].indices)
+        gathered = rows[np.array([7, 2, 19])]
+        np.testing.assert_array_equal(gathered[1].vals, vecs[2].vals)
+        masked = rows[np.arange(len(rows)) % 2 == 0]
+        assert len(masked) == 10
+
+    def test_concat(self):
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        a, va = self._rows(seed=1)
+        b, vb = self._rows(seed=2)
+        cat = CsrRows.concat([a, b])
+        assert len(cat) == len(va) + len(vb)
+        np.testing.assert_array_equal(cat[len(va)].vals, vb[0].vals)
+
+    def test_table_ops_on_csr_column(self):
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        rows, vecs = self._rows()
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR), ("y", "double"))
+        t = Table.from_columns(
+            schema, {"features": rows, "y": np.arange(float(len(rows)))}
+        )
+        assert isinstance(t.col("features"), CsrRows)
+        sliced = t.slice_rows(2, 6)
+        assert sliced.num_rows() == 4
+        np.testing.assert_array_equal(
+            sliced.to_rows()[0][0].indices, vecs[2].indices
+        )
+        both = Table.concat([t, t])
+        assert isinstance(both.col("features"), CsrRows)
+        assert both.num_rows() == 2 * len(rows)
+        csr = t.features_csr("features", n_cols=30)
+        assert csr.n_rows == len(rows)
+
+    def test_pack_paths_bit_identical(self):
+        """The vectorized CSR packer must produce byte-identical minibatch
+        stacks to the per-row SparseVector packer."""
+        from flink_ml_tpu.lib.common import pack_sparse_minibatches
+
+        rows, vecs = self._rows(n=533, dim=100, seed=3)
+        y = np.random.RandomState(4).randn(533)
+        for n_dev, gbs in ((1, 64), (4, 128), (8, 0)):
+            a = pack_sparse_minibatches(vecs, y, n_dev, gbs, dim=100)
+            b = pack_sparse_minibatches(rows, y, n_dev, gbs, dim=100)
+            assert (a.steps, a.mb, a.nnz_pad, a.dim, a.n_rows) == (
+                b.steps, b.mb, b.nnz_pad, b.dim, b.n_rows
+            )
+            np.testing.assert_array_equal(a.ints, b.ints)
+            np.testing.assert_array_equal(a.floats, b.floats)
+
+    def test_pack_csr_validates_range(self):
+        from flink_ml_tpu.lib.common import pack_sparse_minibatches
+
+        rows, _ = self._rows(n=10, dim=30)
+        with pytest.raises(ValueError, match="out of range"):
+            pack_sparse_minibatches(rows, np.zeros(10), 1, 4, dim=3)
