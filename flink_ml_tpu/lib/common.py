@@ -215,17 +215,9 @@ def pack_sparse_minibatches(
             f"feature index {max_idx} out of range for numFeatures={dim}"
         )
     dim = max(dim, 1)
-    if global_batch_size <= 0:
-        global_batch_size = max(n, n_dev)
-    mb = max(1, -(-global_batch_size // n_dev))
-    steps = max(max(1, -(-n // (mb * n_dev))), int(min_steps))
-    n_groups = n_dev * steps
-
-    # step-major rows (see pack_minibatches): group g = device k, local step
-    # s covers rows [s*G + k*mb, s*G + (k+1)*mb) with G = n_dev*mb
-    def _group_lo(g: int) -> int:
-        k, s = divmod(g, steps)
-        return s * (n_dev * mb) + k * mb
+    mb, steps, n_groups, _group_lo = _sparse_layout(
+        n, n_dev, global_batch_size, min_steps
+    )
 
     # max nnz over minibatches, padded to a bucket multiple (shared static shape)
     nnz_max = 1
@@ -261,6 +253,25 @@ def pack_sparse_minibatches(
     )
 
 
+def _sparse_layout(n: int, n_dev: int, global_batch_size: int, min_steps: int):
+    """The ONE copy of the sparse stack's scalar layout math: per-device
+    minibatch rows, step count, group count, and the step-major group->row
+    mapping (group g = device k, local step s covers rows
+    [s*G + k*mb, s*G + (k+1)*mb) with G = n_dev*mb).  Shared by the per-row
+    and vectorized CSR packers so their layouts cannot drift (their outputs
+    are asserted byte-identical in tests)."""
+    if global_batch_size <= 0:
+        global_batch_size = max(n, n_dev)
+    mb = max(1, -(-global_batch_size // n_dev))
+    steps = max(max(1, -(-n // (mb * n_dev))), int(min_steps))
+
+    def group_lo(g: int) -> int:
+        k, s = divmod(g, steps)
+        return s * (n_dev * mb) + k * mb
+
+    return mb, steps, n_dev * steps, group_lo
+
+
 def _pack_sparse_minibatches_csr(
     rows, y, n_dev: int, global_batch_size: int, dim, pad_multiple: int,
     min_nnz_pad: int, min_steps: int,
@@ -273,7 +284,9 @@ def _pack_sparse_minibatches_csr(
     nnz_total = int(indptr[-1]) if n else 0
     max_idx = int(indices.max()) if nnz_total else -1
     if nnz_total and int(indices.min()) < 0:
-        raise ValueError("negative feature index")
+        first_bad = int(np.argmax(indices < 0))
+        row = int(np.searchsorted(indptr, first_bad, side="right")) - 1
+        raise ValueError(f"row {row}: negative feature index")
     if dim is None:
         dim = max(max_idx + 1, rows.dim)
     elif max_idx >= dim:
@@ -281,15 +294,9 @@ def _pack_sparse_minibatches_csr(
             f"feature index {max_idx} out of range for numFeatures={dim}"
         )
     dim = max(dim, 1)
-    if global_batch_size <= 0:
-        global_batch_size = max(n, n_dev)
-    mb = max(1, -(-global_batch_size // n_dev))
-    steps = max(max(1, -(-n // (mb * n_dev))), int(min_steps))
-    n_groups = n_dev * steps
-
-    def _group_lo(g: int) -> int:
-        k, s = divmod(g, steps)
-        return s * (n_dev * mb) + k * mb
+    mb, steps, n_groups, _group_lo = _sparse_layout(
+        n, n_dev, global_batch_size, min_steps
+    )
 
     counts = rows.nnz_per_row()
     nnz_max = 1

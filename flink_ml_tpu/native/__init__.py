@@ -9,7 +9,7 @@ and the table sources fall back to pure Python when it is unavailable —
   read_csv(path, delimiter, skip_header, arity) -> list[list[str]] | None
       (None = input not representable in the native transport — control
       bytes inside quoted cells — caller must fall back to the pure parser)
-  read_libsvm(path, n_features, zero_based) -> (labels ndarray, [SparseVector])
+  read_libsvm(path, n_features, zero_based) -> (labels ndarray, CsrRows)
 
 Streaming (bounded memory — the out-of-core path):
 
@@ -178,7 +178,13 @@ def read_csv(path: str, delimiter: str, skip_header: bool, arity: int):
 
 
 def read_libsvm(path: str, n_features: Optional[int], zero_based: bool):
-    from flink_ml_tpu.ops.vector import SparseVector
+    """Whole-file LibSVM parse -> (labels, CsrRows column).
+
+    The CSR column IS the fast representation (lazy SparseVector row views
+    for row-level consumers, contiguous arrays for the vectorized packer) —
+    no per-row object construction on load.
+    """
+    from flink_ml_tpu.ops.batch import CsrRows
 
     lib = _load()
     labels_p = ctypes.POINTER(ctypes.c_double)()
@@ -211,12 +217,12 @@ def read_libsvm(path: str, n_features: Optional[int], zero_based: bool):
         lib.fml_free(values_p)
 
     dim = n_features if n_features is not None else int(max_idx.value) + 1
-    vecs = [
-        SparseVector(dim, indices[indptr[i]:indptr[i + 1]],
-                     values[indptr[i]:indptr[i + 1]])
-        for i in range(nr)
-    ]
-    return labels, vecs
+    if n_features is not None and nz and int(indices.max()) >= dim:
+        raise ValueError(
+            f"{path}: feature index {int(indices.max())} out of range for "
+            f"declared size {dim}"
+        )
+    return labels, CsrRows(dim, indptr, indices, values)
 
 
 def iter_csv_doubles(path: str, delimiter: str, skip_header: bool,

@@ -83,6 +83,10 @@ class CsrRows:
             i = int(key)
             if i < 0:
                 i += len(self)
+            if not 0 <= i < len(self):
+                raise IndexError(
+                    f"index {int(key)} out of range for {len(self)} rows"
+                )
             a, b = int(self.indptr[i]), int(self.indptr[i + 1])
             return SparseVector(self.dim, self.indices[a:b], self.values[a:b])
         if isinstance(key, slice):
@@ -100,6 +104,10 @@ class CsrRows:
                 )
         idx = np.asarray(key)
         if idx.dtype == bool:
+            if idx.shape != (len(self),):
+                raise IndexError(
+                    f"boolean mask of length {idx.size} for {len(self)} rows"
+                )
             idx = np.nonzero(idx)[0]
         if idx.size == 0:
             return CsrRows(
@@ -108,6 +116,8 @@ class CsrRows:
             )
         idx = idx.astype(np.int64)
         idx = np.where(idx < 0, idx + len(self), idx)  # ndarray semantics
+        if int(idx.min()) < 0 or int(idx.max()) >= len(self):
+            raise IndexError(f"index out of range for {len(self)} rows")
         counts = self.indptr[idx + 1] - self.indptr[idx]
         total = int(counts.sum())
         ends = np.cumsum(counts)
