@@ -474,3 +474,22 @@ class TestOutOfCore2D:
         np.testing.assert_allclose(
             np.sort(c2, axis=0), np.sort(c1, axis=0), rtol=1e-4, atol=1e-5
         )
+
+
+class TestPipelineIntegration:
+    def test_single_stage_pipeline_accepts_chunked_table(self):
+        """Pipeline.fit passes a ChunkedTable straight to the estimator
+        (the reference's pipeline over a partitioned source)."""
+        from flink_ml_tpu.api.pipeline import Pipeline
+
+        table, _, _ = dense_data(2000)
+        chunked = ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 512)
+        pipeline_model = Pipeline([make_estimator(iters=3)]).fit(chunked)
+        direct = make_estimator(iters=3).fit(
+            ChunkedTable(CollectionSource(table.to_rows(), SCHEMA), 512)
+        )
+        (out,) = pipeline_model.transform(table)
+        direct_out = direct.transform(table)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out.col("pred")), np.asarray(direct_out.col("pred"))
+        )
