@@ -82,6 +82,21 @@ class AlgoOperator(Stage):
 class Transformer(AlgoOperator):
     """Marker: row-wise 1-in/1-out semantics (Transformer.java:70-71)."""
 
+    def transform_chunks(self, chunked_table):
+        """Streamed inference: score a ChunkedTable chunk by chunk, yielding
+        one output Table per input chunk.
+
+        The out-of-core counterpart of ``transform`` — works for any
+        Transformer (PipelineModel included): per-chunk transforms reuse
+        whatever device state the stage caches, and host residency stays
+        bounded by one chunk, so files larger than RAM score end-to-end.
+        Feed the iterator to
+        :func:`flink_ml_tpu.utils.persistence.write_csv_chunks` to stream
+        results straight to disk.
+        """
+        for chunk in chunked_table.chunks():
+            yield self.transform(chunk)[0]
+
 
 class Model(Transformer):
     """A Transformer with attached model data (Model.java:102-122)."""

@@ -86,3 +86,5 @@ class TableModelBase(Model):
             self._mapper_cache_key = key
         batch = MLEnvironmentFactory.get_default().default_batch_size
         return (self._mapper_cache.apply(table, batch_size=batch),)
+    # transform_chunks (streamed inference) is inherited from Transformer;
+    # the mapper cache above keeps the model device-resident across chunks

@@ -13,6 +13,7 @@ Pipeline.save/load throwing (Pipeline.java:100-106); model data was meant to be
 
 from __future__ import annotations
 
+import csv
 import json
 import os
 from typing import List
@@ -47,6 +48,42 @@ def load_table(path: str) -> Table:
             raw = json.loads(line)
             rows.append(decode_row(raw, schema))
     return Table.from_rows(rows, schema)
+
+
+def write_csv_chunks(tables, path: str, delimiter: str = ",",
+                     header: bool = True) -> int:
+    """Stream an iterator of Tables (one schema) to a CSV file.
+
+    The sink side of the out-of-core story: feed it
+    ``model.transform_chunks(chunked_table)`` and arbitrarily large inputs
+    score to disk with bounded host memory.  Vector cells use the
+    VectorUtil-compatible codec (quoted — they contain the delimiter).
+    Returns the number of rows written.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    rows_written = 0
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f, delimiter=delimiter)
+        first = True
+        for table in tables:
+            schema = table.schema
+            if first and header:
+                writer.writerow(schema.field_names)
+            first = False
+            types = schema.field_types
+            for row in table.to_rows():
+                writer.writerow(
+                    [_csv_cell(v, t) for v, t in zip(row, types)]
+                )
+                rows_written += 1
+    return rows_written
+
+
+def _csv_cell(v, typ: str):
+    # one codec for both layouts: encode like the jsonl writer, then map
+    # its None (null/NaN) to the empty CSV cell
+    e = _encode_value(v, typ)
+    return "" if e is None else e
 
 
 def encode_row(row, schema: Schema) -> list:

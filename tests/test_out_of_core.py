@@ -493,3 +493,81 @@ class TestPipelineIntegration:
         np.testing.assert_array_equal(
             np.asarray(out.col("pred")), np.asarray(direct_out.col("pred"))
         )
+
+    def test_dense_vector_col_stream_peeks_dim(self):
+        """vectorCol dense streaming with no numFeatures pins the width by
+        peeking one chunk, then bit-matches the in-memory fit."""
+        from flink_ml_tpu.ops.vector import DenseVector
+
+        rng = np.random.RandomState(17)
+        X = rng.randn(3000, 4)
+        y = X @ np.array([1.0, -1.0, 2.0, 0.5]) + 0.2
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+        rows = [(DenseVector(r), float(v)) for r, v in zip(X, y)]
+        table = Table.from_rows(rows, schema)
+
+        def est():
+            return (
+                LinearRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("p")
+                .set_learning_rate(0.05).set_global_batch_size(256)
+                .set_max_iter(3)
+            )
+
+        in_mem = est().fit(table)
+        streamed = est().fit(
+            ChunkedTable(CollectionSource(rows, schema), chunk_rows=700)
+        )
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+
+
+class TestStreamedInference:
+    def test_transform_chunks_matches_whole_transform(self, tmp_path):
+        """Scoring a file chunk by chunk (model resident on device across
+        chunks) equals scoring the materialized table, and the CSV sink
+        round-trips the streamed output."""
+        from flink_ml_tpu.utils.persistence import write_csv_chunks
+
+        table, X, y = dense_data(6000, seed=41)
+        path = tmp_path / "in.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        source = CsvSource(str(path), SCHEMA)
+        model = make_estimator(iters=3).fit(ChunkedTable(source, 1500))
+
+        whole = model.transform(source.read())[0]
+        streamed = Table.concat(
+            list(model.transform_chunks(ChunkedTable(source, 1100)))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(streamed.col("pred")), np.asarray(whole.col("pred"))
+        )
+
+        out_path = tmp_path / "scored.csv"
+        n = write_csv_chunks(
+            model.transform_chunks(ChunkedTable(source, 1100)), str(out_path)
+        )
+        assert n == 6000
+        out_schema = Schema.of(
+            *[(name, "double") for name in streamed.schema.field_names]
+        )
+        read_back = CsvSource(str(out_path), out_schema, skip_header=True).read()
+        np.testing.assert_allclose(
+            np.asarray(read_back.col("pred")),
+            np.asarray(whole.col("pred")), rtol=1e-15,
+        )
+
+    def test_pipeline_model_streams_inference_too(self, tmp_path):
+        from flink_ml_tpu.api.pipeline import Pipeline
+
+        table, X, y = dense_data(3000, seed=43)
+        path = tmp_path / "p.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        source = CsvSource(str(path), SCHEMA)
+        pm = Pipeline([make_estimator(iters=3)]).fit(ChunkedTable(source, 800))
+        whole = pm.transform(source.read())[0]
+        streamed = Table.concat(list(pm.transform_chunks(ChunkedTable(source, 700))))
+        np.testing.assert_array_equal(
+            np.asarray(streamed.col("pred")), np.asarray(whole.col("pred"))
+        )
