@@ -95,7 +95,7 @@ class Transformer(AlgoOperator):
         results straight to disk.
         """
         for chunk in chunked_table.chunks():
-            yield self.transform(chunk)[0]
+            yield self.transform1(chunk)  # asserts the 1-in/1-out contract
 
 
 class Model(Transformer):

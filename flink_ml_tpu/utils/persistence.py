@@ -59,6 +59,11 @@ def write_csv_chunks(tables, path: str, delimiter: str = ",",
     score to disk with bounded host memory.  Vector cells use the
     VectorUtil-compatible codec (quoted — they contain the delimiter).
     Returns the number of rows written.
+
+    Null fidelity: None/NaN cells write as empty; CSV has no typed null, so
+    reading the file back yields each type's null convention (NaN for
+    float columns, 0 for int, '' for string) — the round trip is lossless
+    for float data (the scoring-output case), lossy for nulls elsewhere.
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     rows_written = 0

@@ -373,33 +373,39 @@ class TestKMeansOutOfCore:
         assert sample.max() > 9000
 
 
+def mesh_2d(data, model):
+    """Context manager swapping the default environment onto a
+    (data x model) mesh for the duration."""
+    import contextlib
+
+    import jax
+
+    from flink_ml_tpu.parallel.mesh import create_mesh
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    @contextlib.contextmanager
+    def ctx():
+        env = MLEnvironmentFactory.get_default()
+        old = env.get_mesh()
+        env.set_mesh(
+            create_mesh({"data": data, "model": model},
+                        jax.devices()[: data * model])
+        )
+        try:
+            yield
+        finally:
+            env.set_mesh(old)
+
+    return ctx()
+
+
 class TestOutOfCore2D:
     """The north-star configuration: rows stream over the 'data' axis while
     the sparse weight vector shards over 'model' (Criteo-scale data AND a
     wider-than-one-chip model at once)."""
 
     def _mesh(self, data, model):
-        import contextlib
-
-        import jax
-
-        from flink_ml_tpu.parallel.mesh import create_mesh
-        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
-
-        @contextlib.contextmanager
-        def ctx():
-            env = MLEnvironmentFactory.get_default()
-            old = env.get_mesh()
-            env.set_mesh(
-                create_mesh({"data": data, "model": model},
-                            jax.devices()[: data * model])
-            )
-            try:
-                yield
-            finally:
-                env.set_mesh(old)
-
-        return ctx()
+        return mesh_2d(data, model)
 
     def test_sparse_2d_stream_matches_in_memory_2d(self):
         table, vectors, labels, dim = sparse_data(n=2000, dim=501)
@@ -570,4 +576,93 @@ class TestStreamedInference:
         streamed = Table.concat(list(pm.transform_chunks(ChunkedTable(source, 700))))
         np.testing.assert_array_equal(
             np.asarray(streamed.col("pred")), np.asarray(whole.col("pred"))
+        )
+
+
+class TestFeatureInteractions:
+    """Combinations of out-of-core features that could interact badly:
+    spill x checkpoint x kill, sharded libsvm files, 2-D x spill."""
+
+    def test_spill_plus_checkpoint_resume(self, tmp_path):
+        _, X, y = dense_data(4000, seed=51)
+        path = tmp_path / "d.csv"
+        np.savetxt(path, np.column_stack([X, y]), delimiter=",", fmt="%.17g")
+        source = CsvSource(str(path), SCHEMA)
+        full = make_estimator(iters=6).fit(
+            ChunkedTable(source, 1000, spill=True)
+        )
+        ckpt = str(tmp_path / "ck")
+
+        def est(iters):
+            return (
+                make_estimator(iters=iters)
+                .set_checkpoint_dir(ckpt).set_checkpoint_interval(2)
+            )
+
+        est(3).fit(ChunkedTable(source, 1000, spill=True))
+        resumed = est(6).fit(ChunkedTable(source, 1000, spill=True))
+        assert resumed.train_epochs_ == 6
+        np.testing.assert_allclose(
+            resumed.coefficients(), full.coefficients(), rtol=1e-6, atol=1e-9
+        )
+
+    def test_sharded_libsvm_files_stream(self, tmp_path):
+        table, vectors, labels, dim = sparse_data(n=1800)
+        per = 600
+        for s in range(3):
+            with open(tmp_path / f"part-{s}.svm", "w") as f:
+                for i in range(s * per, (s + 1) * per):
+                    v = vectors[i]
+                    feats = " ".join(
+                        f"{int(j) + 1}:{val:.17g}"
+                        for j, val in zip(v.indices, v.vals)
+                    )
+                    f.write(f"{labels[i]:g} {feats}\n")
+        sharded = ShardedSource.glob(
+            str(tmp_path / "part-*.svm"),
+            lambda p: LibSvmSource(p, n_features=dim),
+        )
+        est = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_num_features(dim).set_learning_rate(0.1)
+            .set_global_batch_size(256).set_max_iter(3)
+        )
+        streamed = est.fit(ChunkedTable(sharded, chunk_rows=500))
+        in_mem = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_num_features(dim).set_learning_rate(0.1)
+            .set_global_batch_size(256).set_max_iter(3)
+            .fit(sharded.read())
+        )
+        np.testing.assert_array_equal(
+            streamed.coefficients(), in_mem.coefficients()
+        )
+
+    def test_2d_mesh_with_spill(self, tmp_path):
+        table, vectors, labels, dim = sparse_data(n=1200, dim=500)
+        path = tmp_path / "s.svm"
+        with open(path, "w") as f:
+            for label, v in zip(labels, vectors):
+                feats = " ".join(
+                    f"{int(i) + 1}:{val:.17g}"
+                    for i, val in zip(v.indices, v.vals)
+                )
+                f.write(f"{label:g} {feats}\n")
+        source = LibSvmSource(str(path), n_features=dim)
+
+        def est():
+            return (
+                LogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("p")
+                .set_num_features(dim).set_learning_rate(0.1)
+                .set_global_batch_size(256).set_max_iter(4)
+            )
+
+        with mesh_2d(4, 2):
+            direct = est().fit(ChunkedTable(source, 400))
+            spilled = est().fit(ChunkedTable(source, 400, spill=True))
+        np.testing.assert_array_equal(
+            spilled.coefficients(), direct.coefficients()
         )
