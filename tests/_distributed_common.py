@@ -1,0 +1,36 @@
+"""Shared epoch definition for the cross-process equivalence test.
+
+test_distributed.py (single-process 8-device reference) and
+distributed_worker.py (2-process x 4-device run) must execute the IDENTICAL
+training epoch; importing the definition from one place makes that
+invariant structural rather than copy-synced.
+"""
+
+import numpy as np
+
+N_DEV = 8
+GLOBAL_BATCH = 16
+LEARNING_RATE = 0.5
+
+
+def make_epoch_inputs():
+    """(combined minibatch stack view, zero params) for the shared epoch."""
+    from flink_ml_tpu.lib.common import _combined_view, pack_minibatches
+
+    rng = np.random.RandomState(0)
+    Xg = rng.randn(64, 3)
+    yg = (Xg @ np.array([1.0, -1.0, 0.5]) > 0).astype(np.float64)
+    stack = pack_minibatches(
+        Xg, yg, n_dev=N_DEV, global_batch_size=GLOBAL_BATCH
+    )
+    params0 = (np.zeros((3,), np.float32), np.zeros((), np.float32))
+    return _combined_view(stack), params0
+
+
+def make_epoch_step(mesh):
+    from flink_ml_tpu.lib.classification import _log_loss_grads
+    from flink_ml_tpu.lib.common import make_glm_epoch_step
+
+    return make_glm_epoch_step(
+        _log_loss_grads(True), mesh, learning_rate=LEARNING_RATE, reg=0.0
+    )

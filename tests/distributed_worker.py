@@ -58,4 +58,35 @@ garr = jax.make_array_from_process_local_data(
 total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
 print(f"RESULT {float(total)}", flush=True)
 
+# One REAL framework training epoch across the process boundary: both
+# processes deterministically pack the same global minibatch stack, each
+# feeds only its local shard, and the epoch step's in-step gradient psum
+# crosses the process boundary.  The parent test runs the identical epoch
+# on a single-process 8-device mesh and compares the numbers — 2x4
+# multi-process must equal 1x8 single-process.
+from tests._distributed_common import make_epoch_inputs, make_epoch_step
+
+combined, params0 = make_epoch_inputs()  # (n_dev*steps, mb, d+2)
+local = combined[combined.shape[0] // num_processes * process_id :
+                 combined.shape[0] // num_processes * (process_id + 1)]
+# x/y/w as separate leaves, all sharded from process-local slices
+x_l, y_l, w_l = local[..., :-2], local[..., -2], local[..., -1]
+batch = tuple(
+    jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), arr,
+        global_shape=(combined.shape[0],) + arr.shape[1:],
+    )
+    for arr in (x_l, y_l, w_l)
+)
+params = tuple(
+    jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P()), p, global_shape=p.shape
+    )
+    for p in params0
+)
+epoch_step = make_epoch_step(mesh)
+(w, b), (loss, delta) = epoch_step(params, batch)
+vals = [float(v) for v in np.asarray(w)] + [float(b), float(loss)]
+print("TRAIN " + " ".join(f"{v:.9e}" for v in vals), flush=True)
+
 shutdown_distributed()

@@ -52,3 +52,29 @@ def test_two_process_mesh_psum():
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         # sum(0..7) reduced across the two-process mesh
         assert "RESULT 28.0" in out, f"worker {pid} output:\n{out}"
+
+    # the cross-process training epoch must equal the same epoch on a
+    # single-process 8-device mesh (this test process, via conftest)
+    import numpy as np
+
+    from tests._distributed_common import make_epoch_inputs, make_epoch_step
+    from flink_ml_tpu.parallel.mesh import default_mesh, replicate, shard_batch
+
+    combined, params0 = make_epoch_inputs()
+    mesh = default_mesh()
+    params = replicate(mesh, params0)
+    batch = shard_batch(
+        mesh, (combined[..., :-2], combined[..., -2], combined[..., -1])
+    )
+    epoch_step = make_epoch_step(mesh)
+    (w, b), (loss, _delta) = epoch_step(params, batch)
+    expected = [float(v) for v in np.asarray(w)] + [float(b), float(loss)]
+
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("TRAIN ")]
+        assert line, f"worker {pid} printed no TRAIN line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected, rtol=1e-6, atol=1e-9,
+            err_msg=f"worker {pid} diverged from single-process epoch",
+        )
