@@ -167,6 +167,24 @@ class CsrRows:
                 dim = max(dim, int(indices.max()) + 1)
         return CsrRows(dim, indptr, indices, values)
 
+    def to_dense(self, width: int = None) -> np.ndarray:
+        """Vectorized densify to a ``(rows, width)`` float64 matrix.
+
+        Matches the row-level semantics exactly: duplicate indices within a
+        row SUM (like SparseVector.to_dense / CsrBatch.to_dense) and
+        out-of-range indices — negative included — fail loudly.
+        """
+        width = self.dim if width is None else int(width)
+        if self.indices.size:
+            if int(self.indices.min()) < 0 or int(self.indices.max()) >= width:
+                raise ValueError(
+                    f"feature index out of range for width={width}"
+                )
+        out = np.zeros((len(self), width), dtype=np.float64)
+        row_ids = np.repeat(np.arange(len(self)), self.nnz_per_row())
+        np.add.at(out, (row_ids, self.indices), self.values)
+        return out
+
     def __repr__(self) -> str:
         return f"CsrRows(rows={len(self)}, dim={self.dim}, nnz={self.indices.size})"
 

@@ -196,6 +196,10 @@ class Table:
         typ = self._schema.type_of(col)
         values = self.col(col)
         if DataTypes.is_vector(typ):
+            if isinstance(values, CsrRows):
+                # vectorized densify (duplicate indices sum, out-of-range
+                # raises — same semantics as the per-row path)
+                return values.to_dense(dim)
             if isinstance(values, np.ndarray) and values.ndim == 2:
                 # matrix-backed column: already the device layout, zero-copy
                 if dim is not None and values.shape[1] != dim:

@@ -436,3 +436,27 @@ class TestCsrRowsColumn:
         rows, _ = self._rows(n=10, dim=30)
         with pytest.raises(ValueError, match="out of range"):
             pack_sparse_minibatches(rows, np.zeros(10), 1, 4, dim=3)
+
+    def test_features_dense_on_csr_column(self):
+        rows, vecs = self._rows(n=15, dim=30)
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR))
+        t = Table.from_columns(schema, {"features": rows})
+        dense = t.features_dense("features")
+        assert dense.shape == (15, 30)
+        for i, v in enumerate(vecs):
+            np.testing.assert_array_equal(dense[i], v.to_dense().values)
+        wider = t.features_dense("features", dim=40)
+        assert wider.shape == (15, 40)
+        np.testing.assert_array_equal(wider[:, :30], dense)
+        with pytest.raises(ValueError, match="out of range"):
+            t.features_dense("features", dim=5)
+
+    def test_csr_densify_sums_duplicates_and_rejects_negatives(self):
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        dup = CsrRows(10, [0, 3], [2, 2, 5], [1.0, 2.5, -1.0])
+        dense = dup.to_dense()
+        assert dense[0, 2] == 3.5 and dense[0, 5] == -1.0
+        neg = CsrRows(10, [0, 1], [-1], [1.0])
+        with pytest.raises(ValueError, match="out of range"):
+            neg.to_dense()
