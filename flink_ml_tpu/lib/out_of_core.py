@@ -37,6 +37,7 @@ from __future__ import annotations
 import contextlib
 import queue
 import threading
+from collections import deque
 from typing import Callable, Iterator, Optional, Tuple
 
 import jax
@@ -63,8 +64,9 @@ from flink_ml_tpu.utils.metrics import StepMetrics
 def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float,
                        param_spec=None):
     """One chunk — a ``lax.scan`` over its minibatch groups — as a single
-    compiled device call: ``chunk_fn(carry, batch) -> carry`` with
-    ``carry = (params, loss_sum, weight_sum)``.
+    compiled device call: ``chunk_fn(carry, batch) -> (carry, tick)`` with
+    ``carry = (params, loss_sum, weight_sum)`` and ``tick`` a scalar the
+    engine blocks on to bound the async pipeline.
 
     The minibatch math and SGD update are the exact objects the in-memory
     fused loop uses (``mb_grad_step``, :func:`make_sgd_update`), so a live
@@ -100,7 +102,11 @@ def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float
             ), None
 
         carry, _ = jax.lax.scan(mb_step, carry, batch)
-        return carry
+        # the tick: a scalar the engine can block on to bound the async
+        # pipeline.  optimization_barrier guarantees a distinct buffer —
+        # a folded alias of carry[2] would be deleted by the next call's
+        # donation, breaking the block_until_ready contract
+        return carry, jax.lax.optimization_barrier(carry[2])
 
     from jax.sharding import PartitionSpec as P
 
@@ -109,7 +115,7 @@ def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float
         local_chunk,
         mesh=mesh,
         in_specs=(carry_spec, P("data")),
-        out_specs=carry_spec,
+        out_specs=(carry_spec, P()),
         check_vma=True,
     )
     return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
@@ -221,13 +227,15 @@ def train_out_of_core(
     make_carry: Optional[Callable] = None,
     finalize: Optional[Callable] = None,
     place_params: Optional[Callable] = None,
+    max_inflight_chunks: int = 4,
 ) -> TrainResult:
     """The streaming epoch engine.
 
     ``blocks_factory()`` restarts the chunk stream for an epoch, yielding
     host ``(batch, n_real_rows)``; the prefetch thread places each block on
     the mesh (async DMA) while the device runs the previous one.
-    ``chunk_fn_factory()`` returns the compiled chunk program.  Convergence
+    ``chunk_fn_factory()`` returns the compiled chunk program
+    (``chunk_fn(carry, batch) -> (carry, tick)``).  Convergence
     (update-norm vs ``tol``) and checkpoint/resume semantics mirror the
     fused in-memory loop; with ``tol == 0`` and no checkpoint, the whole
     run syncs once at the end.
@@ -241,6 +249,16 @@ def train_out_of_core(
     the default replicated placement (feature-sharded weights live on the
     ``model`` axis); the default delta/loss math operates on global arrays,
     so it is sharding-agnostic.
+
+    ``max_inflight_chunks`` bounds the async pipeline depth: JAX dispatch
+    returns before transfer or compute finishes, so without a bound every
+    block of an epoch can pile up in flight (host staging + HBM for each).
+    The consumer blocks on a chunk-completion tick N chunks back before
+    dispatching chunk N, capping live-block residency at ~(prefetch depth +
+    max_inflight) while keeping the device busy.  (Note: on the tunneled
+    axon backend the client itself retains per-transfer buffers beyond
+    array lifetime — measured growth with ZERO live jax arrays, absent on
+    the CPU backend — so peak RSS there overstates what this engine holds.)
     """
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
@@ -296,9 +314,14 @@ def train_out_of_core(
             for batch, real in blocks_factory():
                 yield shard_batch(mesh, batch), real
 
+        inflight: deque = deque()
         for placed, real_rows in _prefetch(placed_blocks()):
-            carry = chunk_fn(carry, placed)
+            carry, tick = chunk_fn(carry, placed)
             n_rows += real_rows
+            inflight.append(tick)
+            if len(inflight) > max_inflight_chunks:
+                jax.block_until_ready(inflight.popleft())
+        inflight.clear()
         if finalize is not None:
             params, loss_sum, w_sum, last_delta_dev = finalize(
                 carry, epoch_start
@@ -476,7 +499,7 @@ def rows_blocks_factory(
 
 def make_kmeans_chunk_fn(key, k: int, mesh):
     """Lloyd accumulation over one row block as a compiled device call:
-    ``chunk_fn(carry, (x, w)) -> carry`` with ``carry = (centroids,
+    ``chunk_fn(carry, (x, w)) -> (carry, tick)`` with ``carry = (centroids,
     sums, counts, cost)``.  Assignments are against the epoch's centroids
     (held fixed in the carry); per-cluster sums/counts/cost ``psum`` over
     the data axis and accumulate across blocks; the per-epoch centroid
@@ -500,7 +523,8 @@ def make_kmeans_chunk_fn(key, k: int, mesh):
         counts = counts + psum(
             jax.ops.segment_sum(w, assign, num_segments=k), "data"
         )
-        return (c, sums, counts, cost)
+        # tick: distinct buffer by construction (see make_chunk_step_fn)
+        return (c, sums, counts, cost), jax.lax.optimization_barrier(cost)
 
     from jax.sharding import PartitionSpec as P
 
@@ -508,7 +532,7 @@ def make_kmeans_chunk_fn(key, k: int, mesh):
         local_chunk,
         mesh=mesh,
         in_specs=(P(), P("data")),
-        out_specs=P(),
+        out_specs=(P(), P()),
         check_vma=True,
     )
     return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
