@@ -148,3 +148,21 @@ class TestShardedReferenceSetKnn:
         with mesh_of(2):
             p2, _ = _transform_cols(model, q, "pred", "dist")
         np.testing.assert_array_equal(p8, p2)
+
+    def test_sharded_model_streams_inference(self):
+        """transform_chunks x shardModelData: chunked scoring against a
+        mesh-sharded reference set matches the whole-table transform."""
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        t = _table(300, 4, seed=12)
+        q = _table(90, 4, seed=13)
+        model = self._model(t, True)
+        with mesh_of(8):
+            whole = model.transform(q)[0]
+            chunked = ChunkedTable(
+                CollectionSource(q.to_rows(), q.schema), chunk_rows=40
+            )
+            streamed = Table.concat(list(model.transform_chunks(chunked)))
+        np.testing.assert_array_equal(
+            np.asarray(streamed.col("pred")), np.asarray(whole.col("pred"))
+        )
