@@ -18,7 +18,14 @@ import json
 import os
 from typing import List, Sequence, Tuple
 
-from flink_ml_tpu.api.core import AlgoOperator, Estimator, Model, Stage, load_stage
+from flink_ml_tpu.api.core import (
+    AlgoOperator,
+    Estimator,
+    Model,
+    Stage,
+    Transformer,
+    load_stage,
+)
 from flink_ml_tpu.table.table import Table
 
 _PIPELINE_FILE = "pipeline.json"
@@ -53,7 +60,25 @@ class Pipeline(Estimator):
                 )
             model_stages.append(model_stage)
             if i < last_estimator_idx:
-                last_inputs = model_stage.transform(*last_inputs)
+                if len(last_inputs) == 1 and getattr(
+                    last_inputs[0], "is_chunked", False
+                ):
+                    # out-of-core forwarding: wrap instead of materializing,
+                    # so each downstream epoch streams base chunks through
+                    # this stage's transform1 (host residency = one chunk)
+                    from flink_ml_tpu.table.sources import TransformedChunkedTable
+
+                    if not isinstance(model_stage, Transformer):
+                        raise TypeError(
+                            f"stage {i} ({type(model_stage).__name__}) cannot "
+                            "forward a chunked input: only Transformers (1-in/"
+                            "1-out) support streamed transform_chunks"
+                        )
+                    last_inputs = (
+                        TransformedChunkedTable(last_inputs[0], model_stage),
+                    )
+                else:
+                    last_inputs = model_stage.transform(*last_inputs)
         return PipelineModel(model_stages)
 
     # -- persistence ---------------------------------------------------------

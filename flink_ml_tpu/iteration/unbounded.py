@@ -28,7 +28,16 @@ Robustness (the two pieces the reference delegates to Flink's runtime):
   snapshots (model state, watermark, open window buffers, pending
   predictions, stream position) every N fired windows; a killed run resumed
   over the same (replayable) sources fast-forwards to the recorded position
-  and continues bit-identically.
+  and continues bit-identically.  The snapshot covers the *continuation*:
+  every model update, window firing, and prediction emitted after the
+  resume point is bit-identical to the uninterrupted run's.  Outputs
+  already **emitted** before the cut — served predictions and the
+  ``keep_model_history`` trail — are downstream-owned and are not replayed
+  (Flink sink semantics: a restored job does not re-emit records its sinks
+  already consumed), so a resumed ``StreamingResult`` lists only
+  post-resume emissions.  ``late_records`` is the one output carried in
+  the snapshot: the side output is reported exactly once, at stream end,
+  so pre-cut lates would otherwise vanish from the final report.
 
 Epoch accounting: window N's model update is epoch N; listeners receive epoch
 watermarks exactly as in the bounded runtime.
@@ -36,6 +45,7 @@ watermarks exactly as in the bounded runtime.
 
 from __future__ import annotations
 
+import bisect
 import heapq
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
@@ -164,12 +174,17 @@ class StreamingDriver:
                 batch_items = list(pending_predictions)
                 pending_predictions.clear()
             else:
-                batch_items = [p for p in pending_predictions if p[0] < before_ts]
-                if not batch_items:
+                # pending is kept event-time-sorted at insertion, so the
+                # cutoff is one bisect — a saturated buffer of
+                # past-watermark predictions costs O(log n) per record, not
+                # a rebuilt O(n) filter
+                cut = bisect.bisect_left(
+                    pending_predictions, before_ts, key=lambda p: p[0]
+                )
+                if cut == 0:
                     return
-                pending_predictions[:] = [
-                    p for p in pending_predictions if p[0] >= before_ts
-                ]
+                batch_items = pending_predictions[:cut]
+                del pending_predictions[:cut]
             batch = Table.from_rows(
                 [row for _, row in batch_items], prediction_source.schema()
             )
@@ -223,12 +238,31 @@ class StreamingDriver:
                 else:
                     open_windows.setdefault(end, []).append(tuple(row))
             else:
-                pending_predictions.append((ts, tuple(row)))
-                if len(pending_predictions) >= self.prediction_flush_rows:
-                    flush_predictions()
+                # kept ts-sorted so flush cutoffs are a bisect; arrival is
+                # near-ordered, so the insert lands at (or near) the tail
+                bisect.insort(
+                    pending_predictions, (ts, tuple(row)), key=lambda p: p[0]
+                )
             fire_ready()
             if stopped:
                 break
+            if len(pending_predictions) >= self.prediction_flush_rows:
+                # an early flush may only serve predictions whose model is
+                # final: a record at t must see every window with end <= t
+                # fired first.  After fire_ready() every window with
+                # end <= watermark HAS fired, and no window with
+                # end <= watermark can still open (later trains there would
+                # be late), so the watermark is exactly the safe horizon.
+                # Bounding by min(open_windows) instead would be wrong
+                # twice over: a window with an earlier end than any open one
+                # can still open while the watermark lags by the allowed
+                # lateness, and before fire_ready() an about-to-fire window
+                # would be skipped.  Pending predictions past the watermark
+                # stay buffered — bounded by the lateness horizon, not by
+                # prediction_flush_rows.
+                flush_predictions(
+                    before_ts=watermark + 1 if watermark is not None else None
+                )
             if (
                 checkpoint is not None
                 and epoch > 0
@@ -293,9 +327,10 @@ class StreamingDriver:
                     [ts, encode_row(r, pred_schema)]
                     for ts, r in pending_predictions
                 ],
-                # side output so far: carried so a resumed run's result
-                # equals the uninterrupted run's (lates are rare by
-                # definition — beyond the allowed disorder bound)
+                # the side output is reported exactly once (at stream end),
+                # so pre-cut lates must ride the snapshot; served
+                # predictions / model history are NOT carried — they were
+                # already emitted downstream (see module docstring)
                 "late": [
                     [ts, encode_row(r, train_schema)] for ts, r in late_records
                 ],

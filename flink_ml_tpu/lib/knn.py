@@ -62,6 +62,18 @@ def _knn_chunked(xq, xt, yt, k, chunk):
 
     Returns (labels (n, k), dists (n, k)).  xt/yt are padded to a multiple of
     ``chunk``; padded rows carry +inf distance so they never enter the top-k.
+
+    Tie-breaking is canonical by (distance, global row index), including for
+    exact distance ties, by induction over the scan: ``lax.top_k`` keeps the
+    lower-*position* element on ties, and every merge's concatenation is in
+    global-row-index order within tied groups — the carry holds the running
+    best lex-sorted by (d, idx) (top_k returns sorted output), and each new
+    chunk's rows appear in index order with indices larger than everything
+    already carried.  The sharded path's cross-shard merge preserves the same
+    invariant (shard order = row-block order), so replicated and sharded
+    selections match bit-for-bit even on tied data — asserted by the
+    duplicate-row tie test in tests/test_parallel_inference.py
+    (test_exact_distance_ties_match_across_paths).
     """
     n = xq.shape[0]
     n_chunks = xt.shape[0] // chunk
@@ -128,6 +140,10 @@ def _knn_apply_model_sharded(mesh, k, chunk, n_classes):
     def apply(xq, xt, yt):
         cand = sharded(xq, xt, yt)  # (n_dev, n, k, 2) per-shard candidates
         n = xq.shape[0]
+        # concat in mesh-device order = global row-block order, each shard's
+        # candidates lex-sorted by (d, idx): positional top_k tie-break
+        # therefore equals the canonical (d, global idx) selection the
+        # replicated scan makes (see _knn_chunked docstring)
         cat_y = jnp.transpose(cand[..., 0], (1, 0, 2)).reshape(n, -1)
         cat_d = jnp.transpose(cand[..., 1], (1, 0, 2)).reshape(n, -1)
         neg_top, pos = jax.lax.top_k(-cat_d, k)

@@ -300,6 +300,49 @@ class ChunkedTable:
         return f"ChunkedTable({type(self.source).__name__}, chunk_rows={self.chunk_rows})"
 
 
+class TransformedChunkedTable:
+    """A ChunkedTable viewed through a Transformer — the lazy forward edge of
+    a multi-stage out-of-core pipeline (``Pipeline.fit`` over chunked input).
+
+    Each ``chunks()`` iteration replays the base source and maps the stage's
+    ``transform1`` over every chunk, so host residency stays one chunk and
+    multi-epoch consumers (trainer drivers) see a re-iterable stream.  With
+    ``spill`` on, the *downstream trainer* spills post-transform packed
+    blocks, so later epochs skip both the parse and the transform.
+    """
+
+    is_chunked = True
+
+    def __init__(self, base, stage):
+        self.base = base
+        self.stage = stage
+        self.chunk_rows = base.chunk_rows
+        self.spill = getattr(base, "spill", False)
+        self._schema: Optional[Schema] = None
+
+    @property
+    def schema(self) -> Schema:
+        # the output schema is data-dependent (OutputColsHelper merge), so it
+        # is probed by transforming one chunk — once per fit, cached
+        if self._schema is None:
+            first = next(iter(self.chunks()), None)
+            if first is None:
+                raise ValueError("cannot infer schema of an empty chunked table")
+            self._schema = first.schema
+        return self._schema
+
+    def chunks(self) -> Iterator[Table]:
+        # one streamed-transform implementation: the stage's own
+        # transform_chunks (the streamed-inference path) is the per-chunk loop
+        return self.stage.transform_chunks(self.base)
+
+    def materialize(self) -> Table:
+        return self.stage.transform1(self.base.materialize())
+
+    def __repr__(self) -> str:
+        return f"TransformedChunkedTable({self.base!r} -> {type(self.stage).__name__})"
+
+
 class UnboundedSource:
     """A source of timestamped records, consumed by the streaming driver.
 

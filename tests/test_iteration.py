@@ -303,6 +303,32 @@ class TestStreamingRobustness:
         )
         assert res.windows_fired == 2 and res.late_records == []
 
+    def test_early_flush_waits_for_unfired_windows(self):
+        """r3 advisor (medium): a prediction-buffer flush must not serve
+        predictions past the watermark — a window with end <= their event
+        time may still fire (or even open) while the watermark lags by the
+        allowed lateness, and each record must see that window's post-update
+        model."""
+        train = GeneratorSource(
+            lambda: iter([(500, (0.5,)), (6500, (6.5,))]), self.SCHEMA
+        )
+        pred_times = [1500, 1600, 12000, 12100]
+        pred = GeneratorSource(
+            lambda: iter([(t, (float(t),)) for t in pred_times]), self.SCHEMA
+        )
+        res = iterate_unbounded(
+            0,
+            train,
+            lambda s, t, e: s + 1,  # state counts fired windows
+            window_ms=1000,
+            allowed_lateness_ms=5000,
+            prediction_source=pred,
+            predict=lambda s, b: [s] * b.num_rows(),
+            prediction_flush_rows=2,
+        )
+        # model at t: windows [0,1000) and [6000,7000) fire before t>=7000
+        assert dict(res.predictions) == {1500: 1, 1600: 1, 12000: 2, 12100: 2}
+
     def test_kill_resume_matches_uninterrupted(self, tmp_path):
         from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
 

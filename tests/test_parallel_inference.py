@@ -127,6 +127,32 @@ class TestShardedReferenceSetKnn:
             for s in shards:
                 assert s.data.shape[0] == total // 8  # 1/8 residency per device
 
+    def test_exact_distance_ties_match_across_paths(self):
+        """Duplicate reference rows (exact distance ties) spanning shards:
+        both paths select canonically by (distance, global row index) — the
+        copy labels are laid out so any non-canonical selection flips the
+        majority vote (copies 0-2 of each point vote 1, copies 3-7 vote 0;
+        canonical top-5 = copies 0-4 -> vote 1)."""
+        rng = np.random.RandomState(11)
+        distinct = rng.randn(64, 4) * 3
+        X = np.tile(distinct, (8, 1))  # copy i of point j at index i*64 + j
+        copy = np.repeat(np.arange(8), 64)
+        y = (copy < 3).astype(np.float64)
+        t = Table.from_columns(
+            SCHEMA, {"features": [DenseVector(r) for r in X], "label": y}
+        )
+        q = Table.from_columns(
+            SCHEMA,
+            {"features": [DenseVector(r) for r in distinct],
+             "label": np.zeros(len(distinct))},
+        )
+        with mesh_of(8):
+            ps, ds = _transform_cols(self._model(t, True), q, "pred", "dist")
+            pr, dr = _transform_cols(self._model(t, False), q, "pred", "dist")
+        np.testing.assert_array_equal(ps, pr)
+        np.testing.assert_array_equal(ds, dr)
+        np.testing.assert_array_equal(ps, np.ones(len(distinct)))
+
     def test_single_device_mesh_falls_back_to_replicated(self):
         t = _table(100, 4, seed=1)
         q = _table(20, 4, seed=2)
