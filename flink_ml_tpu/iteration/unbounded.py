@@ -175,9 +175,10 @@ class StreamingDriver:
                 pending_predictions.clear()
             else:
                 # pending is kept event-time-sorted at insertion, so the
-                # cutoff is one bisect — a saturated buffer of
-                # past-watermark predictions costs O(log n) per record, not
-                # a rebuilt O(n) filter
+                # cutoff is one bisect — a saturated buffer of past-watermark
+                # predictions costs O(log n) comparisons per record (O(n)
+                # shift only on out-of-order mid-list inserts), not a
+                # rebuilt O(n) filter
                 cut = bisect.bisect_left(
                     pending_predictions, before_ts, key=lambda p: p[0]
                 )

@@ -1,5 +1,6 @@
 from flink_ml_tpu.lib.classification import LogisticRegression, LogisticRegressionModel
 from flink_ml_tpu.lib.clustering import KMeans, KMeansModel
+from flink_ml_tpu.lib.feature import StandardScaler, StandardScalerModel
 from flink_ml_tpu.lib.knn import Knn, KnnModel
 from flink_ml_tpu.lib.online import OnlineLogisticRegression
 from flink_ml_tpu.lib.regression import LinearRegression, LinearRegressionModel
@@ -14,4 +15,6 @@ __all__ = [
     "Knn",
     "KnnModel",
     "OnlineLogisticRegression",
+    "StandardScaler",
+    "StandardScalerModel",
 ]

@@ -1,0 +1,218 @@
+"""Feature transformers — concrete Transformer stages for pipeline chains.
+
+This is the stage family the reference's shared colname vocabulary exists to
+serve: a Transformer chained AHEAD of an estimator, fed forward by
+``Pipeline.fit``'s transform branch (Pipeline.java:80-94), reading one column
+(HasSelectedCol.java:33-47) and merging its output into the input table by
+the OutputColsHelper rules (OutputColsHelper.java:32-52).
+
+``StandardScaler``: fit computes per-dimension mean/std of the selected
+vector column in one streamed device pass (a materialized Table or a
+ChunkedTable both work — the accumulator is (count, sum, sum-of-squares)
+per chunk, so fit is out-of-core capable); the fitted
+``StandardScalerModel`` normalizes batches on device, sharded over the
+mesh's data axis like every other ModelMapper hot path.
+
+The reference snapshot ships no concrete feature transformer, so the
+statistics semantics are stated here rather than cited: std is the corrected
+sample standard deviation (ddof=1; 0.0 when count < 2), and zero-variance
+dimensions pass through unscaled (divide by 1) instead of producing NaNs.
+Model data is one row — (means, stds, count) — following the
+model-as-table convention (Model.java:102-122).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.common.mapper import ModelMapper
+from flink_ml_tpu.lib.common import apply_sharded
+from flink_ml_tpu.lib.model_base import TableModelBase
+from flink_ml_tpu.params import param_info
+from flink_ml_tpu.params.params import ParamInfo, WithParams
+from flink_ml_tpu.params.shared import (
+    HasOutputColDefaultAsNull,
+    HasReservedCols,
+    HasSelectedCol,
+)
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+SCALER_MODEL_SCHEMA = Schema.of(
+    ("means", DataTypes.DENSE_VECTOR),
+    ("stds", DataTypes.DENSE_VECTOR),
+    ("count", DataTypes.DOUBLE),
+)
+
+
+class HasWithMean(WithParams):
+    WITH_MEAN: ParamInfo = param_info(
+        "withMean", "Whether to center the data to zero mean.",
+        default=True, value_type=bool,
+    )
+
+    def get_with_mean(self) -> bool:
+        return self.get(self.WITH_MEAN)
+
+    def set_with_mean(self, value: bool):
+        return self.set(self.WITH_MEAN, bool(value))
+
+
+class HasWithStd(WithParams):
+    WITH_STD: ParamInfo = param_info(
+        "withStd", "Whether to scale the data to unit standard deviation.",
+        default=True, value_type=bool,
+    )
+
+    def get_with_std(self) -> bool:
+        return self.get(self.WITH_STD)
+
+    def set_with_std(self, value: bool):
+        return self.set(self.WITH_STD, bool(value))
+
+
+class StandardScalerParams(
+    HasSelectedCol,
+    HasOutputColDefaultAsNull,
+    HasReservedCols,
+    HasWithMean,
+    HasWithStd,
+):
+    """Shared vocabulary for the scaler estimator and model."""
+
+    def resolved_output_col(self) -> str:
+        """outputCol defaults to overwriting selectedCol in place — the
+        OutputColsHelper collision rule then replaces it at its position."""
+        out = self.get_output_col()
+        return out if out is not None else self.get_selected_col()
+
+
+@jax.jit
+def _chunk_moments(x, pivot):
+    """One chunk's per-dimension shifted moments on device: sums of
+    ``(x - pivot)`` and ``(x - pivot)^2``.
+
+    The pivot (first data row) keeps the squares near the data's spread
+    instead of its magnitude — squaring raw values in f32 suffers
+    catastrophic cancellation for large-mean features (a timestamp-scale
+    column, mean ~1.7e9 / std ~1e4, came out 92x wrong in the unshifted
+    formulation).  The tiny (d,) partials accumulate across chunks in
+    float64 on the host, so a long chunk stream never loses precision to
+    f32 running sums either."""
+    xc = x - pivot
+    return jnp.sum(xc, axis=0), jnp.sum(xc * xc, axis=0)
+
+
+@lru_cache(maxsize=32)
+def _scale_apply(mesh):
+    """Mesh-sharded normalize: rows over 'data', statistics replicated."""
+    from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
+
+    def normalize(x, shift, inv_scale):
+        return (x - shift) * inv_scale
+
+    return make_data_parallel_apply(normalize, mesh, n_args=3)
+
+
+class StandardScalerModelMapper(ModelMapper):
+    def __init__(self, model: "StandardScalerModel", data_schema: Schema):
+        self._model_stage = model
+        super().__init__([SCALER_MODEL_SCHEMA], data_schema, model.get_params())
+
+    def reserved_cols(self) -> Optional[list]:
+        return self._model_stage.get_reserved_cols()
+
+    def output_cols(self) -> Tuple[list, list]:
+        return [self._model_stage.resolved_output_col()], [DataTypes.DENSE_VECTOR]
+
+    def load_model(self, *model_tables: Table) -> None:
+        (t,) = model_tables
+        model = self._model_stage
+        means = np.asarray(t.features_dense("means")[0], dtype=np.float32)
+        stds = np.asarray(t.features_dense("stds")[0], dtype=np.float32)
+        self._dim = means.shape[0]
+        # fold the withMean/withStd flags into (shift, 1/scale) once, so the
+        # device step is always one fused subtract-multiply
+        shift = means if model.get_with_mean() else np.zeros_like(means)
+        if model.get_with_std():
+            scale = np.where(stds > 0.0, stds, 1.0)
+        else:
+            scale = np.ones_like(stds)
+        self._shift = jnp.asarray(shift)
+        self._inv_scale = jnp.asarray(1.0 / scale)
+
+    def map_batch(self, batch: Table):
+        model = self._model_stage
+        X = batch.features_dense(model.get_selected_col(), dim=self._dim)
+        # apply_sharded already returns a host array sliced to the batch rows;
+        # matrix-backed vector column: stays one contiguous array end-to-end
+        out = apply_sharded(
+            _scale_apply, X.astype(np.float32), self._shift, self._inv_scale
+        )
+        return {model.resolved_output_col(): out}
+
+
+class StandardScalerModel(TableModelBase, StandardScalerParams):
+    """Normalizes the selected vector column with the fitted statistics."""
+
+    REQUIRED_MODEL_COL = "means"
+
+    def _make_mapper(self, data_schema: Schema) -> StandardScalerModelMapper:
+        return StandardScalerModelMapper(self, data_schema)
+
+
+class StandardScaler(Estimator, StandardScalerParams):
+    """Estimator: one streamed pass accumulating per-dimension moments."""
+
+    def fit(self, *inputs) -> StandardScalerModel:
+        (table,) = inputs
+        col = self.get_selected_col()
+        if getattr(table, "is_chunked", False):
+            chunks = table.chunks()
+        else:
+            chunks = (table,)
+
+        n = 0
+        s = ss = pivot = None
+        for chunk in chunks:
+            if chunk.num_rows() == 0:
+                continue
+            X = chunk.features_dense(col)
+            if pivot is None:
+                pivot = np.ascontiguousarray(X[0], dtype=np.float32)
+                s = np.zeros(X.shape[1], dtype=np.float64)
+                ss = np.zeros(X.shape[1], dtype=np.float64)
+            cs, css = _chunk_moments(
+                jnp.asarray(X, dtype=jnp.float32), jnp.asarray(pivot)
+            )
+            n += X.shape[0]
+            s += np.asarray(cs, dtype=np.float64)
+            ss += np.asarray(css, dtype=np.float64)
+        if s is None:
+            raise ValueError("cannot fit StandardScaler on an empty input")
+        means = pivot.astype(np.float64) + s / n
+        if n > 1:
+            # shifted-data variance formula; clamped because residual
+            # rounding can push an exactly-constant column slightly negative
+            var = np.maximum(ss - s * s / n, 0.0) / (n - 1)
+        else:
+            var = np.zeros_like(means)
+        stds = np.sqrt(var)
+
+        model = StandardScalerModel()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(Table.from_columns(
+            SCALER_MODEL_SCHEMA,
+            {
+                "means": means.reshape(1, -1),
+                "stds": stds.reshape(1, -1),
+                "count": np.asarray([float(n)]),
+            },
+        ))
+        return model

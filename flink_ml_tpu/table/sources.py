@@ -325,7 +325,11 @@ class TransformedChunkedTable:
         # the output schema is data-dependent (OutputColsHelper merge), so it
         # is probed by transforming one chunk — once per fit, cached
         if self._schema is None:
-            first = next(iter(self.chunks()), None)
+            chunks = self.chunks()
+            try:
+                first = next(iter(chunks), None)
+            finally:
+                chunks.close()  # release the base source's file handle now
             if first is None:
                 raise ValueError("cannot infer schema of an empty chunked table")
             self._schema = first.schema

@@ -1,0 +1,255 @@
+"""StandardScaler + true multi-stage Pipeline (VERDICT r3 item 3).
+
+The first concrete feature Transformer: these tests exercise the
+transform-forward branch of Pipeline.fit (Pipeline.java:80-94 parity,
+api/pipeline.py) with REAL stages — the colname vocabulary
+(HasSelectedCol.java:33-47) and OutputColsHelper merge rules
+(OutputColsHelper.java:32-52) finally serving a transformer chain ahead of
+an estimator.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api import Pipeline, PipelineModel, load_stage
+from flink_ml_tpu.lib import LogisticRegression, StandardScaler, StandardScalerModel
+from flink_ml_tpu.ops.vector import DenseVector
+from flink_ml_tpu.table import DataTypes, Schema, Table
+from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+SCHEMA = Schema.of(
+    ("id", "double"), ("features", DataTypes.DENSE_VECTOR), ("label", "double")
+)
+
+
+def _data(n=200, d=5, seed=0, scale=None):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d) * (scale if scale is not None else rng.rand(d) * 9 + 1)
+    X += rng.randn(d) * 3
+    y = (X @ rng.randn(d) > 0).astype(np.float64)
+    t = Table.from_columns(
+        SCHEMA,
+        {"id": np.arange(n, dtype=np.float64), "features": X.copy(), "label": y},
+    )
+    return t, X, y
+
+
+def _scaler(**flags):
+    s = StandardScaler().set_selected_col("features")
+    for k, v in flags.items():
+        getattr(s, f"set_{k}")(v)
+    return s
+
+
+class TestStandardScalerFit:
+    def test_statistics_match_numpy(self):
+        t, X, _ = _data()
+        model = _scaler().fit(t)
+        (mt,) = model.get_model_data()
+        np.testing.assert_allclose(
+            mt.features_dense("means")[0], X.mean(axis=0), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            mt.features_dense("stds")[0], X.std(axis=0, ddof=1), rtol=1e-4
+        )
+        assert float(mt.col("count")[0]) == len(X)
+
+    def test_chunked_fit_matches_materialized(self):
+        t, X, y = _data(n=137)
+        rows = [(float(i), DenseVector(r), float(lab))
+                for i, (r, lab) in enumerate(zip(X, y))]
+        chunked = ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=16)
+        (m_chunk,) = _scaler().fit(chunked).get_model_data()
+        (m_full,) = _scaler().fit(t).get_model_data()
+        # chunked partial sums round differently in f32: ulp-level agreement
+        np.testing.assert_allclose(
+            m_chunk.features_dense("means")[0],
+            m_full.features_dense("means")[0],
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            m_chunk.features_dense("stds")[0],
+            m_full.features_dense("stds")[0],
+            rtol=1e-5,
+        )
+
+    def test_large_mean_precision(self):
+        """Regression (r4 review): unshifted f32 sum-of-squares suffered
+        catastrophic cancellation — timestamp-scale features (mean ~1.7e9,
+        std ~1e4) fitted a std 92x too large.  The pivot-shifted moments
+        must stay accurate, chunked or not."""
+        rng = np.random.RandomState(42)
+        X = 1.7e9 + rng.randn(1000, 3) * np.array([9.9e3, 1.0e4, 5.0e3])
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR),)
+        t = Table.from_columns(schema, {"features": X})
+        (mt,) = _scaler().fit(t).get_model_data()
+        np.testing.assert_allclose(
+            mt.features_dense("stds")[0], X.std(axis=0, ddof=1), rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            mt.features_dense("means")[0], X.mean(axis=0), rtol=1e-6
+        )
+        rows = [(DenseVector(r),) for r in X]
+        chunked = ChunkedTable(CollectionSource(rows, schema), chunk_rows=128)
+        (mc,) = _scaler().fit(chunked).get_model_data()
+        np.testing.assert_allclose(
+            mc.features_dense("stds")[0], X.std(axis=0, ddof=1), rtol=1e-3
+        )
+
+    def test_empty_input_raises(self):
+        t, _, _ = _data()
+        with pytest.raises(ValueError, match="empty"):
+            _scaler().fit(t.slice_rows(0, 0))
+
+
+class TestStandardScalerTransform:
+    def test_normalizes_to_zero_mean_unit_std(self):
+        t, X, _ = _data()
+        (out,) = _scaler().fit(t).transform(t)
+        Z = out.features_dense("features")
+        np.testing.assert_allclose(Z.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(Z.std(axis=0, ddof=1), 1.0, rtol=1e-3)
+
+    def test_overwrites_selected_col_in_place_by_default(self):
+        t, _, _ = _data()
+        (out,) = _scaler().fit(t).transform(t)
+        # OutputColsHelper collision rule: same name, same position
+        assert out.schema.field_names == ["id", "features", "label"]
+        np.testing.assert_array_equal(out.col("id"), t.col("id"))
+        np.testing.assert_array_equal(out.col("label"), t.col("label"))
+
+    def test_output_col_appends(self):
+        t, X, _ = _data()
+        (out,) = _scaler().set_output_col("scaled").fit(t).transform(t)
+        assert out.schema.field_names == ["id", "features", "label", "scaled"]
+        np.testing.assert_array_equal(
+            out.features_dense("features"), t.features_dense("features")
+        )
+        Z = out.features_dense("scaled")
+        np.testing.assert_allclose(Z.mean(axis=0), 0.0, atol=1e-4)
+
+    def test_reserved_cols_prune(self):
+        t, _, _ = _data()
+        model = _scaler().set_output_col("scaled").set_reserved_cols(["label"]).fit(t)
+        (out,) = model.transform(t)
+        assert out.schema.field_names == ["label", "scaled"]
+
+    def test_with_mean_only(self):
+        t, X, _ = _data()
+        (out,) = _scaler(with_std=False).fit(t).transform(t)
+        Z = out.features_dense("features")
+        np.testing.assert_allclose(Z.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(Z.std(axis=0), X.std(axis=0), rtol=1e-3)
+
+    def test_with_std_only(self):
+        t, X, _ = _data()
+        (out,) = _scaler(with_mean=False).fit(t).transform(t)
+        Z = out.features_dense("features")
+        np.testing.assert_allclose(
+            Z.std(axis=0, ddof=1), 1.0, rtol=1e-3
+        )
+        assert np.abs(Z.mean(axis=0)).max() > 1e-2  # means preserved (off-center data)
+
+    def test_zero_variance_dim_passes_through(self):
+        t, X, y = _data()
+        Xc = X.copy()
+        Xc[:, 2] = 7.0
+        tc = Table.from_columns(
+            SCHEMA,
+            {"id": t.col("id"), "features": Xc, "label": y},
+        )
+        (out,) = _scaler().fit(tc).transform(tc)
+        Z = out.features_dense("features")
+        assert np.all(np.isfinite(Z))
+        np.testing.assert_allclose(Z[:, 2], 0.0, atol=1e-6)  # centered, unscaled
+
+    def test_model_save_load_round_trip(self, tmp_path):
+        t, _, _ = _data()
+        model = _scaler().fit(t)
+        model.save(str(tmp_path / "scaler"))
+        loaded = load_stage(str(tmp_path / "scaler"))
+        assert isinstance(loaded, StandardScalerModel)
+        (a,) = model.transform(t)
+        (b,) = loaded.transform(t)
+        np.testing.assert_array_equal(
+            a.features_dense("features"), b.features_dense("features")
+        )
+
+
+class TestScalerPipelineE2E:
+    """The VERDICT r3 'done' bar: Pipeline([scaler, lr]).fit exercises the
+    transform-forward branch with real tables; the loaded PipelineModel
+    reproduces predictions."""
+
+    def _pipeline(self):
+        lr = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_max_iter(10)
+        )
+        return Pipeline([_scaler(), lr])
+
+    def test_fit_forwards_scaled_features_to_estimator(self):
+        t, X, y = _data(n=400, seed=3, scale=np.array([1e3, 1e-3, 1.0, 50.0, 0.1]))
+        pm = self._pipeline().fit(t)
+        (out,) = pm.transform(t)
+        acc_scaled = float(np.mean(np.asarray(out.col("pred")) == y))
+        assert acc_scaled > 0.9
+        # the transform-forward branch fed the estimator SCALED features:
+        # manually scaling with the fitted stage-0 model and fitting a fresh
+        # identical LR reproduces the pipeline's predictions bit-for-bit
+        (scaled,) = pm.stages[0].transform(t)
+        lr2 = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_max_iter(10)
+        )
+        (manual,) = lr2.fit(scaled).transform(scaled)
+        np.testing.assert_array_equal(out.col("pred"), manual.col("pred"))
+
+    def test_save_load_reproduces_predictions(self, tmp_path):
+        t, _, y = _data(n=300, seed=5)
+        pm = self._pipeline().fit(t)
+        (orig,) = pm.transform(t)
+        pm.save(str(tmp_path / "pm"))
+        loaded = PipelineModel.load(str(tmp_path / "pm"))
+        (redo,) = loaded.transform(t)
+        np.testing.assert_array_equal(orig.col("pred"), redo.col("pred"))
+        assert float(np.mean(np.asarray(redo.col("pred")) == y)) > 0.9
+
+    def test_unfitted_pipeline_save_load_then_fit(self, tmp_path):
+        t, _, y = _data(n=300, seed=7)
+        p = self._pipeline()
+        p.save(str(tmp_path / "p"))
+        p2 = Pipeline.load(str(tmp_path / "p"))
+        pm = p2.fit(t)
+        (out,) = pm.transform(t)
+        assert float(np.mean(np.asarray(out.col("pred")) == y)) > 0.9
+
+    def test_chunked_multi_stage_pipeline_out_of_core(self):
+        """Scaler -> LR over a ChunkedTable: the TransformedChunkedTable
+        forward path feeds the estimator's out-of-core fit with scaled
+        chunks; result matches the fully-materialized pipeline."""
+        t, X, y = _data(n=256, seed=9)
+        rows = [(float(i), DenseVector(r), float(lab))
+                for i, (r, lab) in enumerate(zip(X, y))]
+        chunked = ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=32)
+
+        def make():
+            lr = (
+                LogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("pred")
+                .set_learning_rate(0.5).set_max_iter(5)
+                .set_global_batch_size(32)
+            )
+            return Pipeline([_scaler(), lr])
+
+        pm_ooc = make().fit(chunked)
+        pm_mem = make().fit(t)
+        (a,) = pm_ooc.transform(t)
+        (b,) = pm_mem.transform(t)
+        # the scaler's chunked moment accumulation rounds differently from
+        # the one-pass fit (f32 chunk partials), so scaled features differ
+        # in ulps; demand near-total prediction agreement, not bit equality
+        agree = float(np.mean(np.asarray(a.col("pred")) == np.asarray(b.col("pred"))))
+        assert agree >= 0.98, agree
