@@ -232,6 +232,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             layout_key,
             lambda: pack_minibatches(X, y, n_dev, self.get_global_batch_size()),
         )
+        if dict(mesh.shape).get("model", 1) > 1:
+            # wide-dense story: weight vector + feature columns shard over
+            # the 'model' axis (train_glm_dense_2d) instead of replicating
+            return self._fit_dense_2d(stack, mesh, layout_key, dim, table)
         # device residency cache: re-fits of the same table (sweeps, benches)
         # skip the host->device hop — the analog of the CPU path's data
         # already sitting in RAM.  Keyed by mesh: a different mesh is a
@@ -261,6 +265,43 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             reg=self.get_reg(),
             tol=self.get_tol(),
             checkpoint=checkpoint,
+            device_batch=device_batch,
+        )
+        return self._finish(result)
+
+    def _fit_dense_2d(self, stack, mesh, layout_key, dim, table) -> GlmModelBase:
+        """Dense feature-sharded (data x model) fit — VERDICT r3 item 5."""
+        if not self.LOSS_KIND:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no fused loss kind for the "
+                "feature-sharded dense path"
+            )
+        from flink_ml_tpu.lib.common import (
+            make_feature_shard_placer,
+            place_dense_2d_batch,
+            train_glm_dense_2d,
+        )
+
+        model_size = dict(mesh.shape)["model"]
+        _, _, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
+        # thunk: resolved lazily so a no-op checkpoint resume skips the hop
+        device_batch = lambda: table.cached_pack(  # noqa: E731
+            layout_key + ("dev2d", mesh),
+            lambda: place_dense_2d_batch(mesh, stack, dim_pad),
+        )
+        w0 = jnp.zeros((dim,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        result = train_glm_dense_2d(
+            (w0, b0),
+            stack,
+            self.LOSS_KIND,
+            mesh,
+            learning_rate=self.get_learning_rate(),
+            max_iter=self.get_max_iter(),
+            reg=self.get_reg(),
+            tol=self.get_tol(),
+            with_intercept=self.get_with_intercept(),
+            checkpoint=self._checkpoint_config(),
             device_batch=device_batch,
         )
         return self._finish(result)

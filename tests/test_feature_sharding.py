@@ -1,12 +1,15 @@
-"""Feature-dimension (model-axis) sharding: sparse training over a
-('data','model') mesh must match the 1-D data-parallel result exactly."""
+"""Feature-dimension (model-axis) sharding: sparse AND dense training over a
+('data','model') mesh must match the 1-D data-parallel result."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from flink_ml_tpu.lib.common import (
+    pack_minibatches,
     pack_sparse_minibatches,
+    train_glm,
+    train_glm_dense_2d,
     train_glm_sparse,
 )
 from flink_ml_tpu.ops.vector import SparseVector
@@ -58,6 +61,108 @@ class TestFeatureSharding:
         mesh2 = create_mesh({"data": 2, "model": 4})
         r = train(mesh2, 2, dim=25, vecs=vecs, ys=ys)
         assert r.params[0].shape == (25,)
+
+    def test_dense_2d_matches_1d(self):
+        """VERDICT r3 item 5: the dense feature-sharded fused path against
+        the replicated fused path at identical minibatch grouping.  The two
+        differ only in contraction grouping (per-shard partial matvecs +
+        psum vs one full-width matvec), so agreement is ulp-level f32, not
+        bitwise."""
+        from flink_ml_tpu.lib.classification import _log_loss_grads
+
+        rng = np.random.RandomState(3)
+        n, d = 256, 24
+        X = rng.randn(n, d)
+        ys = (X @ rng.randn(d) > 0).astype(np.float64)
+        stack = pack_minibatches(X, ys, 2, global_batch_size=64)
+        w0 = jnp.zeros((d,), jnp.float32)
+        b0 = jnp.zeros((), jnp.float32)
+
+        mesh2d = create_mesh({"data": 2, "model": 4})
+        r2 = train_glm_dense_2d(
+            (w0, b0), stack, "logistic", mesh2d,
+            learning_rate=0.5, max_iter=20,
+        )
+        mesh1d = create_mesh({"data": 2, "model": 1}, devices=jax.devices()[:2])
+        r1 = train_glm(
+            (w0, b0), stack, _log_loss_grads(True), mesh1d,
+            learning_rate=0.5, max_iter=20,
+        )
+        np.testing.assert_allclose(r2.params[0], r1.params[0], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r2.params[1], r1.params[1], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r2.losses, r1.losses, rtol=1e-5)
+        assert r2.epochs == r1.epochs == 20
+
+    def test_dense_2d_dim_padding(self):
+        rng = np.random.RandomState(4)
+        n, d = 128, 13  # not divisible by model=4 -> padded, trimmed back
+        X = rng.randn(n, d)
+        ys = (X @ rng.randn(d) > 0).astype(np.float64)
+        stack = pack_minibatches(X, ys, 2, global_batch_size=32)
+        r = train_glm_dense_2d(
+            (jnp.zeros((d,), jnp.float32), jnp.zeros((), jnp.float32)),
+            stack, "logistic", create_mesh({"data": 2, "model": 4}),
+            learning_rate=0.5, max_iter=10,
+        )
+        assert r.params[0].shape == (d,)
+        assert np.all(np.isfinite(r.params[0]))
+
+    def test_dense_2d_checkpoint_resume(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        rng = np.random.RandomState(5)
+        X = rng.randn(128, 16)
+        ys = (X @ rng.randn(16) > 0).astype(np.float64)
+        stack = pack_minibatches(X, ys, 2, global_batch_size=32)
+        mesh = create_mesh({"data": 2, "model": 4})
+        p0 = (jnp.zeros((16,), jnp.float32), jnp.zeros((), jnp.float32))
+
+        full = train_glm_dense_2d(
+            (jnp.copy(p0[0]), jnp.copy(p0[1])), stack, "logistic", mesh,
+            learning_rate=0.5, max_iter=12,
+        )
+        cfg = CheckpointConfig(directory=str(tmp_path / "ck"), every_n_epochs=5)
+        chunked = train_glm_dense_2d(
+            (jnp.copy(p0[0]), jnp.copy(p0[1])), stack, "logistic", mesh,
+            learning_rate=0.5, max_iter=12, checkpoint=cfg,
+        )
+        np.testing.assert_allclose(chunked.params[0], full.params[0],
+                                   rtol=1e-6, atol=1e-7)
+        assert chunked.epochs == full.epochs == 12
+
+    def test_estimator_routes_dense_2d(self):
+        """LogisticRegression.fit under a ('data','model') env mesh takes the
+        feature-sharded path and matches the replicated fit."""
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.table.schema import DataTypes, Schema
+        from flink_ml_tpu.table.table import Table
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        rng = np.random.RandomState(6)
+        X = rng.randn(300, 20)
+        ys = (X @ rng.randn(20) > 0).astype(np.float64)
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+        t = Table.from_columns(schema, {"features": X, "label": ys})
+
+        def fit(mesh):
+            env = MLEnvironmentFactory.get_default()
+            old = env.get_mesh()
+            env.set_mesh(mesh)
+            try:
+                model = (
+                    LogisticRegression().set_vector_col("features")
+                    .set_label_col("label").set_prediction_col("pred")
+                    .set_learning_rate(0.5).set_max_iter(15)
+                    .set_global_batch_size(64).fit(t)
+                )
+                (mt,) = model.get_model_data()
+                return np.asarray(mt.col("coefficients")[0].to_dense().values)
+            finally:
+                env.set_mesh(old)
+
+        w2d = fit(create_mesh({"data": 2, "model": 4}))
+        w1d = fit(create_mesh({"data": 2, "model": 1}, devices=jax.devices()[:2]))
+        np.testing.assert_allclose(w2d, w1d, rtol=1e-5, atol=1e-6)
 
     def test_squared_loss_2d(self):
         rng = np.random.RandomState(1)
