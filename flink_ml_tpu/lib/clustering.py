@@ -289,8 +289,15 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        from flink_ml_tpu.parallel.mesh import data_parallel_size, shard_batch
+        from flink_ml_tpu.parallel.mesh import (
+            data_parallel_size,
+            require_single_process,
+            shard_batch,
+        )
 
+        # k-means++ init samples from the local table, so per-process shards
+        # would seed divergent (silently wrong) replicated centroids
+        require_single_process("KMeans from per-process shards")
         n_dev = data_parallel_size(mesh)
 
         def build():
@@ -347,10 +354,14 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         is the whole dataset, matching the in-memory path.
         """
         from flink_ml_tpu.lib import out_of_core as oc
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.parallel.mesh import (
+            data_parallel_size,
+            require_single_process,
+        )
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
+        require_single_process("KMeans from per-process shards")
         n_dev = data_parallel_size(mesh)
         # on a 2-D mesh the centroids replicate over 'model' (like the
         # in-memory Lloyd path); rows shard over 'data' only

@@ -878,7 +878,12 @@ def train_glm_dense_2d(
     (SURVEY §5.7).  Numerics match the replicated path to ulp-level f32
     rounding: splitting the d-dim contraction into per-shard partials
     changes only the summation grouping, not the update schedule."""
-    model_size = dict(mesh.shape)["model"]
+    model_size = dict(mesh.shape).get("model", 1)
+    if model_size < 2:
+        raise ValueError(
+            "train_glm_dense_2d needs a mesh with a >1 'model' axis; use "
+            "train_glm (replicated params) on a data-only mesh"
+        )
     dim = stack.x.shape[2]
     place, trim, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
     batch = (stack.x, stack.y, stack.w)

@@ -214,23 +214,30 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         y = self._labels(table)
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        # rows shard over the data axis only; other mesh axes replicate
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        # rows shard over the data axis only; other mesh axes replicate.
+        # Multi-process, `table` is this process's file shard: packing
+        # targets the LOCAL share of the data axis and batch size, and
+        # shard_batch assembles the global batch from per-process slices.
+        from flink_ml_tpu.parallel.mesh import (
+            local_batch_share,
+            local_data_parallel_size,
+        )
 
-        n_dev = data_parallel_size(mesh)
+        n_dev = local_data_parallel_size(mesh)
+        batch_share = local_batch_share(self.get_global_batch_size())
 
         vector_col = self.get_vector_col()
         if (vector_col is None) == (self.get_feature_cols() is None):
             raise ValueError("set exactly one of vectorCol / featureCols")
         if vector_col is not None and _col_is_sparse(table, vector_col):
-            return self._fit_sparse(table, y, mesh, n_dev)
+            return self._fit_sparse(table, y, mesh, n_dev, batch_share)
 
         X, dim = resolve_features(table, self)
         layout_key = ("dense", vector_col, tuple(self.get_feature_cols() or ()),
-                      self.get_label_col(), n_dev, self.get_global_batch_size())
+                      self.get_label_col(), n_dev, batch_share)
         stack = table.cached_pack(
             layout_key,
-            lambda: pack_minibatches(X, y, n_dev, self.get_global_batch_size()),
+            lambda: pack_minibatches(X, y, n_dev, batch_share),
         )
         if dict(mesh.shape).get("model", 1) > 1:
             # wide-dense story: weight vector + feature columns shard over
@@ -281,7 +288,11 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             place_dense_2d_batch,
             train_glm_dense_2d,
         )
+        from flink_ml_tpu.parallel.mesh import require_single_process
 
+        # per-process assembly of a ('data', -, 'model')-sharded batch is
+        # not wired up yet (feature columns span processes)
+        require_single_process("dense feature-sharded (2-D) training")
         model_size = dict(mesh.shape)["model"]
         _, _, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
         # thunk: resolved lazily so a no-op checkpoint resume skips the hop
@@ -306,20 +317,27 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         )
         return self._finish(result)
 
-    def _fit_sparse(self, table: Table, y, mesh, n_dev: int) -> GlmModelBase:
+    def _fit_sparse(
+        self, table: Table, y, mesh, n_dev: int, batch_share: int
+    ) -> GlmModelBase:
         """Sparse-feature training: segment-CSR minibatches, fused device loop."""
         if not self.LOSS_KIND:
             raise NotImplementedError(
                 f"{type(self).__name__} has no sparse loss kind"
             )
+        from flink_ml_tpu.parallel.mesh import require_single_process
+
+        # the packed nnz_pad is data-dependent, so per-process shards would
+        # compile mismatched block shapes across processes
+        require_single_process("sparse training from per-process shards")
         num_features = self.get_num_features()
         layout_key = ("sparse", self.get_vector_col(), self.get_label_col(),
-                      n_dev, self.get_global_batch_size(), num_features)
+                      n_dev, batch_share, num_features)
         sstack = table.cached_pack(
             layout_key,
             lambda: pack_sparse_minibatches(
                 table.col(self.get_vector_col()), y, n_dev,
-                self.get_global_batch_size(), dim=num_features,
+                batch_share, dim=num_features,
             ),
         )
         from flink_ml_tpu.parallel.mesh import shard_batch
@@ -356,12 +374,20 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         (full-batch SGD needs the entire dataset resident by definition).
         """
         from flink_ml_tpu.lib import out_of_core as oc
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.parallel.mesh import (
+            data_parallel_size,
+            local_data_parallel_size,
+            require_single_process,
+        )
         from flink_ml_tpu.table.schema import DataTypes
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
+        # mb (per-device rows) comes from the GLOBAL axis; block packing
+        # targets this process's LOCAL share (multi-process, each process
+        # streams its own file shard into the global block queue)
         n_dev = data_parallel_size(mesh)
+        n_dev_pack = local_data_parallel_size(mesh)
         model_size = data_parallel_size(mesh, "model")
         gbs = self.get_global_batch_size()
         if gbs is None or gbs <= 0:
@@ -370,8 +396,8 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 "(full batch would need the whole dataset resident)"
             )
         mb = max(1, -(-gbs // n_dev))
-        G = mb * n_dev
-        steps_per_chunk = max(1, table.chunk_rows // G)
+        G_local = mb * n_dev_pack
+        steps_per_chunk = max(1, table.chunk_rows // G_local)
         label = self.get_label_col()
         vector_col = self.get_vector_col()
         if (vector_col is None) == (self.get_feature_cols() is None):
@@ -389,6 +415,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 raise NotImplementedError(
                     f"{type(self).__name__} has no sparse loss kind"
                 )
+            # the estimated nnz_pad is data-dependent: per-process shards
+            # would compile mismatched block shapes across processes
+            require_single_process("sparse out-of-core training from "
+                                   "per-process shards")
             dim = self.get_num_features()
             if dim is None:
                 raise ValueError(
@@ -465,7 +495,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 )
 
             blocks = oc.dense_blocks_factory(
-                table, extract, n_dev, mb, steps_per_chunk
+                table, extract, n_dev_pack, mb, steps_per_chunk
             )
             grad_fn = self._grad_fn()
 

@@ -219,10 +219,14 @@ class KnnModelMapper(ModelMapper):
         self._classes = np.unique(y)
         y_ids = np.searchsorted(self._classes, y)
 
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.parallel.mesh import (
+            data_parallel_size,
+            require_single_process,
+        )
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
         mesh = MLEnvironmentFactory.get_default().get_mesh()
+        require_single_process("Knn model placement")
         n_dev = data_parallel_size(mesh)
         self._sharded = (
             bool(self._model_stage.get_shard_model_data()) and n_dev > 1

@@ -47,15 +47,90 @@ def data_parallel_size(mesh: Mesh, axis: str = "data") -> int:
     return dict(mesh.shape).get(axis, 1)
 
 
+def local_data_parallel_size(mesh: Mesh, axis: str = "data") -> int:
+    """This PROCESS's share of the data axis — the row-shard count a local
+    packing must target.
+
+    Single-process this equals :func:`data_parallel_size`.  Multi-process
+    (``jax.distributed``), each process packs only its own rows for its own
+    devices (the per-process file-shard contract, see :func:`shard_batch`),
+    so layout functions must divide the axis across processes.  The data
+    axis must be process-aligned (every process contributes whole data-axis
+    positions — the default mesh over ``jax.devices()`` is).
+    """
+    n = data_parallel_size(mesh, axis)
+    p = jax.process_count()
+    if p == 1:
+        return n
+    if n % p != 0:
+        raise ValueError(
+            f"data axis size {n} not divisible by process count {p}"
+        )
+    return n // p
+
+
+def local_batch_share(global_batch_size):
+    """This process's slice of a global SGD batch size.
+
+    Packing is per-process multi-host (each process packs its own rows for
+    its own devices), so layout code pairs this with
+    :func:`local_data_parallel_size` — the per-device minibatch
+    ``ceil(share / local_shards)`` then equals the single-process
+    ``ceil(global / global_shards)``.  Passes 0/None (full batch) through.
+    """
+    if not global_batch_size or global_batch_size <= 0:
+        return global_batch_size
+    p = jax.process_count()
+    if p == 1:
+        return global_batch_size
+    if global_batch_size % p != 0:
+        raise ValueError(
+            f"globalBatchSize {global_batch_size} not divisible by "
+            f"process count {p}"
+        )
+    return global_batch_size // p
+
+
+def require_single_process(what: str) -> None:
+    """Loud guard for paths whose multi-process semantics are not yet
+    defined (data-dependent per-process layout or init would silently
+    diverge across processes)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{what} is not yet supported in multi-process runs"
+        )
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     """Place a host batch pytree on the mesh, sharded along ``axis`` on dim 0.
 
-    The device-side analog of Flink distributing row partitions to subtasks.
-    Leading dimensions must divide the axis size (pad at the data-plane level).
+    The device-side analog of Flink distributing row partitions to subtasks
+    (``env.readCsvFile`` producing a partitioned DataSet,
+    LinearRegression.java:91-102).  Leading dimensions must divide the axis
+    size (pad at the data-plane level).
+
+    **Multi-process contract** (``jax.process_count() > 1``): ``batch`` is
+    this process's LOCAL rows — each process reads its own file shards and
+    contributes its slice of the global batch
+    (``jax.make_array_from_process_local_data``); the global leading dim is
+    ``local_rows * process_count`` in process order.  Every process must
+    contribute identically-shaped local blocks (equal row shards; pack with
+    :func:`local_data_parallel_size` shards and the per-process slice of the
+    global batch size).  Single-process behavior is unchanged.
     """
+    n_proc = jax.process_count()
+
     def _put(x):
         ndim = getattr(x, "ndim", 0)
         spec = P(axis) if ndim >= 1 else P()
+        if n_proc > 1:
+            x = np.asarray(x)
+            global_shape = (
+                (x.shape[0] * n_proc,) + x.shape[1:] if ndim >= 1 else x.shape
+            )
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), x, global_shape=global_shape
+            )
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(_put, batch)
@@ -63,10 +138,21 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
 
 def replicate(mesh: Mesh, pytree):
     """Replicate a pytree to every device — the broadcast-variable analog
-    (BroadcastVariableModelSource.java:44-46 -> one all-devices placement)."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P())), pytree
-    )
+    (BroadcastVariableModelSource.java:44-46 -> one all-devices placement).
+    Multi-process, every process must pass the same values (the model is
+    deterministically derived or broadcast out-of-band, exactly the
+    broadcast-variable contract)."""
+    n_proc = jax.process_count()
+
+    def _put(x):
+        if n_proc > 1:
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P()), x, global_shape=x.shape
+            )
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(_put, pytree)
 
 
 def initialize_distributed(

@@ -24,13 +24,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_psum():
+def test_two_process_mesh_psum(tmp_path):
+    # per-process file shards for the data-plane fit (VERDICT r3 item 2)
+    from tests._distributed_common import make_shard_rows, write_shard_csv
+
+    shards = make_shard_rows(2)
+    for pid, (Xs, ys) in enumerate(shards):
+        write_shard_csv(str(tmp_path / f"shard{pid}.csv"), Xs, ys)
+
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(pid), "2", str(port)],
+            [sys.executable, str(WORKER), str(pid), "2", str(port),
+             str(tmp_path)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -78,3 +86,34 @@ def test_two_process_mesh_psum():
             got, expected, rtol=1e-6, atol=1e-9,
             err_msg=f"worker {pid} diverged from single-process epoch",
         )
+
+    # -- per-process file-shard fits (the real data plane) --------------------
+    # single-process reference: the SAME estimator fit over the interleaved
+    # row order (global step s = each process's s-th G/P-row window)
+    from tests._distributed_common import (
+        fit_shard_table,
+        interleaved_rows,
+        shard_schema,
+    )
+    from flink_ml_tpu.table.table import Table
+
+    Xi, yi = interleaved_rows(shards, 2)
+    ref_table = Table.from_columns(
+        shard_schema(),
+        {**{f"f{i}": Xi[:, i] for i in range(Xi.shape[1])}, "label": yi},
+    )
+    w_ref, b_ref = fit_shard_table(ref_table)
+    expected_fit = list(w_ref) + [b_ref]
+
+    for tag in ("FITMEM", "FITOOC"):
+        for pid, out in enumerate(outs):
+            line = [ln for ln in out.splitlines() if ln.startswith(tag + " ")]
+            assert line, f"worker {pid} printed no {tag} line:\n{out}"
+            got = [float(v) for v in line[0].split()[1:]]
+            np.testing.assert_allclose(
+                got, expected_fit, rtol=1e-6, atol=1e-8,
+                err_msg=(
+                    f"worker {pid} {tag}: per-process file-shard fit diverged "
+                    "from the single-process interleaved-order fit"
+                ),
+            )
