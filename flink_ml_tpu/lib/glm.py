@@ -232,6 +232,13 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         if vector_col is not None and _col_is_sparse(table, vector_col):
             return self._fit_sparse(table, y, mesh, n_dev, batch_share)
 
+        model_sharded = dict(mesh.shape).get("model", 1) > 1
+        if model_sharded:
+            # guard BEFORE the full-dataset pack below: per-process assembly
+            # of a ('data', -, 'model')-sharded batch is not wired up yet
+            from flink_ml_tpu.parallel.mesh import require_single_process
+
+            require_single_process("dense feature-sharded (2-D) training")
         X, dim = resolve_features(table, self)
         layout_key = ("dense", vector_col, tuple(self.get_feature_cols() or ()),
                       self.get_label_col(), n_dev, batch_share)
@@ -239,7 +246,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             layout_key,
             lambda: pack_minibatches(X, y, n_dev, batch_share),
         )
-        if dict(mesh.shape).get("model", 1) > 1:
+        if model_sharded:
             # wide-dense story: weight vector + feature columns shard over
             # the 'model' axis (train_glm_dense_2d) instead of replicating
             return self._fit_dense_2d(stack, mesh, layout_key, dim, table)
@@ -288,11 +295,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             place_dense_2d_batch,
             train_glm_dense_2d,
         )
-        from flink_ml_tpu.parallel.mesh import require_single_process
-
-        # per-process assembly of a ('data', -, 'model')-sharded batch is
-        # not wired up yet (feature columns span processes)
-        require_single_process("dense feature-sharded (2-D) training")
         model_size = dict(mesh.shape)["model"]
         _, _, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
         # thunk: resolved lazily so a no-op checkpoint resume skips the hop
