@@ -373,6 +373,7 @@ def make_glm_epoch_step(
     ``loss`` is the epoch's mean training loss and ``delta`` the L2 norm of
     the epoch's total parameter update (the convergence criterion).
     """
+    check_vma = getattr(grad_fn, "shard_map_check_vma", True)
     key = (grad_fn, mesh, float(learning_rate), float(reg))
     cached = _cache_get(key)
     if cached is not None:
@@ -408,7 +409,9 @@ def make_glm_epoch_step(
         )
         return params, (loss, delta)
 
-    return _cache_put(key, make_data_parallel_step(local_epoch, mesh))
+    return _cache_put(
+        key, make_data_parallel_step(local_epoch, mesh, check_vma=check_vma)
+    )
 
 
 @dataclass
@@ -435,7 +438,7 @@ def _combined_view(stack: MinibatchStack) -> np.ndarray:
 
 def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
                           max_iter, tol, in_specs=None, out_specs=None,
-                          delta_fn=None, epoch_fn=None):
+                          delta_fn=None, epoch_fn=None, check_vma=True):
     """The WHOLE training run as one compiled device program.
 
     Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
@@ -527,7 +530,9 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
         out_specs=(
             out_specs if out_specs is not None else (P(), P(), P(), P())
         ),
-        check_vma=True,
+        # relaxed only for grad fns that declare it (interpret-mode pallas,
+        # see make_pallas_grad_fn) — every other path stays strict
+        check_vma=check_vma,
     )
     return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
 
@@ -602,14 +607,16 @@ def make_glm_train_fn(
 ):
     """Fused training over the dense combined layout
     (see :func:`_build_fused_train_fn` for the program structure)."""
+    check_vma = getattr(grad_fn, "shard_map_check_vma", True)
     key = ("train", grad_fn, mesh, float(learning_rate), float(reg),
-           int(max_iter), float(tol))
+           int(max_iter), float(tol), check_vma)
 
     def mb_grad_step(p, mb):
         return grad_fn(p, mb[..., :-2], mb[..., -2], mb[..., -1])
 
     return _build_fused_train_fn(
-        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol,
+        check_vma=check_vma,
     )
 
 

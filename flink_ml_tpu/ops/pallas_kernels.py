@@ -73,8 +73,16 @@ except Exception:  # pragma: no cover
 
 
 def use_pallas() -> bool:
-    """Real Pallas lowering only on TPU backends (interpret elsewhere)."""
-    return _HAS_PLTPU and jax.default_backend() == "tpu"
+    """Real Pallas lowering only on TPU devices (interpret elsewhere).
+
+    Gated on the device PLATFORM, not the backend name: tunneled backends
+    (axon) report a non-"tpu" backend name for real TPU chips, which
+    silently routed the kernel to interpret mode there.
+    """
+    if not _HAS_PLTPU:
+        return False
+    devices = jax.devices()
+    return bool(devices) and devices[0].platform == "tpu"
 
 
 def _round_up(n: int, m: int) -> int:
@@ -197,7 +205,6 @@ def glm_grad(x, y, w, wts, b, kind: str = "logistic",
     return gw[:d, 0], stats[0, 0], stats[0, 1], stats[0, 2]
 
 
-@functools.lru_cache(maxsize=None)
 def make_pallas_grad_fn(kind: str, with_intercept: bool, tile_rows: int = 512):
     """A drop-in GradFn (lib/common.py contract) backed by :func:`glm_grad`.
 
@@ -205,19 +212,36 @@ def make_pallas_grad_fn(kind: str, with_intercept: bool, tile_rows: int = 512):
     ((g_w, g_b), loss_sum, w_sum).  Off-TPU the kernel runs interpreted —
     numerically identical, just slower — so tests cover one code path.
 
-    Memoized on the hyper-flags (like the jnp grad factories): downstream
-    compiled-step caches key on grad-fn identity, so a fresh closure per call
-    would force a recompile of the whole fused training program every fit.
+    Memoized on the hyper-flags AND the current backend's pallas capability
+    (like the jnp grad factories): downstream compiled-step caches key on
+    grad-fn identity, so a fresh closure per call would force a recompile of
+    the whole fused training program every fit — and keying on
+    ``use_pallas()`` keeps ``interpret`` and ``shard_map_check_vma``
+    consistent with each other even if the process's backend changes
+    between factory calls.
     """
+    return _make_pallas_grad_fn(kind, with_intercept, tile_rows, use_pallas())
+
+
+@functools.lru_cache(maxsize=None)
+def _make_pallas_grad_fn(kind: str, with_intercept: bool, tile_rows: int,
+                         on_tpu: bool):
     keep_b = 1.0 if with_intercept else 0.0
 
     def grad_fn(params, x, y, w):
         wts, b = params
         g_w, g_b, loss_sum, w_sum = glm_grad(
             x, y, w, wts, b, kind=kind, tile_rows=tile_rows,
-            interpret=not use_pallas(),  # at trace time: current backend
+            interpret=not on_tpu,
         )
         return (g_w.astype(wts.dtype), (g_b * keep_b).astype(jnp.float32)), \
             loss_sum, w_sum
 
+    # interpret-mode pallas_call internally mixes data-varying and unvarying
+    # operands in a dynamic_slice, which strict-vma shard_map rejects
+    # (JAX-internal limit; real Mosaic lowering passes strict).  Training
+    # builders (fused + epoch-step) read this to relax check_vma ONLY for
+    # the interpreted path, so the CPU CI suite exercises the kernel
+    # through the full harness.
+    grad_fn.shard_map_check_vma = on_tpu
     return grad_fn
