@@ -22,7 +22,7 @@ rows applied in padded power-of-two buckets to bound jit recompiles.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -790,6 +790,316 @@ def _feature_sharded_delta(params, start):
     return jnp.sqrt(
         jax.lax.psum(jnp.sum((params[0] - start[0]) ** 2), "model")
         + (params[1] - start[1]) ** 2
+    )
+
+
+@dataclass
+class HotColdStack:
+    """Hot/cold split of a :class:`SparseMinibatchStack` (VERDICT r3 item 1).
+
+    The v5e has no SparseCore: random gathers/scatters run at ~100M
+    accesses/s (~10 cycles each), which caps the all-segment-CSR path at
+    <1M rows/s on the Criteo shape while a CPU keeps the ~200KB hot set in
+    L2.  The escape is to make the hot traffic STREAM instead of hop: the
+    ``hot_k`` most frequent features become a dense per-minibatch slab
+    ``(mb, hot_k)`` in bf16 — built once on device — and the forward/
+    backward over them are two MXU GEMMs reading the slab at HBM stream
+    bandwidth; only the cold tail (a few nnz/row) still pays random access.
+    Measured on v5e: 1.75x the segment-CSR step, 1.3x the strengthened CSR
+    CPU baseline at the bench shape.
+
+    Features are permuted so hot ids occupy [0, hot_k) (slab position =
+    feature id) and cold ids [hot_k, dim); ``perm``/``inv_perm`` map
+    original->permuted and back — training runs in permuted space, the
+    returned coefficients are unpermuted.
+
+    Numerics: the slab and the two GEMM operands are bf16 with f32
+    accumulation (exact for 0/1-valued hashed features, ~2^-8 relative
+    rounding otherwise); everything else stays f32.  ``slab_dtype``
+    exists for equivalence tests (f32 slab).
+    """
+
+    hot_ints: np.ndarray   # (n_groups, 2, hot_pad) int32 [slab pos, row id]
+    hot_vals: np.ndarray   # (n_groups, hot_pad) f32; pad rows carry rid=mb
+    cold: SparseMinibatchStack  # permuted cold entries + [y | w] tail
+    perm: np.ndarray       # original feature id -> permuted id
+    inv_perm: np.ndarray   # permuted id -> original feature id
+    hot_k: int
+    slab_dtype: Any = jnp.bfloat16
+
+    @property
+    def mb(self) -> int:
+        return self.cold.mb
+
+    @property
+    def dim(self) -> int:
+        return self.cold.dim
+
+    @property
+    def n_rows(self) -> int:
+        return self.cold.n_rows
+
+
+def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
+                   pad_multiple: int = 512,
+                   slab_dtype=jnp.bfloat16) -> HotColdStack:
+    """Frequency analysis + feature permutation + per-group entry split.
+
+    The ``hot_k`` features with the most stored entries (ties broken by
+    lower id) map to slab positions [0, hot_k); everything else keeps
+    segment-CSR form with ids remapped into [hot_k, dim)."""
+    ints, floats = sstack.ints, sstack.floats
+    mb, nnz_pad, dim = sstack.mb, sstack.nnz_pad, sstack.dim
+    n_groups = ints.shape[0]
+    hot_k = int(min(max(hot_k, 1), dim))
+
+    idx = ints[:, 0, :]
+    rid = ints[:, 1, :]
+    valid = rid < mb
+    counts = np.bincount(idx[valid].ravel(), minlength=dim)
+    order = np.lexsort((np.arange(dim), -counts))  # by count desc, id asc
+    hot_ids = np.sort(order[:hot_k])
+    perm = np.empty(dim, dtype=np.int32)
+    perm[hot_ids] = np.arange(hot_k, dtype=np.int32)
+    cold_mask_ids = np.ones(dim, dtype=bool)
+    cold_mask_ids[hot_ids] = False
+    cold_ids = np.nonzero(cold_mask_ids)[0]
+    perm[cold_ids] = hot_k + np.arange(cold_ids.size, dtype=np.int32)
+    inv_perm = np.empty(dim, dtype=np.int32)
+    inv_perm[perm] = np.arange(dim, dtype=np.int32)
+
+    new_idx = np.where(valid, perm[idx], 0)
+    is_hot = valid & (new_idx < hot_k)
+    is_cold = valid & ~(new_idx < hot_k)
+    hot_counts = is_hot.sum(axis=1)
+    cold_counts = is_cold.sum(axis=1)
+    hot_pad = max(-(-int(hot_counts.max(initial=1)) // pad_multiple)
+                  * pad_multiple, pad_multiple)
+    cold_pad = max(-(-int(cold_counts.max(initial=1)) // pad_multiple)
+                   * pad_multiple, pad_multiple)
+
+    hot_ints = np.zeros((n_groups, 2, hot_pad), dtype=np.int32)
+    hot_ints[:, 1, :] = mb  # pad row id -> dropped row
+    hot_vals = np.zeros((n_groups, hot_pad), dtype=np.float32)
+    cold_ints = np.zeros((n_groups, 2, cold_pad), dtype=np.int32)
+    cold_ints[:, 1, :] = mb
+    cold_floats = np.zeros((n_groups, cold_pad + 2 * mb), dtype=np.float32)
+    vals = floats[:, :nnz_pad]
+    for g in range(n_groups):
+        h = is_hot[g]
+        c = is_cold[g]
+        nh, nc = int(hot_counts[g]), int(cold_counts[g])
+        hot_ints[g, 0, :nh] = new_idx[g, h]
+        hot_ints[g, 1, :nh] = rid[g, h]
+        hot_vals[g, :nh] = vals[g, h]
+        cold_ints[g, 0, :nc] = new_idx[g, c]
+        cold_ints[g, 1, :nc] = rid[g, c]
+        cold_floats[g, :nc] = vals[g, c]
+        cold_floats[g, cold_pad:] = floats[g, nnz_pad:]  # [y | w] tail
+
+    cold = SparseMinibatchStack(
+        ints=cold_ints, floats=cold_floats, steps=sstack.steps, mb=mb,
+        nnz_pad=cold_pad, dim=dim, n_rows=sstack.n_rows,
+    )
+    return HotColdStack(
+        hot_ints=hot_ints, hot_vals=hot_vals, cold=cold, perm=perm,
+        inv_perm=inv_perm, hot_k=hot_k, slab_dtype=slab_dtype,
+    )
+
+
+def densify_hot_slabs(mesh, hstack: HotColdStack):
+    """Build the per-minibatch hot slabs ON DEVICE, sharded over 'data'.
+
+    The host ships only the compact hot entry arrays (~entries x 12B); the
+    10s-of-GB slab materializes device-side via one sequential scatter pass
+    (zeros + at[].add per group), so the tunneled host->device hop stays
+    the size of the sparse data, not the slab."""
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    mb, hot_k, dtype = hstack.mb, hstack.hot_k, hstack.slab_dtype
+
+    def local(hot_ints, hot_vals):
+        def one(args):
+            ig, vg = args
+            pos, rid = ig[0], ig[1]
+            slab = jnp.zeros((mb + 1, hot_k), dtype)  # row mb = pad sink
+            return slab.at[rid, pos].add(vg.astype(dtype))[:mb]
+
+        return jax.lax.map(one, (hot_ints, hot_vals))
+
+    hot_ints_d, hot_vals_d = shard_batch(
+        mesh, (hstack.hot_ints, hstack.hot_vals)
+    )
+    if dict(mesh.shape).get("data", 1) > 1:
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data"), check_vma=True,
+        ))
+    else:
+        fn = jax.jit(local)
+    return fn(hot_ints_d, hot_vals_d)
+
+
+def hotcold_device_batch(mesh, hstack: HotColdStack):
+    """Device placement for the hot/cold batch: build the slab on device,
+    shard the cold segment-CSR arrays over 'data'."""
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    slab = densify_hot_slabs(mesh, hstack)
+    cold_ints, cold_floats = shard_batch(
+        mesh, (hstack.cold.ints, hstack.cold.floats)
+    )
+    return (slab, cold_ints, cold_floats)
+
+
+def make_hotcold_mb_grad_step(kind: str, mb: int, cold_nnz_pad: int,
+                              hot_k: int, dim: int,
+                              with_intercept: bool = True):
+    """The hot/cold minibatch gradient: two MXU GEMMs over the bf16 slab
+    (forward logits, backward feature gradient) + segment-CSR for the cold
+    tail.  The vectors are widened to 128 GEMM columns — the N=1 matvec
+    lowers to a catastrophic lane-reduction on TPU (measured 400x slower),
+    while N=128 engages the MXU at stream bandwidth; the extra columns are
+    free (the pass is memory-bound on the slab)."""
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def mb_grad_step(params, xs):
+        slab, ints, floats = xs
+        wts, b = params
+        idx = ints[0]
+        rid = ints[1]
+        vals = floats[:cold_nnz_pad]
+        y = floats[cold_nnz_pad : cold_nnz_pad + mb]
+        w = floats[cold_nnz_pad + mb :]
+        dtype = slab.dtype
+        w_hot = jnp.broadcast_to(
+            wts[:hot_k].astype(dtype)[:, None], (hot_k, 128)
+        )
+        hot_logits = jax.lax.dot_general(
+            slab, w_hot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[:, 0]
+        contrib = vals * jnp.take(wts, idx, axis=0)
+        logits = (
+            hot_logits
+            + jax.ops.segment_sum(contrib, rid, num_segments=mb)
+            + b
+        )
+        err, loss_sum = _sparse_loss(kind, logits, y, w)
+        err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
+        g_hot = jax.lax.dot_general(
+            slab, err_m, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[:, 0]
+        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+        scatter = vals * jnp.take(err_ext, rid, axis=0)
+        g_w = jax.ops.segment_sum(scatter, idx, num_segments=dim)
+        g_w = g_w.at[:hot_k].add(g_hot)
+        g_b = jnp.sum(err) * keep_b
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return mb_grad_step
+
+
+def make_hotcold_glm_train_fn(
+    kind: str,
+    mesh,
+    mb: int,
+    cold_nnz_pad: int,
+    hot_k: int,
+    dim: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+    slab_dtype=jnp.bfloat16,
+):
+    """Fused training over (slab, cold ints, cold floats) batches; loop
+    scaffolding shared with every other path via
+    :func:`_build_fused_train_fn`."""
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    key = ("hotcold", kind, mesh, mb, cold_nnz_pad, hot_k, dim,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept), jnp.dtype(slab_dtype).name)
+    mb_grad_step = make_hotcold_mb_grad_step(
+        kind, mb, cold_nnz_pad, hot_k, dim, with_intercept
+    )
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+    )
+
+
+def train_glm_sparse_hotcold(
+    init_params,
+    hstack: HotColdStack,
+    kind: str,
+    mesh,
+    learning_rate: float,
+    max_iter: int,
+    reg: float = 0.0,
+    tol: float = 0.0,
+    with_intercept: bool = True,
+    checkpoint=None,
+    device_batch=None,
+) -> TrainResult:
+    """Hot/cold counterpart of :func:`train_glm_sparse` (1-D data-parallel
+    mesh).  Training runs in permuted feature space; ``run`` unpermutes
+    before returning, so BOTH the returned coefficients and any saved
+    checkpoints are in the ORIGINAL feature space (each chunk's placement
+    re-permutes on entry — the permutation is deterministic from the packed
+    data).  ``hstack`` may be a zero-arg thunk: the expensive host split is
+    resolved only when training actually runs, so a no-op checkpoint
+    resume skips it entirely."""
+    resolved: list = [None]
+
+    def hs() -> HotColdStack:
+        if resolved[0] is None:
+            resolved[0] = _resolve_thunk(hstack)
+        return resolved[0]
+
+    def place(params):
+        from flink_ml_tpu.parallel.mesh import replicate
+
+        w0, b0 = params
+        return replicate(
+            mesh, (jnp.asarray(w0)[hs().inv_perm], jnp.asarray(b0))
+        )
+
+    def trim(params):
+        return (np.asarray(params[0])[hs().perm], params[1])
+
+    def factory(n_epochs):
+        h = hs()
+        return make_hotcold_glm_train_fn(
+            kind, mesh, h.cold.mb, h.cold.nnz_pad, h.hot_k, h.cold.dim,
+            learning_rate, reg, n_epochs, tol, with_intercept,
+            slab_dtype=h.slab_dtype,
+        )
+
+    def run(n_epochs, params, dev_batch=None):
+        r = _run_fused_train(
+            factory(n_epochs), params,
+            dev_batch if dev_batch is not None
+            else hotcold_device_batch(mesh, hs()),
+            mesh, place_params=place, batch_preplaced=True,
+            n_rows=hs().n_rows,
+        )
+        return TrainResult(params=trim(r.params), epochs=r.epochs,
+                           losses=r.losses, final_delta=r.final_delta,
+                           metrics=r.metrics)
+
+    if checkpoint is None:
+        return run(max_iter, init_params, _resolve_thunk(device_batch))
+    return run_chunked_checkpoint(
+        run, init_params, max_iter, tol, checkpoint, mesh, None,
+        device_batch=(
+            device_batch if device_batch is not None
+            else (lambda: hotcold_device_batch(mesh, hs()))
+        ),
     )
 
 

@@ -36,6 +36,7 @@ from flink_ml_tpu.lib.params import (
     HasCheckpoint,
     HasFeatureColsDefaultAsNull,
     HasNumFeatures,
+    HasNumHotFeatures,
     HasGlobalBatchSize,
     HasLabelCol,
     HasLearningRate,
@@ -81,6 +82,7 @@ class GlmTrainParams(
     HasReg,
     HasWithIntercept,
     HasNumFeatures,
+    HasNumHotFeatures,
     HasCheckpoint,
     HasSeed,
 ):
@@ -232,6 +234,12 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         if vector_col is not None and _col_is_sparse(table, vector_col):
             return self._fit_sparse(table, y, mesh, n_dev, batch_share)
 
+        if int(self.get_num_hot_features() or 0) > 0:
+            raise ValueError(
+                "numHotFeatures applies only to sparse vector columns "
+                "(dense features already stream through the MXU); unset it "
+                "for dense training"
+            )
         model_sharded = dict(mesh.shape).get("model", 1) > 1
         if model_sharded:
             # guard BEFORE the full-dataset pack below: per-process assembly
@@ -344,6 +352,10 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         )
         from flink_ml_tpu.parallel.mesh import shard_batch
 
+        hot_k = int(self.get_num_hot_features() or 0)
+        if hot_k > 0:
+            return self._fit_sparse_hotcold(table, mesh, layout_key, sstack,
+                                            hot_k)
         # thunk: resolved lazily so a no-op checkpoint resume skips the hop
         device_batch = lambda: table.cached_pack(  # noqa: E731
             layout_key + ("dev", mesh),
@@ -354,6 +366,49 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         result = train_glm_sparse(
             (w0, b0),
             sstack,
+            self.LOSS_KIND,
+            mesh,
+            learning_rate=self.get_learning_rate(),
+            max_iter=self.get_max_iter(),
+            reg=self.get_reg(),
+            tol=self.get_tol(),
+            with_intercept=self.get_with_intercept(),
+            checkpoint=self._checkpoint_config(),
+            device_batch=device_batch,
+        )
+        return self._finish(result)
+
+    def _fit_sparse_hotcold(self, table, mesh, layout_key, sstack,
+                            hot_k: int) -> GlmModelBase:
+        """Hot/cold sparse fit (VERDICT r3 item 1): the top-``hot_k``
+        frequent features stream through a dense bf16 MXU slab, the cold
+        tail stays segment-CSR.  See lib/common.HotColdStack."""
+        from flink_ml_tpu.lib.common import (
+            hotcold_device_batch,
+            split_hot_cold,
+            train_glm_sparse_hotcold,
+        )
+
+        if dict(mesh.shape).get("model", 1) > 1:
+            raise NotImplementedError(
+                "numHotFeatures > 0 is not supported together with a "
+                "model-sharded (2-D) mesh; pick one wide-model strategy"
+            )
+        # thunks: the host split AND the device slab build resolve lazily,
+        # so a no-op checkpoint resume pays neither
+        hstack = lambda: table.cached_pack(  # noqa: E731
+            layout_key + ("hot", hot_k),
+            lambda: split_hot_cold(sstack, hot_k),
+        )
+        device_batch = lambda: table.cached_pack(  # noqa: E731
+            layout_key + ("hotdev", hot_k, mesh),
+            lambda: hotcold_device_batch(mesh, hstack()),
+        )
+        w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        result = train_glm_sparse_hotcold(
+            (w0, b0),
+            hstack,
             self.LOSS_KIND,
             mesh,
             learning_rate=self.get_learning_rate(),
@@ -396,6 +451,12 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             raise ValueError(
                 "out-of-core training requires an explicit globalBatchSize "
                 "(full batch would need the whole dataset resident)"
+            )
+        if int(self.get_num_hot_features() or 0) > 0:
+            raise NotImplementedError(
+                "numHotFeatures > 0 (hot/cold slab training) is not "
+                "implemented for out-of-core fits yet; unset it or "
+                "materialize the table"
             )
         mb = max(1, -(-gbs // n_dev))
         G_local = mb * n_dev_pack

@@ -187,6 +187,24 @@ class HasNumFeatures(WithParams):
         return self.set(self.NUM_FEATURES, value)
 
 
+class HasNumHotFeatures(WithParams):
+    NUM_HOT_FEATURES: ParamInfo = param_info(
+        "numHotFeatures",
+        "Hot/cold sparse training: the this-many most frequent features "
+        "stream through a dense bf16 MXU slab instead of random "
+        "gather/scatter (0 disables the split). Pick roughly the size of "
+        "the frequency head; the slab costs ~2*numHotFeatures bytes/row "
+        "of HBM traffic and rows*numHotFeatures*2 bytes of HBM residency.",
+        default=0, value_type=int,
+    )
+
+    def get_num_hot_features(self) -> int:
+        return self.get(self.NUM_HOT_FEATURES)
+
+    def set_num_hot_features(self, value: int):
+        return self.set(self.NUM_HOT_FEATURES, int(value))
+
+
 class HasWindowMs(WithParams):
     WINDOW_MS: ParamInfo = param_info(
         "windowMs", "Event-time tumbling window size in milliseconds.",
