@@ -647,23 +647,44 @@ def make_sparse_mb_grad_step(kind: str, mb: int, nnz_pad: int, dim: int,
 
     def mb_grad_step(params, xs):
         ints, floats = xs  # (2, nnz_pad), (nnz_pad + 2*mb,)
-        idx = ints[0]
-        rid = ints[1]
-        vals = floats[:nnz_pad]
-        y = floats[nnz_pad : nnz_pad + mb]
-        w = floats[nnz_pad + mb :]
+        idx, rid, vals, y, w = _segment_csr_unpack(ints, floats, nnz_pad, mb)
         wts, b = params
-        contrib = vals * jnp.take(wts, idx, axis=0)
-        logits = jax.ops.segment_sum(contrib, rid, num_segments=mb) + b
+        logits = _segment_csr_forward(wts, idx, rid, vals, mb) + b
         err, loss_sum = _sparse_loss(kind, logits, y, w)
-        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
-        g_w = jax.ops.segment_sum(
-            vals * jnp.take(err_ext, rid, axis=0), idx, num_segments=dim
-        )
+        g_w = _segment_csr_backward(err, idx, rid, vals, dim)
         g_b = jnp.sum(err) * keep_b
         return (g_w, g_b), loss_sum, jnp.sum(w)
 
     return mb_grad_step
+
+
+def _segment_csr_unpack(ints, floats, nnz_pad: int, mb: int):
+    """Unpack one packed sparse minibatch slice into (idx, rid, vals, y, w)
+    — the ONE copy of the [values | y | w] layout decode (sparse, 2-D, and
+    hot/cold builders all read it, so the layouts cannot drift)."""
+    idx = ints[0]
+    rid = ints[1]
+    vals = floats[:nnz_pad]
+    y = floats[nnz_pad : nnz_pad + mb]
+    w = floats[nnz_pad + mb :]
+    return idx, rid, vals, y, w
+
+
+def _segment_csr_forward(wts, idx, rid, vals, mb: int):
+    """Partial logits from stored entries: segment_sum(values * gather(w))
+    — pad entries carry rid == mb and drop out of the segment range."""
+    return jax.ops.segment_sum(
+        vals * jnp.take(wts, idx, axis=0), rid, num_segments=mb
+    )
+
+
+def _segment_csr_backward(err, idx, rid, vals, dim: int):
+    """Feature-gradient scatter through the same segments; the appended
+    zero row is the pad sink (rid == mb gathers it, contributing nothing)."""
+    err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+    return jax.ops.segment_sum(
+        vals * jnp.take(err_ext, rid, axis=0), idx, num_segments=dim
+    )
 
 
 def make_sparse_glm_train_fn(
@@ -968,11 +989,9 @@ def make_hotcold_mb_grad_step(kind: str, mb: int, cold_nnz_pad: int,
     def mb_grad_step(params, xs):
         slab, ints, floats = xs
         wts, b = params
-        idx = ints[0]
-        rid = ints[1]
-        vals = floats[:cold_nnz_pad]
-        y = floats[cold_nnz_pad : cold_nnz_pad + mb]
-        w = floats[cold_nnz_pad + mb :]
+        idx, rid, vals, y, w = _segment_csr_unpack(
+            ints, floats, cold_nnz_pad, mb
+        )
         dtype = slab.dtype
         w_hot = jnp.broadcast_to(
             wts[:hot_k].astype(dtype)[:, None], (hot_k, 128)
@@ -981,21 +1000,14 @@ def make_hotcold_mb_grad_step(kind: str, mb: int, cold_nnz_pad: int,
             slab, w_hot, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )[:, 0]
-        contrib = vals * jnp.take(wts, idx, axis=0)
-        logits = (
-            hot_logits
-            + jax.ops.segment_sum(contrib, rid, num_segments=mb)
-            + b
-        )
+        logits = hot_logits + _segment_csr_forward(wts, idx, rid, vals, mb) + b
         err, loss_sum = _sparse_loss(kind, logits, y, w)
         err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
         g_hot = jax.lax.dot_general(
             slab, err_m, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )[:, 0]
-        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
-        scatter = vals * jnp.take(err_ext, rid, axis=0)
-        g_w = jax.ops.segment_sum(scatter, idx, num_segments=dim)
+        g_w = _segment_csr_backward(err, idx, rid, vals, dim)
         g_w = g_w.at[:hot_k].add(g_hot)
         g_b = jnp.sum(err) * keep_b
         return (g_w, g_b), loss_sum, jnp.sum(w)

@@ -25,6 +25,7 @@ from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import apply_sharded, resolve_features
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
+    HasBf16Distances,
     HasFeatureColsDefaultAsNull,
     HasK,
     HasLabelCol,
@@ -48,6 +49,7 @@ class KnnParams(
     HasVectorColDefaultAsNull,
     HasFeatureColsDefaultAsNull,
     HasK,
+    HasBf16Distances,
     HasShardModelData,
     HasReservedCols,
     HasPredictionCol,
@@ -56,8 +58,8 @@ class KnnParams(
     """Shared vocabulary for the Knn estimator and model."""
 
 
-@partial(jax.jit, static_argnums=(3, 4))
-def _knn_chunked(xq, xt, yt, k, chunk):
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _knn_chunked(xq, xt, yt, k, chunk, bf16=False):
     """Top-k labels for query batch xq against chunked training data.
 
     Returns (labels (n, k), dists (n, k)).  xt/yt are padded to a multiple of
@@ -80,12 +82,24 @@ def _knn_chunked(xq, xt, yt, k, chunk):
     xq2 = jnp.sum(xq * xq, axis=1, keepdims=True)
     is_real = jnp.isfinite(yt)
 
+    xq_mm = xq.astype(jnp.bfloat16) if bf16 else xq
+
     def scan_chunk(carry, idx):
         best_d, best_y = carry
         xc = jax.lax.dynamic_slice_in_dim(xt, idx * chunk, chunk)
         yc = jax.lax.dynamic_slice_in_dim(yt, idx * chunk, chunk)
         valid = jax.lax.dynamic_slice_in_dim(is_real, idx * chunk, chunk)
-        d = xq2 - 2.0 * (xq @ xc.T) + jnp.sum(xc * xc, axis=1)
+        if bf16:
+            # bf16Distances: the cross term on the MXU in bf16 with f32
+            # accumulation; norms stay f32 (HasBf16Distances contract)
+            cross = jax.lax.dot_general(
+                xq_mm, xc.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            cross = xq @ xc.T
+        d = xq2 - 2.0 * cross + jnp.sum(xc * xc, axis=1)
         d = jnp.where(valid, d, jnp.inf)
         # merge running best with this chunk, re-select top-k
         cat_d = jnp.concatenate([best_d, d], axis=1)
@@ -105,7 +119,7 @@ def _knn_chunked(xq, xt, yt, k, chunk):
 
 
 @lru_cache(maxsize=32)
-def _knn_apply_model_sharded(mesh, k, chunk, n_classes):
+def _knn_apply_model_sharded(mesh, k, chunk, n_classes, bf16=False):
     """Reference-set-sharded kNN: the model (xt/yt) shards over 'data' so it
     need not fit one chip's HBM; queries replicate.
 
@@ -124,7 +138,7 @@ def _knn_apply_model_sharded(mesh, k, chunk, n_classes):
         # queries are replicated (unvarying) but meet the varying reference
         # shard inside the top-k scan carry: mark them varying up front
         xq = jax.lax.pcast(xq, ("data",), to="varying")
-        labels, dists = _knn_chunked(xq, xt_local, yt_local, k, chunk)
+        labels, dists = _knn_chunked(xq, xt_local, yt_local, k, chunk, bf16)
         # leading size-1 axis: the shard_map output gather stacks shards
         # there, giving (n_dev, n, k, 2) without any in-program collective
         return jnp.stack([labels, dists], axis=2)[None]
@@ -158,7 +172,7 @@ def _knn_apply_model_sharded(mesh, k, chunk, n_classes):
 
 
 @lru_cache(maxsize=32)
-def _knn_apply(mesh, k, chunk, n_classes):
+def _knn_apply(mesh, k, chunk, n_classes, bf16=False):
     """Mesh-sharded kNN transform: query rows shard over 'data', the training
     set (the model) replicates to every device — the broadcast-variable
     analog (ModelMapperAdapter.java:53-61) for the benchmark transform
@@ -166,7 +180,7 @@ def _knn_apply(mesh, k, chunk, n_classes):
     from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
 
     def forward(xq, xt, yt):
-        labels, dists = _knn_chunked(xq, xt, yt, k, chunk)
+        labels, dists = _knn_chunked(xq, xt, yt, k, chunk, bf16)
         pred = _majority_vote(labels.astype(jnp.int32), dists, n_classes)
         # class ids and distances are exact in f32 (ids are small ints);
         # staying f32 avoids per-call x64 truncation on TPU
@@ -262,7 +276,10 @@ class KnnModelMapper(ModelMapper):
             _knn_apply_model_sharded if self._sharded else _knn_apply
         )
         out = apply_sharded(
-            lambda mesh: apply_factory(mesh, k, self._chunk, len(self._classes)),
+            lambda mesh: apply_factory(
+                mesh, k, self._chunk, len(self._classes),
+                bool(model.get_bf16_distances()),
+            ),
             X, self._xt, self._yt,
         )
         pred_ids = out[:n, 0].astype(np.int64)

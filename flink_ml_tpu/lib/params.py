@@ -219,6 +219,25 @@ class HasWindowMs(WithParams):
         return self.set(self.WINDOW_MS, value)
 
 
+class HasBf16Distances(WithParams):
+    BF16_DISTANCES: ParamInfo = param_info(
+        "bf16Distances",
+        "Compute the distance-matrix cross term (x . c^T) in bf16 with f32 "
+        "accumulation — ~2x MXU throughput on the matmul-bound Knn "
+        "transform. Opt-in: distances lose ~8 bits of mantissa, so exact "
+        "tie-breaking and bit-parity with the f32 path are not guaranteed "
+        "(neighbor SETS can differ when distances are closer than the bf16 "
+        "rounding of the cross term). The norm terms stay f32.",
+        default=False, value_type=bool,
+    )
+
+    def get_bf16_distances(self) -> bool:
+        return self.get(self.BF16_DISTANCES)
+
+    def set_bf16_distances(self, value: bool):
+        return self.set(self.BF16_DISTANCES, value)
+
+
 class HasShardModelData(WithParams):
     SHARD_MODEL_DATA: ParamInfo = param_info(
         "shardModelData",

@@ -178,6 +178,27 @@ class TestKnn:
         (orig,) = model.transform(t)
         np.testing.assert_array_equal(out.col("pred"), orig.col("pred"))
 
+    def test_bf16_distances_opt_in(self):
+        """bf16Distances: well-separated data classifies identically; the
+        flag is opt-in because exact ties/bit-parity are not guaranteed."""
+        t, X, labels, _ = blob_data(seed=8)
+        rng = np.random.RandomState(9)
+        Q = rng.randn(40, 2) * 4 + 2
+        qt = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR),),
+            {"features": [DenseVector(r) for r in Q]},
+        )
+
+        def preds(bf16):
+            m = (
+                Knn().set_vector_col("features").set_label_col("label")
+                .set_k(5).set_prediction_col("pred")
+                .set_bf16_distances(bf16).fit(t)
+            )
+            return np.asarray(m.transform(qt)[0].col("pred"))
+
+        np.testing.assert_array_equal(preds(True), preds(False))
+
     def test_non_contiguous_labels(self):
         """Labels need not be 0..c-1 — e.g. {-1, 7}."""
         schema = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
