@@ -14,6 +14,7 @@ Run: python examples/out_of_core_training.py [--rows N] [--chunk-rows N]
 """
 
 import argparse
+import json
 import os
 import sys
 import tempfile
@@ -25,23 +26,9 @@ import numpy as np
 from flink_ml_tpu.lib import LogisticRegression
 from flink_ml_tpu.table.schema import Schema
 from flink_ml_tpu.table.sources import ChunkedTable, CsvSource, ShardedSource
+from scripts.generate_linreg_data import generate
 
-TRUE_W = np.array([1.5, -2.0, 0.5, 3.0, -1.0])
-
-
-def write_part_files(directory: str, rows: int, shards: int = 4) -> str:
-    """A directory of part-files, the way bulk exports arrive."""
-    rng = np.random.RandomState(0)
-    per = -(-rows // shards)
-    for i in range(shards):
-        n = min(per, rows - i * per)
-        X = rng.randn(n, len(TRUE_W))
-        y = ((X @ TRUE_W + 0.3 * rng.randn(n)) > 0).astype(np.float64)
-        np.savetxt(
-            os.path.join(directory, f"part-{i:05d}.csv"),
-            np.column_stack([X, y]), delimiter=",", fmt="%.9g",
-        )
-    return os.path.join(directory, "part-*.csv")
+DIM = 5
 
 
 def main():
@@ -51,16 +38,21 @@ def main():
     args = parser.parse_args()
 
     schema = Schema.of(
-        *[(f"f{i}", "double") for i in range(len(TRUE_W))], ("label", "double")
+        *[(f"f{i}", "double") for i in range(DIM)], ("label", "double")
     )
     with tempfile.TemporaryDirectory() as tmp:
-        pattern = write_part_files(tmp, args.rows)
+        # the seeded example data generator (the reference ships
+        # LinearRegressionDataGenerator.java for the same job)
+        pattern = generate(tmp, rows=args.rows, dim=DIM, eval_rows=0,
+                           task="binary")
+        meta = json.load(open(os.path.join(tmp, "meta.json")))
+        true_w = np.asarray(meta["true_w"])
         source = ShardedSource.glob(pattern, lambda p: CsvSource(p, schema))
         table = ChunkedTable(source, chunk_rows=args.chunk_rows, spill=True)
 
         model = (
             LogisticRegression()
-            .set_feature_cols([f"f{i}" for i in range(len(TRUE_W))])
+            .set_feature_cols([f"f{i}" for i in range(DIM)])
             .set_label_col("label")
             .set_prediction_col("pred")
             .set_learning_rate(0.5)
@@ -70,12 +62,12 @@ def main():
         )
 
         w = model.coefficients()
-        direction = w / np.linalg.norm(w) * np.linalg.norm(TRUE_W)
+        direction = w / np.linalg.norm(w) * np.linalg.norm(true_w)
         print(
             f"trained on {args.rows} rows with host residency capped at "
             f"{args.chunk_rows} rows/chunk ({model.train_epochs_} epochs)"
         )
-        print(f"true weights:      {np.round(TRUE_W, 2)}")
+        print(f"true weights:      {np.round(true_w, 2)}")
         print(f"fitted (rescaled): {np.round(direction, 2)}")
         summary = model.train_metrics_.summary()
         print(

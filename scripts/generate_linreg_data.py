@@ -1,0 +1,87 @@
+"""Seeded train/eval data generator for the LinearRegression example.
+
+Parity with the reference's example data generator
+(examples-batch/.../util/LinearRegressionDataGenerator.java — writes the
+train files the LinearRegression example reads): emits a directory of CSV
+part-files (the way bulk exports arrive, ready for
+``ShardedSource.glob``/``ChunkedTable``) plus a held-out eval file, with
+the generating coefficients recorded alongside so examples can check
+recovery.
+
+Usage:
+  python scripts/generate_linreg_data.py --out DIR [--rows N] [--dim D]
+      [--parts K] [--eval-rows M] [--seed S] [--task regression|binary]
+
+Layout written under --out:
+  part-00000.csv ... part-{K-1}.csv   f0..f{D-1},label rows
+  eval.csv                            held-out rows, same schema
+  meta.json                           {"true_w": [...], "intercept": ...,
+                                       "rows", "dim", "seed", "task"}
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def generate(out_dir, rows=100_000, dim=5, parts=4, eval_rows=10_000,
+             seed=0, task="regression"):
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    true_w = rng.randn(dim) * 2.0
+    intercept = float(rng.randn())
+
+    def labels(X):
+        z = X @ true_w + intercept + 0.3 * rng.randn(len(X))
+        return (z > 0).astype(np.float64) if task == "binary" else z
+
+    per = -(-rows // parts)
+    written = 0
+    for i in range(parts):
+        n = min(per, rows - written)
+        if n <= 0:
+            break
+        X = rng.randn(n, dim)
+        np.savetxt(
+            os.path.join(out_dir, f"part-{i:05d}.csv"),
+            np.column_stack([X, labels(X)]), delimiter=",", fmt="%.9g",
+        )
+        written += n
+    Xe = rng.randn(eval_rows, dim)
+    np.savetxt(
+        os.path.join(out_dir, "eval.csv"),
+        np.column_stack([Xe, labels(Xe)]), delimiter=",", fmt="%.9g",
+    )
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump({
+            "true_w": [float(v) for v in true_w],
+            "intercept": intercept,
+            "rows": written, "dim": dim, "parts": parts,
+            "eval_rows": eval_rows, "seed": seed, "task": task,
+        }, f, indent=2)
+    return os.path.join(out_dir, "part-*.csv")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--rows", type=int, default=100_000)
+    p.add_argument("--dim", type=int, default=5)
+    p.add_argument("--parts", type=int, default=4)
+    p.add_argument("--eval-rows", type=int, default=10_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--task", choices=("regression", "binary"),
+                   default="regression")
+    a = p.parse_args()
+    pattern = generate(a.out, a.rows, a.dim, a.parts, a.eval_rows, a.seed,
+                       a.task)
+    print(pattern)
+
+
+if __name__ == "__main__":
+    main()
