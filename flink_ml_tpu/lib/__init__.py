@@ -1,6 +1,12 @@
 from flink_ml_tpu.lib.classification import LogisticRegression, LogisticRegressionModel
 from flink_ml_tpu.lib.clustering import KMeans, KMeansModel
-from flink_ml_tpu.lib.feature import StandardScaler, StandardScalerModel
+from flink_ml_tpu.lib.feature import (
+    MinMaxScaler,
+    MinMaxScalerModel,
+    StandardScaler,
+    StandardScalerModel,
+    VectorAssembler,
+)
 from flink_ml_tpu.lib.knn import Knn, KnnModel
 from flink_ml_tpu.lib.online import OnlineLogisticRegression
 from flink_ml_tpu.lib.regression import LinearRegression, LinearRegressionModel
@@ -14,7 +20,10 @@ __all__ = [
     "KMeansModel",
     "Knn",
     "KnnModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
     "OnlineLogisticRegression",
     "StandardScaler",
     "StandardScalerModel",
+    "VectorAssembler",
 ]

@@ -30,16 +30,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu.api.core import Estimator
+from flink_ml_tpu.api.core import Estimator, Transformer
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import apply_sharded
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.params import param_info
 from flink_ml_tpu.params.params import ParamInfo, WithParams
 from flink_ml_tpu.params.shared import (
+    HasOutputCol,
     HasOutputColDefaultAsNull,
     HasReservedCols,
     HasSelectedCol,
+    HasSelectedCols,
 )
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
@@ -165,6 +167,191 @@ class StandardScalerModel(TableModelBase, StandardScalerParams):
 
     def _make_mapper(self, data_schema: Schema) -> StandardScalerModelMapper:
         return StandardScalerModelMapper(self, data_schema)
+
+
+MINMAX_MODEL_SCHEMA = Schema.of(
+    ("mins", DataTypes.DENSE_VECTOR),
+    ("maxs", DataTypes.DENSE_VECTOR),
+    ("count", DataTypes.DOUBLE),
+)
+
+
+class MinMaxScalerParams(
+    HasSelectedCol,
+    HasOutputColDefaultAsNull,
+    HasReservedCols,
+):
+    """Vocabulary for MinMaxScaler: rescale each dimension of the selected
+    vector column into [outputMin, outputMax]."""
+
+    OUTPUT_MIN: ParamInfo = param_info(
+        "outputMin", "Lower bound of the output range.",
+        default=0.0, value_type=float,
+    )
+    OUTPUT_MAX: ParamInfo = param_info(
+        "outputMax", "Upper bound of the output range.",
+        default=1.0, value_type=float,
+    )
+
+    def get_output_min(self) -> float:
+        return self.get(self.OUTPUT_MIN)
+
+    def set_output_min(self, value: float):
+        return self.set(self.OUTPUT_MIN, float(value))
+
+    def get_output_max(self) -> float:
+        return self.get(self.OUTPUT_MAX)
+
+    def set_output_max(self, value: float):
+        return self.set(self.OUTPUT_MAX, float(value))
+
+    def resolved_output_col(self) -> str:
+        out = self.get_output_col()
+        return out if out is not None else self.get_selected_col()
+
+
+@jax.jit
+def _chunk_minmax(x):
+    return jnp.min(x, axis=0), jnp.max(x, axis=0)
+
+
+@lru_cache(maxsize=32)
+def _affine_apply(mesh):
+    """Mesh-sharded per-dimension affine map x*a + b (rows over 'data')."""
+    from flink_ml_tpu.parallel.collectives import make_data_parallel_apply
+
+    def affine(x, a, b):
+        return x * a + b
+
+    return make_data_parallel_apply(affine, mesh, n_args=3)
+
+
+class MinMaxScalerModelMapper(ModelMapper):
+    def __init__(self, model: "MinMaxScalerModel", data_schema: Schema):
+        self._model_stage = model
+        super().__init__([MINMAX_MODEL_SCHEMA], data_schema, model.get_params())
+
+    def reserved_cols(self) -> Optional[list]:
+        return self._model_stage.get_reserved_cols()
+
+    def output_cols(self) -> Tuple[list, list]:
+        return [self._model_stage.resolved_output_col()], [DataTypes.DENSE_VECTOR]
+
+    def load_model(self, *model_tables: Table) -> None:
+        (t,) = model_tables
+        model = self._model_stage
+        mins = np.asarray(t.features_dense("mins")[0], dtype=np.float64)
+        maxs = np.asarray(t.features_dense("maxs")[0], dtype=np.float64)
+        self._dim = mins.shape[0]
+        lo, hi = model.get_output_min(), model.get_output_max()
+        if lo >= hi:
+            # validated here too: range params can be (re)set after fit or
+            # on a loaded model, and inverted scaling is silently wrong
+            raise ValueError("outputMin must be < outputMax")
+        span = maxs - mins
+        varying = span > 0.0
+        # folded per-dim affine: varying dims rescale into [lo, hi];
+        # constant dims map to the range midpoint (no spread to preserve)
+        a = np.where(varying, (hi - lo) / np.where(varying, span, 1.0), 0.0)
+        b = np.where(varying, lo - mins * a, 0.5 * (lo + hi))
+        self._a = jnp.asarray(a, dtype=jnp.float32)
+        self._b = jnp.asarray(b, dtype=jnp.float32)
+
+    def map_batch(self, batch: Table):
+        model = self._model_stage
+        X = batch.features_dense(model.get_selected_col(), dim=self._dim)
+        out = apply_sharded(
+            _affine_apply, X.astype(np.float32), self._a, self._b
+        )
+        return {model.resolved_output_col(): out}
+
+
+class MinMaxScalerModel(TableModelBase, MinMaxScalerParams):
+    """Rescales the selected vector column with the fitted min/max."""
+
+    REQUIRED_MODEL_COL = "mins"
+
+    def _make_mapper(self, data_schema: Schema) -> MinMaxScalerModelMapper:
+        return MinMaxScalerModelMapper(self, data_schema)
+
+
+class MinMaxScaler(Estimator, MinMaxScalerParams):
+    """Estimator: one streamed pass accumulating per-dimension min/max
+    (chunked input welcome, like StandardScaler)."""
+
+    def fit(self, *inputs) -> MinMaxScalerModel:
+        (table,) = inputs
+        col = self.get_selected_col()
+        if self.get_output_min() >= self.get_output_max():
+            raise ValueError("outputMin must be < outputMax")
+        chunks = table.chunks() if getattr(table, "is_chunked", False) else (table,)
+        n = 0
+        mins = maxs = None
+        for chunk in chunks:
+            if chunk.num_rows() == 0:
+                continue
+            X = chunk.features_dense(col)
+            cmin, cmax = _chunk_minmax(jnp.asarray(X, dtype=jnp.float32))
+            cmin = np.asarray(cmin, dtype=np.float64)
+            cmax = np.asarray(cmax, dtype=np.float64)
+            if mins is None:
+                mins, maxs = cmin, cmax
+            else:
+                mins = np.minimum(mins, cmin)
+                maxs = np.maximum(maxs, cmax)
+            n += X.shape[0]
+        if mins is None:
+            raise ValueError("cannot fit MinMaxScaler on an empty input")
+
+        model = MinMaxScalerModel()
+        model.get_params().merge(self.get_params())
+        model.set_model_data(Table.from_columns(
+            MINMAX_MODEL_SCHEMA,
+            {
+                "mins": mins.reshape(1, -1),
+                "maxs": maxs.reshape(1, -1),
+                "count": np.asarray([float(n)]),
+            },
+        ))
+        return model
+
+
+class VectorAssembler(
+    Transformer, HasSelectedCols, HasOutputCol, HasReservedCols
+):
+    """Concatenate numeric and/or vector columns into one dense vector
+    column — the canonical pipeline head stage (selectedCols -> outputCol).
+
+    Stateless (no fit): the output is a matrix-backed column built by one
+    columnar hstack, so a downstream estimator's ``features_dense`` is
+    zero-copy.  Dense vector inputs contribute their full width; numeric
+    columns contribute one dimension each, in selectedCols order.
+    """
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        cols = self.get_selected_cols()
+        parts = []
+        for c in cols:
+            typ = table.schema.type_of(c)
+            if DataTypes.is_vector(typ):
+                parts.append(np.asarray(table.features_dense(c), dtype=np.float64))
+            else:
+                parts.append(
+                    np.asarray(table.col(c), dtype=np.float64).reshape(-1, 1)
+                )
+        out = (
+            np.hstack(parts) if parts
+            else np.zeros((table.num_rows(), 0))
+        )
+
+        from flink_ml_tpu.table.output_cols import OutputColsHelper
+
+        helper = OutputColsHelper(
+            table.schema, [self.get_output_col()], [DataTypes.DENSE_VECTOR],
+            reserved_col_names=self.get_reserved_cols(),
+        )
+        return (helper.get_result_table(table, {self.get_output_col(): out}),)
 
 
 class StandardScaler(Estimator, StandardScalerParams):

@@ -176,6 +176,131 @@ class TestStandardScalerTransform:
         )
 
 
+class TestMinMaxScaler:
+    def test_scales_to_unit_range(self):
+        t, X, _ = _data()
+        from flink_ml_tpu.lib import MinMaxScaler
+
+        (out,) = (
+            MinMaxScaler().set_selected_col("features").fit(t).transform(t)
+        )
+        Z = out.features_dense("features")
+        np.testing.assert_allclose(Z.min(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(Z.max(axis=0), 1.0, atol=1e-5)
+
+    def test_custom_range_and_constant_dim(self):
+        from flink_ml_tpu.lib import MinMaxScaler
+
+        t, X, y = _data()
+        Xc = X.copy()
+        Xc[:, 1] = 4.0  # constant dimension -> range midpoint
+        tc = Table.from_columns(
+            SCHEMA, {"id": t.col("id"), "features": Xc, "label": y}
+        )
+        model = (
+            MinMaxScaler().set_selected_col("features")
+            .set_output_min(-1.0).set_output_max(1.0).fit(tc)
+        )
+        (out,) = model.transform(tc)
+        Z = out.features_dense("features")
+        np.testing.assert_allclose(Z.min(axis=0)[[0, 2, 3, 4]], -1.0, atol=1e-5)
+        np.testing.assert_allclose(Z.max(axis=0)[[0, 2, 3, 4]], 1.0, atol=1e-5)
+        np.testing.assert_allclose(Z[:, 1], 0.0, atol=1e-6)
+
+    def test_chunked_fit_matches_materialized(self):
+        from flink_ml_tpu.lib import MinMaxScaler
+
+        t, X, y = _data(n=100)
+        rows = [(float(i), DenseVector(r), float(lab))
+                for i, (r, lab) in enumerate(zip(X, y))]
+        chunked = ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=16)
+        (mc,) = MinMaxScaler().set_selected_col("features").fit(chunked).get_model_data()
+        (mf,) = MinMaxScaler().set_selected_col("features").fit(t).get_model_data()
+        np.testing.assert_allclose(
+            mc.features_dense("mins")[0], mf.features_dense("mins")[0], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            mc.features_dense("maxs")[0], mf.features_dense("maxs")[0], rtol=1e-6
+        )
+
+    def test_bad_range_rejected(self):
+        from flink_ml_tpu.lib import MinMaxScaler
+
+        t, _, _ = _data(n=20)
+        with pytest.raises(ValueError, match="outputMin"):
+            (MinMaxScaler().set_selected_col("features")
+             .set_output_min(1.0).set_output_max(0.0).fit(t))
+
+    def test_save_load(self, tmp_path):
+        from flink_ml_tpu.lib import MinMaxScaler, MinMaxScalerModel
+
+        t, _, _ = _data()
+        model = MinMaxScaler().set_selected_col("features").fit(t)
+        model.save(str(tmp_path / "mm"))
+        loaded = load_stage(str(tmp_path / "mm"))
+        assert isinstance(loaded, MinMaxScalerModel)
+        (a,) = model.transform(t)
+        (b,) = loaded.transform(t)
+        np.testing.assert_array_equal(
+            a.features_dense("features"), b.features_dense("features")
+        )
+
+
+class TestVectorAssembler:
+    def test_assembles_numeric_and_vector_cols(self):
+        from flink_ml_tpu.lib import VectorAssembler
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(50, 3)
+        a = rng.randn(50)
+        schema = Schema.of(
+            ("a", "double"), ("vec", DataTypes.DENSE_VECTOR), ("label", "double")
+        )
+        t = Table.from_columns(
+            schema, {"a": a, "vec": X, "label": np.zeros(50)}
+        )
+        (out,) = (
+            VectorAssembler().set_selected_cols(["a", "vec"])
+            .set_output_col("features").transform(t)
+        )
+        assert out.schema.field_names == ["a", "vec", "label", "features"]
+        Z = out.features_dense("features")
+        np.testing.assert_array_equal(Z[:, 0], a)
+        np.testing.assert_array_equal(Z[:, 1:], X)
+
+    def test_assembler_heads_a_pipeline(self, tmp_path):
+        """assembler -> scaler -> LR: a three-stage pipeline over plain
+        numeric columns, save/load reproducing predictions."""
+        from flink_ml_tpu.lib import VectorAssembler
+
+        rng = np.random.RandomState(1)
+        n = 300
+        cols = {f"c{i}": rng.randn(n) * (10.0 ** i) for i in range(4)}
+        X = np.stack([cols[f"c{i}"] for i in range(4)], axis=1)
+        y = (X[:, 0] + 0.3 * X[:, 1] / 10 > 0).astype(np.float64)
+        schema = Schema.of(*[(f"c{i}", "double") for i in range(4)],
+                           ("label", "double"))
+        t = Table.from_columns(schema, {**cols, "label": y})
+        lr = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_max_iter(15)
+        )
+        pm = Pipeline([
+            VectorAssembler().set_selected_cols([f"c{i}" for i in range(4)])
+            .set_output_col("features"),
+            _scaler(),
+            lr,
+        ]).fit(t)
+        (out,) = pm.transform(t)
+        acc = float(np.mean(np.asarray(out.col("pred")) == y))
+        assert acc > 0.9, acc
+        pm.save(str(tmp_path / "pm"))
+        loaded = PipelineModel.load(str(tmp_path / "pm"))
+        (redo,) = loaded.transform(t)
+        np.testing.assert_array_equal(out.col("pred"), redo.col("pred"))
+
+
 class TestScalerPipelineE2E:
     """The VERDICT r3 'done' bar: Pipeline([scaler, lr]).fit exercises the
     transform-forward branch with real tables; the loaded PipelineModel
