@@ -838,15 +838,28 @@ class HotColdStack:
     accumulation (exact for 0/1-valued hashed features, ~2^-8 relative
     rounding otherwise); everything else stays f32.  ``slab_dtype``
     exists for equivalence tests (f32 slab).
+
+    With ``model_size > 1`` the layout is feature-sharded over a
+    ('data','model') mesh: slab columns split evenly (shard i owns columns
+    [i*hot_k_local, (i+1)*hot_k_local)) and the permuted weight space
+    interleaves per shard — shard i owns permuted ids
+    [i*dim_local, (i+1)*dim_local), locally [0, hot_k_local) hot and
+    [hot_k_local, dim_local) cold — so each shard's weight slice is
+    [its slab columns | its cold range] and weight traffic never crosses
+    chips.  ``dim_pad >= dim`` absorbs the rounding (dead positions carry
+    zero weight and zero gradient forever).  ``model_size == 1`` reduces to
+    the single-chip layout above (``dim_pad == dim``).
     """
 
-    hot_ints: np.ndarray   # (n_groups, 2, hot_pad) int32 [slab pos, row id]
+    hot_ints: np.ndarray   # (n_groups, 2, hot_pad) int32 [slab col, row id]
     hot_vals: np.ndarray   # (n_groups, hot_pad) f32; pad rows carry rid=mb
     cold: SparseMinibatchStack  # permuted cold entries + [y | w] tail
-    perm: np.ndarray       # original feature id -> permuted id
-    inv_perm: np.ndarray   # permuted id -> original feature id
-    hot_k: int
+    perm: np.ndarray       # original feature id -> permuted id [0, dim_pad)
+    inv_perm: np.ndarray   # permuted id -> original feature id (dead -> 0)
+    hot_k: int             # slab columns (incl. dead tail when rounded up)
     slab_dtype: Any = jnp.bfloat16
+    model_size: int = 1    # 'model' mesh-axis size the layout targets
+    dim_pad: int = 0       # permuted weight-space size (== dim when 1-D)
 
     @property
     def mb(self) -> int:
@@ -854,44 +867,72 @@ class HotColdStack:
 
     @property
     def dim(self) -> int:
+        """Permuted weight-space size (``cold.dim == dim_pad``); the
+        original feature count is ``len(perm)``."""
         return self.cold.dim
 
     @property
     def n_rows(self) -> int:
         return self.cold.n_rows
 
+    @property
+    def hot_k_local(self) -> int:
+        return self.hot_k // self.model_size
+
+    @property
+    def dim_local(self) -> int:
+        return self.dim_pad // self.model_size
+
 
 def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
                    pad_multiple: int = 512,
-                   slab_dtype=jnp.bfloat16) -> HotColdStack:
+                   slab_dtype=jnp.bfloat16,
+                   model_size: int = 1) -> HotColdStack:
     """Frequency analysis + feature permutation + per-group entry split.
 
     The ``hot_k`` features with the most stored entries (ties broken by
-    lower id) map to slab positions [0, hot_k); everything else keeps
-    segment-CSR form with ids remapped into [hot_k, dim)."""
+    lower id) become slab columns; everything else keeps segment-CSR form
+    with ids remapped into the permuted cold range.  ``model_size > 1``
+    produces the feature-sharded layout documented on
+    :class:`HotColdStack` (``hot_k`` rounds up to a model-axis multiple;
+    the extra slab columns are dead)."""
     ints, floats = sstack.ints, sstack.floats
     mb, nnz_pad, dim = sstack.mb, sstack.nnz_pad, sstack.dim
     n_groups = ints.shape[0]
-    hot_k = int(min(max(hot_k, 1), dim))
+    model_size = int(max(model_size, 1))
+    n_hot = int(min(max(hot_k, 1), dim))
+    hot_k_eff = -(-n_hot // model_size) * model_size
+    hk_l = hot_k_eff // model_size
+    cold_count = dim - n_hot
+    cold_l = -(-cold_count // model_size) if cold_count else 0
+    dim_local = hk_l + cold_l
+    dim_pad = model_size * dim_local
 
     idx = ints[:, 0, :]
     rid = ints[:, 1, :]
     valid = rid < mb
     counts = np.bincount(idx[valid].ravel(), minlength=dim)
     order = np.lexsort((np.arange(dim), -counts))  # by count desc, id asc
-    hot_ids = np.sort(order[:hot_k])
+    hot_ids = np.sort(order[:n_hot])
+    # slab column per hot feature (rank in id order); -1 marks cold
+    slab_col = np.full(dim, -1, dtype=np.int32)
+    slab_col[hot_ids] = np.arange(n_hot, dtype=np.int32)
     perm = np.empty(dim, dtype=np.int32)
-    perm[hot_ids] = np.arange(hot_k, dtype=np.int32)
+    c = np.arange(n_hot, dtype=np.int32)
+    perm[hot_ids] = (c // hk_l) * dim_local + (c % hk_l)
     cold_mask_ids = np.ones(dim, dtype=bool)
     cold_mask_ids[hot_ids] = False
     cold_ids = np.nonzero(cold_mask_ids)[0]
-    perm[cold_ids] = hot_k + np.arange(cold_ids.size, dtype=np.int32)
-    inv_perm = np.empty(dim, dtype=np.int32)
+    if cold_ids.size:
+        r = np.arange(cold_ids.size, dtype=np.int32)
+        perm[cold_ids] = (r // cold_l) * dim_local + hk_l + (r % cold_l)
+    inv_perm = np.zeros(dim_pad, dtype=np.int32)
     inv_perm[perm] = np.arange(dim, dtype=np.int32)
 
+    ranks = np.where(valid, slab_col[idx], -1)
     new_idx = np.where(valid, perm[idx], 0)
-    is_hot = valid & (new_idx < hot_k)
-    is_cold = valid & ~(new_idx < hot_k)
+    is_hot = ranks >= 0
+    is_cold = valid & (ranks < 0)
     hot_counts = is_hot.sum(axis=1)
     cold_counts = is_cold.sum(axis=1)
     hot_pad = max(-(-int(hot_counts.max(initial=1)) // pad_multiple)
@@ -910,26 +951,31 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
         h = is_hot[g]
         c = is_cold[g]
         nh, nc = int(hot_counts[g]), int(cold_counts[g])
-        hot_ints[g, 0, :nh] = new_idx[g, h]
+        hot_ints[g, 0, :nh] = ranks[g, h]  # global slab column
         hot_ints[g, 1, :nh] = rid[g, h]
         hot_vals[g, :nh] = vals[g, h]
-        cold_ints[g, 0, :nc] = new_idx[g, c]
+        cold_ints[g, 0, :nc] = new_idx[g, c]  # permuted feature id
         cold_ints[g, 1, :nc] = rid[g, c]
         cold_floats[g, :nc] = vals[g, c]
         cold_floats[g, cold_pad:] = floats[g, nnz_pad:]  # [y | w] tail
 
+    # the cold stack's ids live in PERMUTED space [hot ranges excluded],
+    # which spans [0, dim_pad) — dim must be dim_pad (== dim when 1-D) or
+    # a rounded-up 2-D layout would violate the col-index < dim invariant
     cold = SparseMinibatchStack(
         ints=cold_ints, floats=cold_floats, steps=sstack.steps, mb=mb,
-        nnz_pad=cold_pad, dim=dim, n_rows=sstack.n_rows,
+        nnz_pad=cold_pad, dim=dim_pad, n_rows=sstack.n_rows,
     )
     return HotColdStack(
         hot_ints=hot_ints, hot_vals=hot_vals, cold=cold, perm=perm,
-        inv_perm=inv_perm, hot_k=hot_k, slab_dtype=slab_dtype,
+        inv_perm=inv_perm, hot_k=hot_k_eff, slab_dtype=slab_dtype,
+        model_size=model_size, dim_pad=dim_pad,
     )
 
 
 def densify_hot_slabs(mesh, hstack: HotColdStack):
-    """Build the per-minibatch hot slabs ON DEVICE, sharded over 'data'.
+    """Build the per-minibatch hot slabs ON DEVICE, sharded over 'data'
+    (and over 'model' on slab columns when the layout is feature-sharded).
 
     The host ships only the compact hot entry arrays (~entries x 12B); the
     10s-of-GB slab materializes device-side via one sequential scatter pass
@@ -941,6 +987,38 @@ def densify_hot_slabs(mesh, hstack: HotColdStack):
 
     mb, hot_k, dtype = hstack.mb, hstack.hot_k, hstack.slab_dtype
 
+    hot_ints_d, hot_vals_d = shard_batch(
+        mesh, (hstack.hot_ints, hstack.hot_vals)
+    )
+    if hstack.model_size > 1:
+        if dict(mesh.shape).get("model", 1) != hstack.model_size:
+            raise ValueError(
+                f"HotColdStack laid out for model_size={hstack.model_size} "
+                f"but mesh has model axis {dict(mesh.shape).get('model', 1)}"
+            )
+        hk_l = hstack.hot_k_local
+
+        def local_sharded(hot_ints, hot_vals):
+            lo = jax.lax.axis_index("model") * hk_l
+
+            def one(args):
+                ig, vg = args
+                pos, rid = ig[0], ig[1]
+                lpos = pos - lo
+                mine = jnp.logical_and(lpos >= 0, lpos < hk_l)
+                slab = jnp.zeros((mb + 1, hk_l), dtype)  # row mb = pad sink
+                return slab.at[
+                    jnp.where(mine, rid, mb), jnp.clip(lpos, 0, hk_l - 1)
+                ].add(jnp.where(mine, vg, 0.0).astype(dtype))[:mb]
+
+            return jax.lax.map(one, (hot_ints, hot_vals))
+
+        fn = jax.jit(jax.shard_map(
+            local_sharded, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data", None, "model"), check_vma=True,
+        ))
+        return fn(hot_ints_d, hot_vals_d)
+
     def local(hot_ints, hot_vals):
         def one(args):
             ig, vg = args
@@ -950,9 +1028,6 @@ def densify_hot_slabs(mesh, hstack: HotColdStack):
 
         return jax.lax.map(one, (hot_ints, hot_vals))
 
-    hot_ints_d, hot_vals_d = shard_batch(
-        mesh, (hstack.hot_ints, hstack.hot_vals)
-    )
     if dict(mesh.shape).get("data", 1) > 1:
         fn = jax.jit(jax.shard_map(
             local, mesh=mesh, in_specs=(P("data"), P("data")),
@@ -1045,6 +1120,110 @@ def make_hotcold_glm_train_fn(
     )
 
 
+def make_hotcold_mb_grad_step_2d(kind: str, mb: int, cold_nnz_pad: int,
+                                 hot_k_local: int, dim_local: int,
+                                 with_intercept: bool = True):
+    """Feature-sharded hot/cold minibatch gradient.
+
+    Shard i of the ``model`` axis owns slab columns
+    [i*hot_k_local, (i+1)*hot_k_local) (arriving pre-sliced: the slab leaf
+    is sharded on its column axis) and the permuted weight range
+    [i*dim_local, (i+1)*dim_local) — locally [0, hot_k_local) are its slab
+    columns, [hot_k_local, dim_local) its cold features.  The slab GEMMs
+    stay node-local; cold entries are masked to local ownership exactly
+    like :func:`make_sparse_mb_grad_step_2d`; one ``psum`` over ``model``
+    (the TP allreduce riding ICI) completes the logits.  The 128-column
+    GEMM widening matches the 1-D step (the N=1 matvec lowers to a
+    catastrophic lane reduction)."""
+    keep_b = 1.0 if with_intercept else 0.0
+
+    def mb_grad_step(params, xs):
+        slab, ints, floats = xs  # slab local: (mb, hot_k_local)
+        wts_local, b = params    # (dim_local,), ()
+        idx, rid, vals, y, w = _segment_csr_unpack(
+            ints, floats, cold_nnz_pad, mb
+        )
+        lo = jax.lax.axis_index("model") * dim_local
+        local_idx = idx - lo
+        mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
+        safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
+        dtype = slab.dtype
+        w_hot = jnp.broadcast_to(
+            wts_local[:hot_k_local].astype(dtype)[:, None], (hot_k_local, 128)
+        )
+        hot_partial = jax.lax.dot_general(
+            slab, w_hot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[:, 0]
+        contrib = jnp.where(
+            mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
+        )
+        cold_partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
+        # the TP allreduce: complete logits across feature shards
+        logits = jax.lax.psum(hot_partial + cold_partial, "model") + b
+        err, loss_sum = _sparse_loss(kind, logits, y, w)
+        err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
+        g_hot = jax.lax.dot_general(
+            slab, err_m, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[:, 0]
+        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+        scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
+        g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
+        g_w = g_w.at[:hot_k_local].add(g_hot)
+        g_b = jnp.sum(err) * keep_b
+        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return mb_grad_step
+
+
+def make_hotcold_glm_train_fn_2d(
+    kind: str,
+    mesh,
+    mb: int,
+    cold_nnz_pad: int,
+    hot_k: int,
+    dim_pad: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+    slab_dtype=jnp.bfloat16,
+):
+    """Fused hot/cold training over a ('data','model') mesh: minibatch
+    groups shard over ``data``, slab columns and the permuted weight vector
+    over ``model``.  Loop scaffolding shared with every other path via
+    :func:`_build_fused_train_fn`."""
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    model_size = dict(mesh.shape)["model"]
+    if hot_k % model_size or dim_pad % model_size:
+        raise ValueError(
+            f"hot_k={hot_k} / dim_pad={dim_pad} not divisible by model "
+            f"axis size {model_size} (use split_hot_cold(model_size=...))"
+        )
+    key = ("hotcold2d", kind, mesh, mb, cold_nnz_pad, hot_k, dim_pad,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept), jnp.dtype(slab_dtype).name)
+    mb_grad_step = make_hotcold_mb_grad_step_2d(
+        kind, mb, cold_nnz_pad, hot_k // model_size, dim_pad // model_size,
+        with_intercept,
+    )
+
+    from jax.sharding import PartitionSpec as P
+
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol,
+        in_specs=(
+            (P("model"), P()),
+            (P("data", None, "model"), P("data"), P("data")),
+        ),
+        out_specs=((P("model"), P()), P(), P(), P()),
+        delta_fn=_feature_sharded_delta,
+    )
+
+
 def train_glm_sparse_hotcold(
     init_params,
     hstack: HotColdStack,
@@ -1058,14 +1237,16 @@ def train_glm_sparse_hotcold(
     checkpoint=None,
     device_batch=None,
 ) -> TrainResult:
-    """Hot/cold counterpart of :func:`train_glm_sparse` (1-D data-parallel
-    mesh).  Training runs in permuted feature space; ``run`` unpermutes
-    before returning, so BOTH the returned coefficients and any saved
-    checkpoints are in the ORIGINAL feature space (each chunk's placement
-    re-permutes on entry — the permutation is deterministic from the packed
-    data).  ``hstack`` may be a zero-arg thunk: the expensive host split is
-    resolved only when training actually runs, so a no-op checkpoint
-    resume skips it entirely."""
+    """Hot/cold counterpart of :func:`train_glm_sparse`.  Training runs in
+    permuted feature space; ``run`` unpermutes before returning, so BOTH
+    the returned coefficients and any saved checkpoints are in the
+    ORIGINAL feature space (each chunk's placement re-permutes on entry —
+    the permutation is deterministic from the packed data).  ``hstack``
+    may be a zero-arg thunk: the expensive host split is resolved only
+    when training actually runs, so a no-op checkpoint resume skips it
+    entirely.  A stack laid out with ``model_size > 1`` trains
+    feature-sharded over the mesh's ``model`` axis (slab columns and the
+    permuted weight vector sharded, one psum completing logits)."""
     resolved: list = [None]
 
     def hs() -> HotColdStack:
@@ -1074,18 +1255,39 @@ def train_glm_sparse_hotcold(
         return resolved[0]
 
     def place(params):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         from flink_ml_tpu.parallel.mesh import replicate
 
         w0, b0 = params
-        return replicate(
-            mesh, (jnp.asarray(w0)[hs().inv_perm], jnp.asarray(b0))
+        h = hs()
+        # scatter (not gather-by-inv_perm): dead positions of a rounded-up
+        # 2-D layout must hold zero, not a duplicated weight
+        w_perm = (
+            jnp.zeros((h.dim_pad,), jnp.float32)
+            .at[jnp.asarray(h.perm)]
+            .set(jnp.asarray(w0, jnp.float32))
         )
+        if h.model_size > 1:
+            return (
+                jax.device_put(w_perm, NamedSharding(mesh, P("model"))),
+                jax.device_put(
+                    jnp.asarray(b0, jnp.float32), NamedSharding(mesh, P())
+                ),
+            )
+        return replicate(mesh, (w_perm, jnp.asarray(b0, jnp.float32)))
 
     def trim(params):
         return (np.asarray(params[0])[hs().perm], params[1])
 
     def factory(n_epochs):
         h = hs()
+        if h.model_size > 1:
+            return make_hotcold_glm_train_fn_2d(
+                kind, mesh, h.cold.mb, h.cold.nnz_pad, h.hot_k, h.dim_pad,
+                learning_rate, reg, n_epochs, tol, with_intercept,
+                slab_dtype=h.slab_dtype,
+            )
         return make_hotcold_glm_train_fn(
             kind, mesh, h.cold.mb, h.cold.nnz_pad, h.hot_k, h.cold.dim,
             learning_rate, reg, n_epochs, tol, with_intercept,

@@ -320,27 +320,102 @@ class TestHotColdSplit:
                 .set_num_hot_features(4).fit(chunked)
             )
 
-    def test_model_sharded_mesh_rejected(self):
+    def test_2d_f32_slab_matches_1d(self):
+        """Feature-sharded hot/cold training (slab columns + weights over
+        the 'model' axis, one psum completing logits) matches the 1-D path
+        to f32 rounding — only the summation grouping changes."""
         import jax
+        import jax.numpy as jnp
 
+        from flink_ml_tpu.lib.common import (
+            split_hot_cold,
+            train_glm_sparse_hotcold,
+        )
+        from flink_ml_tpu.parallel.mesh import create_mesh
+
+        vecs, ys = self._power_law_data()
+        s = pack_sparse_minibatches(vecs, ys, n_dev=4, global_batch_size=64)
+        p0 = lambda: (  # noqa: E731
+            jnp.zeros((s.dim,), jnp.float32), jnp.zeros((), jnp.float32)
+        )
+        mesh1 = create_mesh({"data": 4}, jax.devices()[:4])
+        h1 = split_hot_cold(s, hot_k=8, pad_multiple=8,
+                            slab_dtype=jnp.float32)
+        r1 = train_glm_sparse_hotcold(
+            p0(), h1, "logistic", mesh1, learning_rate=0.5, max_iter=15
+        )
+        mesh2 = create_mesh({"data": 4, "model": 2})
+        h2 = split_hot_cold(s, hot_k=8, pad_multiple=8,
+                            slab_dtype=jnp.float32, model_size=2)
+        assert h2.dim_pad >= s.dim and h2.hot_k % 2 == 0
+        r2 = train_glm_sparse_hotcold(
+            p0(), h2, "logistic", mesh2, learning_rate=0.5, max_iter=15
+        )
+        np.testing.assert_allclose(r2.params[0], r1.params[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r2.params[1], r1.params[1], atol=1e-6)
+        np.testing.assert_allclose(r2.losses, r1.losses, rtol=1e-5)
+
+    def test_2d_rounded_hot_k_dead_columns(self):
+        """hot_k not divisible by the model axis rounds up; the dead slab
+        columns stay at zero weight."""
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.lib.common import (
+            split_hot_cold,
+            train_glm_sparse_hotcold,
+        )
+        from flink_ml_tpu.parallel.mesh import create_mesh
+
+        vecs, ys = self._power_law_data(n=200, dim=33)
+        s = pack_sparse_minibatches(vecs, ys, n_dev=4, global_batch_size=32)
+        h = split_hot_cold(s, hot_k=7, pad_multiple=8,
+                           slab_dtype=jnp.float32, model_size=2)
+        assert h.hot_k == 8 and h.dim_pad % 2 == 0 and h.dim_pad >= 33
+        r = train_glm_sparse_hotcold(
+            (jnp.zeros((33,), jnp.float32), jnp.zeros((), jnp.float32)),
+            h, "logistic", create_mesh({"data": 4, "model": 2}),
+            learning_rate=0.5, max_iter=8,
+        )
+        assert r.params[0].shape == (33,)
+        assert np.all(np.isfinite(r.params[0]))
+
+    def test_model_sharded_mesh_estimator(self):
+        """numHotFeatures on a ('data','model') mesh routes through the
+        feature-sharded slab path; predictions agree with the 1-D fit."""
         from flink_ml_tpu.parallel.mesh import create_mesh
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
-        vecs, ys = self._power_law_data(n=50, dim=16)
+        vecs, ys = self._power_law_data(n=300)
         t = Table.from_columns(SCHEMA, {"features": vecs, "label": ys})
+
+        def fit():
+            return (
+                LogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("pred")
+                .set_learning_rate(0.5).set_max_iter(30)
+                .set_global_batch_size(32).set_num_hot_features(8)
+                .fit(t)
+            )
+
+        m1 = fit()
         env = MLEnvironmentFactory.get_default()
         old = env.get_mesh()
         env.set_mesh(create_mesh({"data": 2, "model": 4}))
         try:
-            with pytest.raises(NotImplementedError, match="numHotFeatures"):
-                (
-                    LogisticRegression().set_vector_col("features")
-                    .set_label_col("label").set_prediction_col("p")
-                    .set_num_hot_features(4).set_global_batch_size(16)
-                    .set_num_features(16).fit(t)
-                )
+            m2 = fit()
         finally:
             env.set_mesh(old)
+        (p1,) = m1.transform(t)
+        (p2,) = m2.transform(t)
+        agree = np.mean(
+            np.asarray(p1.col("pred")) == np.asarray(p2.col("pred"))
+        )
+        assert agree >= 0.98, agree
+        # bf16 slab rounding differs only in grouping: coefficients close
+        np.testing.assert_allclose(
+            m2.coefficients(), m1.coefficients(), rtol=0.05, atol=0.02
+        )
 
 
 class TestSparseLinearRegression:
