@@ -33,7 +33,9 @@ from flink_ml_tpu.table.table import Table
 class _PeekedSource(UnboundedSource):
     """Re-yields a record peeked off a single-pass source, then the remainder
     of the SAME iterator — nothing is lost to the dim probe.  One-shot:
-    ``stream()`` may only be consumed once (like the source it wraps)."""
+    ``stream()`` may only be consumed once (like the source it wraps).
+    Deliberately leaves ``stream_chunks`` unsupported: the peek consumed
+    from the per-record view, so only that view is coherent."""
 
     def __init__(self, first, rest, inner: UnboundedSource):
         self._first = first
@@ -43,6 +45,34 @@ class _PeekedSource(UnboundedSource):
     def stream(self):
         yield self._first
         yield from self._rest
+
+    def schema(self):
+        return self._inner.schema()
+
+
+class _PeekedChunkSource(UnboundedSource):
+    """Chunk-protocol analog of :class:`_PeekedSource`: re-yields the chunk
+    peeked for the dim probe ahead of the same chunk iterator, preserving
+    the columnar fast path through the streaming driver.  One-shot."""
+
+    def __init__(self, first_chunk, rest, inner: UnboundedSource):
+        self._first = first_chunk
+        self._rest = rest
+        self._inner = inner
+
+    def stream_chunks(self, max_rows: int = 8192):
+        def chunks():
+            yield self._first
+            yield from self._rest
+
+        return chunks()
+
+    def stream(self):
+        from flink_ml_tpu.table.sources import chunk_row_iter
+
+        schema = self.schema()
+        for ts, cols in self.stream_chunks():
+            yield from chunk_row_iter(ts, cols, schema)
 
     def schema(self):
         return self._inner.schema()
@@ -86,6 +116,34 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
         """
         if self.get_feature_cols() is not None:
             return len(self.get_feature_cols()), source
+        chunks = (
+            source.stream_chunks()
+            if hasattr(source, "stream_chunks") else None
+        )
+        if chunks is not None:
+            # probe from the chunk view so the driver's vectorized ingest
+            # path stays available downstream
+            it = iter(chunks)
+            first = next(it, None)
+            while first is not None and len(first[0]) == 0:
+                first = next(it, None)
+            if first is None:
+                raise ValueError(
+                    "empty training stream; cannot infer feature dim"
+                )
+            schema = source.schema()
+            # canonical name: chunk columns are keyed by schema field names,
+            # the param lookup is case-insensitive (TableUtil.findColIndex)
+            name = schema.field_names[
+                schema.find_col_index(self.get_vector_col())
+            ]
+            col = first[1][name]
+            if isinstance(col, np.ndarray) and col.ndim == 2:
+                dim = int(col.shape[1])
+            else:
+                v = col[0]
+                dim = v.size() if v.size() >= 0 else v.to_dense().size()
+            return dim, _PeekedChunkSource(first, it, source)
         it = iter(source.stream())
         try:
             first = next(it)

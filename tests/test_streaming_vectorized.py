@@ -1,0 +1,403 @@
+"""Vectorized streaming ingest (columnar span processing) vs the per-record
+merge loop: the two drivers must produce IDENTICAL StreamingResults on
+time-ordered streams — same predictions (ts, value) for every record, same
+windows fired, same final state, same model history.  The vectorized path is
+the hot path (zero per-record Python); the per-record loop remains the
+semantics oracle and the out-of-order/checkpointed path."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.iteration.unbounded import StreamingDriver
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.sources import (
+    ColumnarUnboundedSource,
+    GeneratorSource,
+)
+
+TRAIN_SCHEMA = Schema.of(("x", "double"), ("y", "double"))
+PRED_SCHEMA = Schema.of(("x", "double"),)
+
+
+def _train_rows(n, seed=0, interval=7):
+    rng = np.random.RandomState(seed)
+    ts = np.arange(n, dtype=np.int64) * interval
+    x = rng.randn(n)
+    y = rng.randn(n)
+    return ts, x, y
+
+
+def _pred_rows(n, seed=1, interval=11, offset=3):
+    rng = np.random.RandomState(seed)
+    ts = np.arange(n, dtype=np.int64) * interval + offset
+    return ts, rng.randn(n)
+
+
+def _update(state, table, epoch):
+    # deterministic, order-sensitive: catches any row reordering
+    x = np.asarray(table.col("x"))
+    y = np.asarray(table.col("y"))
+    return state + float(np.sum(x * y)) + 0.001 * float(x[0]) * (epoch + 1)
+
+
+def _predict(state, table):
+    x = np.asarray(table.col("x"))
+    return (x * state).tolist()
+
+
+def _per_record_sources(ts_t, x, y, ts_p=None, xp=None):
+    """The same data as per-record sources (time_ordered=False forces the
+    merge-loop path)."""
+    train = GeneratorSource(
+        lambda: iter(
+            [(int(t), (float(a), float(b))) for t, a, b in zip(ts_t, x, y)]
+        ),
+        TRAIN_SCHEMA,
+    )
+    pred = None
+    if ts_p is not None:
+        pred = GeneratorSource(
+            lambda: iter([(int(t), (float(a),)) for t, a in zip(ts_p, xp)]),
+            PRED_SCHEMA,
+        )
+    return train, pred
+
+
+def _columnar_sources(ts_t, x, y, ts_p=None, xp=None, chunk_rows=64):
+    train = ColumnarUnboundedSource(
+        ts_t, {"x": x, "y": y}, TRAIN_SCHEMA, chunk_rows=chunk_rows
+    )
+    pred = None
+    if ts_p is not None:
+        pred = ColumnarUnboundedSource(
+            ts_p, {"x": xp}, PRED_SCHEMA, chunk_rows=chunk_rows
+        )
+    return train, pred
+
+
+def _run(driver_kwargs, train, pred, **run_kwargs):
+    driver = StreamingDriver(**driver_kwargs)
+    if pred is not None:
+        run_kwargs.setdefault("prediction_source", pred)
+        run_kwargs.setdefault("predict", _predict)
+    return driver.run(0.0, train, _update, **run_kwargs)
+
+
+def _assert_same(r_vec, r_rec):
+    assert r_vec.windows_fired == r_rec.windows_fired
+    assert r_vec.final_state == pytest.approx(r_rec.final_state, rel=1e-12)
+    assert len(r_vec.predictions) == len(r_rec.predictions)
+    for (t1, v1), (t2, v2) in zip(r_vec.predictions, r_rec.predictions):
+        assert t1 == t2
+        assert v1 == pytest.approx(v2, rel=1e-12)
+    assert [t for t, _ in r_vec.model_updates] == [
+        t for t, _ in r_rec.model_updates
+    ]
+    for (_, s1), (_, s2) in zip(r_vec.model_updates, r_rec.model_updates):
+        assert s1 == pytest.approx(s2, rel=1e-12)
+    assert r_vec.late_records == [] and r_rec.late_records == []
+
+
+class TestEquivalence:
+    def test_train_only(self):
+        ts, x, y = _train_rows(500)
+        kw = dict(window_ms=100, keep_model_history=True)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y))
+        assert r_vec.windows_fired > 3
+        _assert_same(r_vec, r_rec)
+
+    def test_train_and_predict(self):
+        ts, x, y = _train_rows(400)
+        tp, xp = _pred_rows(300)
+        kw = dict(window_ms=100, keep_model_history=True)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        assert len(r_vec.predictions) == 300
+        _assert_same(r_vec, r_rec)
+
+    def test_with_lateness_held_watermark(self):
+        """allowed_lateness holds windows open; ordered streams still fire
+        them in the same places on both paths."""
+        ts, x, y = _train_rows(400)
+        tp, xp = _pred_rows(250)
+        kw = dict(window_ms=100, allowed_lateness_ms=150,
+                  keep_model_history=True)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        _assert_same(r_vec, r_rec)
+
+    def test_small_flush_rows(self):
+        """Tiny prediction_flush_rows changes batch grouping, never values."""
+        ts, x, y = _train_rows(300)
+        tp, xp = _pred_rows(300)
+        kw = dict(window_ms=100, prediction_flush_rows=16)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        _assert_same(r_vec, r_rec)
+
+    @pytest.mark.parametrize("max_windows", [1, 3, 7])
+    def test_max_windows_stop(self, max_windows):
+        """Mid-stream stop: the vectorized path serves exactly the
+        predictions the per-record loop had consumed at its stopping
+        record."""
+        ts, x, y = _train_rows(400)
+        tp, xp = _pred_rows(400)
+        kw = dict(window_ms=100, keep_model_history=True)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp),
+                     max_windows=max_windows)
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp),
+                     max_windows=max_windows)
+        assert r_vec.windows_fired == max_windows
+        _assert_same(r_vec, r_rec)
+
+    def test_max_windows_firing_record_is_prediction(self):
+        """The record that advances the watermark past the stopping window
+        end is itself a prediction — it must be served, and nothing after."""
+        ts_t = np.asarray([10, 20, 110], dtype=np.int64)  # window [0,100) + next
+        x = np.asarray([1.0, 2.0, 3.0])
+        y = np.asarray([1.0, 1.0, 1.0])
+        # prediction at ts=105 arrives BEFORE the train record at 110; at
+        # ts=100 exactly the window end: fires the window itself
+        ts_p = np.asarray([5, 100, 100, 200], dtype=np.int64)
+        xp = np.asarray([1.0, 2.0, 3.0, 4.0])
+        kw = dict(window_ms=100)
+        r_vec = _run(kw, *_columnar_sources(ts_t, x, y, ts_p, xp),
+                     max_windows=1)
+        r_rec = _run(kw, *_per_record_sources(ts_t, x, y, ts_p, xp),
+                     max_windows=1)
+        _assert_same(r_vec, r_rec)
+        # the firing prediction (first at ts=100) is served; its twin at
+        # the same ts and everything later never consumed
+        assert [t for t, _ in r_vec.predictions] == [5, 100]
+
+    def test_pred_stream_outlives_train(self):
+        ts, x, y = _train_rows(100)
+        tp, xp = _pred_rows(400, interval=13)
+        kw = dict(window_ms=100)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        _assert_same(r_vec, r_rec)
+
+    def test_train_stream_outlives_pred(self):
+        ts, x, y = _train_rows(500)
+        tp, xp = _pred_rows(40)
+        kw = dict(window_ms=100)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        _assert_same(r_vec, r_rec)
+
+    def test_listener_epochs_match(self):
+        from flink_ml_tpu.iteration.listener import IterationListener
+
+        class Rec(IterationListener):
+            def __init__(self):
+                self.epochs = []
+                self.terminated = 0
+
+            def on_epoch_watermark_incremented(self, epoch, ctx, collector=None):
+                self.epochs.append(epoch)
+
+            def on_iteration_terminated(self, ctx, collector=None):
+                self.terminated += 1
+
+        ts, x, y = _train_rows(300)
+        l_vec, l_rec = Rec(), Rec()
+        _run(dict(window_ms=100), *_columnar_sources(ts, x, y),
+             listeners=[l_vec])
+        _run(dict(window_ms=100), *_per_record_sources(ts, x, y),
+             listeners=[l_rec])
+        assert l_vec.epochs == l_rec.epochs and l_vec.epochs
+        assert l_vec.terminated == l_rec.terminated == 1
+
+    def test_chunk_boundary_straddles_window(self):
+        """Windows spanning chunk boundaries accumulate across spans."""
+        ts, x, y = _train_rows(257)  # prime-ish vs chunk_rows=32
+        kw = dict(window_ms=1000)    # few big windows
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, chunk_rows=32))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y))
+        _assert_same(r_vec, r_rec)
+
+    def test_generator_source_time_ordered_takes_chunk_path(self):
+        """linear_timestamps declares time order, so its chunk view exists
+        and matches the per-record run."""
+        rows = [(float(i), float(i % 3)) for i in range(200)]
+        src = GeneratorSource.linear_timestamps(rows, 7, TRAIN_SCHEMA)
+        assert src.stream_chunks() is not None
+        r_vec = StreamingDriver(window_ms=100).run(0.0, src, _update)
+        src2 = GeneratorSource(
+            lambda: iter([(i * 7, r) for i, r in enumerate(rows)]),
+            TRAIN_SCHEMA,
+        )
+        assert src2.stream_chunks() is None
+        r_rec = StreamingDriver(window_ms=100).run(0.0, src2, _update)
+        _assert_same(r_vec, r_rec)
+
+
+class TestColumnarSource:
+    def test_rejects_unordered_timestamps(self):
+        with pytest.raises(ValueError, match="non-decreasing"):
+            ColumnarUnboundedSource(
+                [3, 1, 2], {"x": [1.0, 2.0, 3.0]}, PRED_SCHEMA
+            )
+
+    def test_rejects_length_mismatch(self):
+        with pytest.raises(ValueError, match="length"):
+            ColumnarUnboundedSource([1, 2], {"x": [1.0]}, PRED_SCHEMA)
+
+    def test_rejects_missing_column(self):
+        with pytest.raises(ValueError, match="missing column"):
+            ColumnarUnboundedSource([1], {"z": [1.0]}, PRED_SCHEMA)
+
+    def test_per_record_view_matches_chunks(self):
+        """stream() decodes the same records the chunk view carries,
+        including matrix-backed vector columns as DenseVectors."""
+        from flink_ml_tpu.ops.vector import DenseVector
+
+        schema = Schema.of(
+            ("features", DataTypes.DENSE_VECTOR), ("label", "double")
+        )
+        X = np.arange(12, dtype=np.float64).reshape(4, 3)
+        src = ColumnarUnboundedSource(
+            [0, 1, 2, 3],
+            {"features": X, "label": np.asarray([0.0, 1.0, 0.0, 1.0])},
+            schema, chunk_rows=3,
+        )
+        recs = list(src.stream())
+        assert [t for t, _ in recs] == [0, 1, 2, 3]
+        assert type(recs[0][1][0]) is DenseVector
+        np.testing.assert_array_equal(recs[2][1][0].values, X[2])
+
+    def test_driver_validates_chunk_order_violation(self):
+        """A lying time_ordered generator fails loudly, not silently."""
+        rows = [(0, (1.0,)), (10, (2.0,)), (5, (3.0,))]
+        src = GeneratorSource(lambda: iter(rows), PRED_SCHEMA,
+                              time_ordered=True)
+        with pytest.raises(ValueError, match="out-of-order"):
+            StreamingDriver(window_ms=100).run(
+                0.0, src, lambda s, t, e: s
+            )
+
+
+class TestReviewRegressions:
+    def test_case_insensitive_vector_col_chunk_probe(self):
+        """The dim probe resolves the vector column case-insensitively on
+        the chunk path, like the per-record probe (TableUtil.findColIndex
+        semantics)."""
+        from flink_ml_tpu.lib.online import OnlineLogisticRegression
+
+        rng = np.random.RandomState(0)
+        n, d = 200, 4
+        X = rng.randn(n, d)
+        y = (rng.randn(n) > 0).astype(np.float64)
+        schema = Schema.of(
+            ("Features", DataTypes.DENSE_VECTOR), ("label", "double")
+        )
+        src = ColumnarUnboundedSource(
+            np.arange(n, dtype=np.int64) * 10,
+            {"Features": X, "label": y}, schema,
+        )
+        model, result = (
+            OnlineLogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_window_ms(500).fit_unbounded(src)
+        )
+        assert model.coefficients().shape == (d,)
+        assert result.windows_fired > 0
+
+    def test_mixed_matrix_and_list_segments_in_one_window(self):
+        """Adjacent chunks of the same vector column columnizing
+        differently (matrix vs object list — one ragged chunk) must still
+        concatenate into a valid window table."""
+        from flink_ml_tpu.ops.vector import DenseVector, SparseVector
+
+        schema = Schema.of(
+            ("features", DataTypes.VECTOR), ("label", "double")
+        )
+        # chunk 1: all dense width-3 (matrix-backed); chunk 2: one sparse
+        # row forces the object-list form; both land in window [0, 1000)
+        rows = [(DenseVector(np.asarray([float(i), 0.0, 1.0])), 1.0)
+                for i in range(4)]
+        rows += [(SparseVector(3, np.asarray([1]), np.asarray([2.0])), 0.0),
+                 (DenseVector(np.asarray([9.0, 9.0, 9.0])), 1.0)]
+        src = GeneratorSource(
+            lambda: iter([(i * 10, r) for i, r in enumerate(rows)]),
+            schema, time_ordered=True, chunk_rows=4,
+        )
+        seen = []
+
+        def upd(state, table, epoch):
+            seen.append(table.num_rows())
+            # every row readable as a vector
+            for v in table.col("features"):
+                assert v.to_dense().size() == 3
+            return state
+
+        r = StreamingDriver(window_ms=1000).run(0.0, src, upd)
+        assert r.windows_fired == 1 and seen == [6]
+
+    def test_generator_chunk_rows_bounds_ingest_latency(self):
+        """chunk_rows controls how much a time-ordered generator buffers
+        before the driver can fire — a live source can match it to its
+        window size."""
+        rows = [(float(i), 1.0) for i in range(10)]
+        fired_at = []
+
+        def gen():
+            for i, r in enumerate(rows):
+                yield i * 100, r
+
+        src = GeneratorSource(gen, TRAIN_SCHEMA, time_ordered=True,
+                              chunk_rows=2)
+        chunks = src.stream_chunks()
+        first = next(iter(chunks))
+        assert len(first[0]) == 2  # yields after 2 records, not 8192
+        r = StreamingDriver(window_ms=200).run(
+            0.0, GeneratorSource(gen, TRAIN_SCHEMA, time_ordered=True,
+                                 chunk_rows=2),
+            lambda s, t, e: fired_at.append(e) or s,
+        )
+        assert r.windows_fired == 5 and fired_at == [0, 1, 2, 3, 4]
+
+
+class TestVectorizedStreamingEstimator:
+    def test_online_lr_columnar_source(self):
+        """OnlineLogisticRegression over a ColumnarUnboundedSource: the
+        matrix-backed feature column rides zero-copy into the window
+        update; results match the per-record GeneratorSource run."""
+        from flink_ml_tpu.lib.online import OnlineLogisticRegression
+        from flink_ml_tpu.ops.vector import DenseVector
+
+        rng = np.random.RandomState(7)
+        n, d = 2000, 8
+        X = rng.randn(n, d)
+        true_w = rng.randn(d)
+        y = ((X @ true_w) > 0).astype(np.float64)
+        schema = Schema.of(
+            ("features", DataTypes.DENSE_VECTOR), ("label", "double")
+        )
+        ts = np.arange(n, dtype=np.int64) * 10
+
+        def est():
+            return (
+                OnlineLogisticRegression().set_vector_col("features")
+                .set_label_col("label").set_prediction_col("p")
+                .set_learning_rate(0.5).set_window_ms(1000)
+            )
+
+        m_vec, r_vec = est().fit_unbounded(
+            ColumnarUnboundedSource(
+                ts, {"features": X, "label": y}, schema
+            )
+        )
+        rows = [(DenseVector(X[i]), y[i]) for i in range(n)]
+        m_rec, r_rec = est().fit_unbounded(
+            GeneratorSource(
+                lambda: iter([(int(ts[i]), rows[i]) for i in range(n)]),
+                schema,
+            )
+        )
+        assert r_vec.windows_fired == r_rec.windows_fired
+        np.testing.assert_allclose(
+            m_vec.coefficients(), m_rec.coefficients(), rtol=1e-6
+        )
