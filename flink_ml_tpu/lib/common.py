@@ -272,6 +272,37 @@ def _sparse_layout(n: int, n_dev: int, global_batch_size: int, min_steps: int):
     return mb, steps, n_dev * steps, group_lo
 
 
+def sparse_row_counts(vectors) -> np.ndarray:
+    """Stored-entry count per row (CsrRows: vectorized; else per object)."""
+    from flink_ml_tpu.ops.batch import CsrRows
+
+    if isinstance(vectors, CsrRows):
+        return vectors.nnz_per_row()
+    return np.fromiter(
+        (len(v.indices) for v in vectors), np.int64, len(vectors)
+    )
+
+
+def sparse_layout_floors(counts: np.ndarray, n_dev: int,
+                         global_batch_size: int,
+                         pad_multiple: int = 512):
+    """(nnz_pad, steps) the pack WOULD choose for these row counts — without
+    materializing the stack.  The multi-process agreement pre-scan: each
+    process computes its local value here, ``agree_max`` reconciles them,
+    and the single pack runs with the agreed floors (no throwaway pack)."""
+    n = int(len(counts))
+    mb, steps, n_groups, group_lo = _sparse_layout(
+        n, n_dev, global_batch_size, 0
+    )
+    csum = np.concatenate([[0], np.cumsum(np.asarray(counts, np.int64))])
+    los = np.minimum(
+        np.asarray([group_lo(g) for g in range(n_groups)], np.int64), n
+    )
+    his = np.minimum(los + mb, n)
+    nnz_max = max(1, int((csum[his] - csum[los]).max(initial=0)))
+    return -(-nnz_max // pad_multiple) * pad_multiple, steps
+
+
 def _pack_sparse_minibatches_csr(
     rows, y, n_dev: int, global_batch_size: int, dim, pad_multiple: int,
     min_nnz_pad: int, min_steps: int,

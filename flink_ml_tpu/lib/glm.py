@@ -335,21 +335,56 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             raise NotImplementedError(
                 f"{type(self).__name__} has no sparse loss kind"
             )
-        from flink_ml_tpu.parallel.mesh import require_single_process
+        import jax as _jax
 
-        # the packed nnz_pad is data-dependent, so per-process shards would
-        # compile mismatched block shapes across processes
-        require_single_process("sparse training from per-process shards")
+        from flink_ml_tpu.parallel.mesh import agree_max
+
         num_features = self.get_num_features()
+        if _jax.process_count() > 1 and num_features is None:
+            raise ValueError(
+                "multi-process sparse training requires numFeatures (each "
+                "process would otherwise infer a different dimension from "
+                "its own file shard)"
+            )
+        # multi-process: the packed nnz width and step count derive from
+        # LOCAL rows, but every process must compile the same block shapes.
+        # A cheap pre-scan (row counts only, no stack materialized) computes
+        # the local layout scalars, agree_max reconciles them, and the ONE
+        # pack runs with the agreed floors.  The nnz floor is schedule-
+        # neutral (pad entries carry zero weight); the steps floor only
+        # differs when shards are unequal-sized, where the shorter shard's
+        # trailing all-pad steps contribute zero gradient (with reg > 0
+        # those steps still apply weight decay, like any zero-gradient step)
+        if _jax.process_count() > 1:
+            from flink_ml_tpu.lib.common import (
+                sparse_layout_floors,
+                sparse_row_counts,
+            )
+
+            counts = sparse_row_counts(table.col(self.get_vector_col()))
+            nnz_pad, steps = agree_max(
+                *sparse_layout_floors(counts, n_dev, batch_share)
+            )
+        else:
+            nnz_pad, steps = 0, 0  # pack's own natural layout
         layout_key = ("sparse", self.get_vector_col(), self.get_label_col(),
-                      n_dev, batch_share, num_features)
+                      n_dev, batch_share, num_features, nnz_pad, steps)
         sstack = table.cached_pack(
             layout_key,
             lambda: pack_sparse_minibatches(
                 table.col(self.get_vector_col()), y, n_dev,
                 batch_share, dim=num_features,
+                min_nnz_pad=nnz_pad, min_steps=steps,
             ),
         )
+        if nnz_pad and (sstack.nnz_pad, sstack.steps) != (nnz_pad, steps):
+            # the pre-scan must predict the pack's layout exactly, or the
+            # processes compile mismatched shapes and the collective hangs
+            raise AssertionError(
+                f"sparse layout pre-scan predicted (nnz_pad={nnz_pad}, "
+                f"steps={steps}) but the pack chose "
+                f"({sstack.nnz_pad}, {sstack.steps})"
+            )
         from flink_ml_tpu.parallel.mesh import shard_batch
 
         hot_k = int(self.get_num_hot_features() or 0)
@@ -391,7 +426,12 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             split_hot_cold,
             train_glm_sparse_hotcold,
         )
+        from flink_ml_tpu.parallel.mesh import require_single_process
 
+        # each process would pick hot features from its OWN shard's
+        # frequencies and permute weights differently — needs a cross-
+        # process count allreduce before the split
+        require_single_process("hot/cold sparse training (numHotFeatures)")
         model_size = dict(mesh.shape).get("model", 1)
         # thunks: the host split AND the device slab build resolve lazily,
         # so a no-op checkpoint resume pays neither

@@ -60,12 +60,24 @@ class _PeekedChunkSource(UnboundedSource):
         self._rest = rest
         self._inner = inner
 
-    def stream_chunks(self, max_rows: int = 8192):
-        def chunks():
+    def stream_chunks(self, max_rows: Optional[int] = None):
+        def all_chunks():
             yield self._first
             yield from self._rest
 
-        return chunks()
+        if max_rows is None:
+            return all_chunks()
+
+        step = int(max_rows)
+
+        def resliced():
+            # honor the caller's chunk bound by re-slicing buffered chunks
+            for ts, cols in all_chunks():
+                for a in range(0, len(ts), step):
+                    b = a + step
+                    yield ts[a:b], {k: v[a:b] for k, v in cols.items()}
+
+        return resliced()
 
     def stream(self):
         from flink_ml_tpu.table.sources import chunk_row_iter

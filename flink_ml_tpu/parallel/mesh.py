@@ -91,6 +91,27 @@ def local_batch_share(global_batch_size):
     return global_batch_size // p
 
 
+def agree_max(*values: int):
+    """Cross-process agreement on data-dependent layout scalars: the
+    element-wise MAX over all processes (identity single-process).
+
+    Multi-process compiled programs need identical static shapes on every
+    process, but layout scalars like the sparse stack's padded nnz width
+    derive from each process's local rows.  Each process computes its local
+    value, all processes agree on the max, and packers accept the agreed
+    value as a floor (``min_nnz_pad`` / ``min_steps``) — padding is free
+    (pad entries carry zero weight), divergence is a hang or a silent
+    wrong answer."""
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(values, np.int64)
+    )
+    return tuple(int(v) for v in np.max(gathered, axis=0))
+
+
 def require_single_process(what: str) -> None:
     """Loud guard for paths whose multi-process semantics are not yet
     defined (data-dependent per-process layout or init would silently

@@ -102,3 +102,70 @@ def fit_shard_table(table):
     w = np.asarray(mt.col("coefficients")[0].to_dense().values)
     b = float(mt.col("intercept")[0])
     return w, b
+
+
+# -- per-process SPARSE shard fit (cross-process nnz_pad agreement) -----------
+
+SPARSE_DIM = 2048
+#: per-process nnz density — deliberately UNEQUAL so the local packs land on
+#: different padded nnz widths (512 vs 1024 at pad_multiple=512) and the
+#: cross-process agree_max repack is genuinely exercised, not a no-op
+SPARSE_NNZ_BASE = 5
+SPARSE_NNZ_STEP = 145
+
+
+def make_sparse_shard_rows(num_processes):
+    """One (vectors, y) block per process shard; process p's rows carry
+    ``SPARSE_NNZ_BASE + p * SPARSE_NNZ_STEP`` stored entries each."""
+    from flink_ml_tpu.ops.vector import SparseVector
+
+    rng = np.random.RandomState(13)
+    true_w = rng.randn(SPARSE_DIM)
+    shards = []
+    for p in range(num_processes):
+        nnz = SPARSE_NNZ_BASE + p * SPARSE_NNZ_STEP
+        vecs, ys = [], []
+        for _ in range(SHARD_ROWS):
+            idx = np.sort(rng.choice(SPARSE_DIM, nnz, replace=False))
+            vals = rng.randn(nnz)
+            vecs.append(SparseVector(SPARSE_DIM, idx.astype(np.int64), vals))
+            ys.append(float((vals @ true_w[idx]) > 0))
+        shards.append((vecs, np.asarray(ys)))
+    return shards
+
+
+def sparse_shard_schema():
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+
+    return Schema.of(
+        ("features", DataTypes.SPARSE_VECTOR), ("label", "double")
+    )
+
+
+def interleaved_sparse_rows(shards, num_processes):
+    """Single-process row order equivalent to the multi-process sparse
+    schedule (same windowing rule as :func:`interleaved_rows`)."""
+    g_local = SHARD_G // num_processes
+    vecs, ys = [], []
+    for start in range(0, SHARD_ROWS, g_local):
+        for p in range(num_processes):
+            vecs.extend(shards[p][0][start:start + g_local])
+            ys.extend(shards[p][1][start:start + g_local])
+    return vecs, np.asarray(ys)
+
+
+def fit_sparse_shard_table(table):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    est = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_num_features(SPARSE_DIM)
+        .set_learning_rate(LEARNING_RATE).set_max_iter(SHARD_EPOCHS)
+        .set_global_batch_size(SHARD_G)
+    )
+    model = est.fit(table)
+    (mt,) = model.get_model_data()
+    w = np.asarray(mt.col("coefficients")[0].to_dense().values)
+    b = float(mt.col("intercept")[0])
+    return w, b

@@ -120,4 +120,30 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # SPARSE per-process fit: the shards carry deliberately UNEQUAL nnz
+    # densities, so each process's local pack lands on a different padded
+    # nnz width and the cross-process agree_max repack (parallel/mesh.py)
+    # must reconcile the compiled block shapes before the fused loop runs
+    from tests._distributed_common import (
+        fit_sparse_shard_table,
+        make_sparse_shard_rows,
+        sparse_shard_schema,
+    )
+    from flink_ml_tpu.table.table import Table
+
+    svecs, sy = make_sparse_shard_rows(num_processes)[process_id]
+    sparse_table = Table.from_columns(
+        sparse_shard_schema(), {"features": svecs, "label": sy}
+    )
+    w_sp, b_sp = fit_sparse_shard_table(sparse_table)
+    # the weight vector is 2048-dim: print a stable digest + probe slice
+    digest = [float(np.sum(w_sp)), float(np.sum(w_sp * w_sp))]
+    probe = [float(v) for v in w_sp[:8]]
+    print(
+        "FITSPARSE " + " ".join(
+            f"{v:.9e}" for v in digest + probe + [b_sp]
+        ),
+        flush=True,
+    )
+
 shutdown_distributed()

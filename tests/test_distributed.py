@@ -117,3 +117,36 @@ def test_two_process_mesh_psum(tmp_path):
                     "from the single-process interleaved-order fit"
                 ),
             )
+
+    # -- sparse per-process fit (cross-process nnz_pad agreement) -------------
+    # the shards' nnz densities are unequal by construction, so the workers'
+    # local packs disagree on the padded width until agree_max reconciles
+    # them; the result must equal the single-process interleaved-order fit
+    from tests._distributed_common import (
+        fit_sparse_shard_table,
+        interleaved_sparse_rows,
+        make_sparse_shard_rows,
+        sparse_shard_schema,
+    )
+
+    sshards = make_sparse_shard_rows(2)
+    svecs, sy = interleaved_sparse_rows(sshards, 2)
+    sref = Table.from_columns(
+        sparse_shard_schema(), {"features": svecs, "label": sy}
+    )
+    w_sref, b_sref = fit_sparse_shard_table(sref)
+    expected_sparse = (
+        [float(np.sum(w_sref)), float(np.sum(w_sref * w_sref))]
+        + [float(v) for v in w_sref[:8]] + [b_sref]
+    )
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITSPARSE ")]
+        assert line, f"worker {pid} printed no FITSPARSE line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_sparse, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid} FITSPARSE: per-process sparse fit diverged "
+                "from the single-process interleaved-order fit"
+            ),
+        )

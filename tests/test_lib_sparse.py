@@ -418,6 +418,70 @@ class TestHotColdSplit:
         )
 
 
+class TestLayoutFloors:
+    def test_min_floors_are_schedule_neutral(self):
+        """Packing with min_nnz_pad / min_steps floors (the multi-process
+        agree_max repack) trains bit-identically to the unfloored pack —
+        pad entries carry zero weight and extra steps carry zero rows."""
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.lib.common import train_glm_sparse
+        from flink_ml_tpu.parallel.mesh import default_mesh
+
+        vecs, ys, _ = sparse_data(n=120, dim=40, nnz=4, seed=8)
+        mesh = default_mesh()
+        base = pack_sparse_minibatches(vecs, ys, n_dev=8, global_batch_size=32)
+        floored = pack_sparse_minibatches(
+            vecs, ys, n_dev=8, global_batch_size=32,
+            min_nnz_pad=base.nnz_pad * 2, min_steps=base.steps + 3,
+        )
+        assert floored.nnz_pad == base.nnz_pad * 2
+        assert floored.steps == base.steps + 3
+        p0 = lambda: (  # noqa: E731
+            jnp.zeros((40,), jnp.float32), jnp.zeros((), jnp.float32)
+        )
+        r1 = train_glm_sparse(p0(), base, "logistic", mesh,
+                              learning_rate=0.5, max_iter=10)
+        r2 = train_glm_sparse(p0(), floored, "logistic", mesh,
+                              learning_rate=0.5, max_iter=10)
+        np.testing.assert_array_equal(
+            np.asarray(r1.params[0]), np.asarray(r2.params[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r1.params[1]), np.asarray(r2.params[1])
+        )
+
+    def test_agree_max_single_process_identity(self):
+        from flink_ml_tpu.parallel.mesh import agree_max
+
+        assert agree_max(512, 7) == (512, 7)
+
+    def test_layout_prescan_predicts_pack_exactly(self):
+        """sparse_layout_floors must predict the pack's natural layout for
+        both column forms — a divergence would hang multi-process runs
+        (the estimator asserts this at fit time too)."""
+        from flink_ml_tpu.lib.common import (
+            sparse_layout_floors,
+            sparse_row_counts,
+        )
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        for n, nnz, gbs in [(120, 4, 32), (37, 2, 0), (64, 7, 16)]:
+            vecs, ys, _ = sparse_data(n=n, dim=40, nnz=nnz, seed=n)
+            s = pack_sparse_minibatches(vecs, ys, n_dev=4,
+                                        global_batch_size=gbs)
+            counts = sparse_row_counts(vecs)
+            assert sparse_layout_floors(counts, 4, gbs) == (s.nnz_pad, s.steps)
+            # CSR column form: same counts, same prediction
+            indptr = np.concatenate([[0], np.cumsum(counts)])
+            csr = CsrRows(
+                40, indptr,
+                np.concatenate([v.indices for v in vecs]),
+                np.concatenate([v.vals for v in vecs]),
+            )
+            np.testing.assert_array_equal(sparse_row_counts(csr), counts)
+
+
 class TestSparseLinearRegression:
     def test_sparse_squared_loss_converges(self):
         rng = np.random.RandomState(5)
