@@ -915,21 +915,28 @@ class HotColdStack:
         return self.dim_pad // self.model_size
 
 
-def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
-                   pad_multiple: int = 512,
-                   slab_dtype=jnp.bfloat16,
-                   model_size: int = 1) -> HotColdStack:
-    """Frequency analysis + feature permutation + per-group entry split.
+def hotcold_entry_counts(sstack: SparseMinibatchStack) -> np.ndarray:
+    """Stored-entry count per feature over the stack's valid entries — THE
+    frequency vector the hot/cold split selects from (multi-process callers
+    ``agree_sum`` this before splitting)."""
+    valid = sstack.ints[:, 1, :] < sstack.mb
+    return np.bincount(
+        sstack.ints[:, 0, :][valid].ravel(), minlength=sstack.dim
+    )
 
-    The ``hot_k`` features with the most stored entries (ties broken by
-    lower id) become slab columns; everything else keeps segment-CSR form
-    with ids remapped into the permuted cold range.  ``model_size > 1``
-    produces the feature-sharded layout documented on
-    :class:`HotColdStack` (``hot_k`` rounds up to a model-axis multiple;
-    the extra slab columns are dead)."""
-    ints, floats = sstack.ints, sstack.floats
-    mb, nnz_pad, dim = sstack.mb, sstack.nnz_pad, sstack.dim
-    n_groups = ints.shape[0]
+
+def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
+                  pad_multiple: int, model_size: int,
+                  counts: Optional[np.ndarray]):
+    """The deterministic first half of the hot/cold split: hot selection,
+    permutation, per-entry masks, and the NATURAL pad widths — everything
+    except materializing the entry arrays.  Shared by :func:`split_hot_cold`
+    (which fills) and :func:`hotcold_layout_floors` (the multi-process
+    pre-scan), so the two cannot drift.  ``counts`` overrides the local
+    frequency analysis with externally-agreed (global) counts; it must have
+    length ``dim``."""
+    ints = sstack.ints
+    mb, dim = sstack.mb, sstack.dim
     model_size = int(max(model_size, 1))
     n_hot = int(min(max(hot_k, 1), dim))
     hot_k_eff = -(-n_hot // model_size) * model_size
@@ -942,7 +949,14 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
     idx = ints[:, 0, :]
     rid = ints[:, 1, :]
     valid = rid < mb
-    counts = np.bincount(idx[valid].ravel(), minlength=dim)
+    if counts is None:
+        counts = hotcold_entry_counts(sstack)
+    else:
+        counts = np.asarray(counts)
+        if counts.shape != (dim,):
+            raise ValueError(
+                f"counts must have shape ({dim},), got {counts.shape}"
+            )
     order = np.lexsort((np.arange(dim), -counts))  # by count desc, id asc
     hot_ids = np.sort(order[:n_hot])
     # slab column per hot feature (rank in id order); -1 marks cold
@@ -970,6 +984,65 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
                   * pad_multiple, pad_multiple)
     cold_pad = max(-(-int(cold_counts.max(initial=1)) // pad_multiple)
                    * pad_multiple, pad_multiple)
+    return dict(
+        hot_k_eff=hot_k_eff, dim_pad=dim_pad, perm=perm, inv_perm=inv_perm,
+        ranks=ranks, new_idx=new_idx, is_hot=is_hot, is_cold=is_cold,
+        hot_counts=hot_counts, cold_counts=cold_counts,
+        hot_pad=hot_pad, cold_pad=cold_pad,
+    )
+
+
+def hotcold_layout_floors(sstack: SparseMinibatchStack, hot_k: int,
+                          pad_multiple: int = 512, model_size: int = 1,
+                          counts: Optional[np.ndarray] = None):
+    """((hot_pad, cold_pad), plan) the split WOULD choose — the
+    multi-process pre-scan (same contract as :func:`sparse_layout_floors`):
+    each process computes its local pads from the globally-agreed
+    ``counts``, agree_max reconciles them, and the one split runs with the
+    agreed floors.  Pass the returned ``plan`` back to
+    :func:`split_hot_cold` so the O(entries) mask/permutation work runs
+    once, not twice."""
+    plan = _hotcold_plan(sstack, hot_k, pad_multiple, model_size, counts)
+    return (plan["hot_pad"], plan["cold_pad"]), plan
+
+
+def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
+                   pad_multiple: int = 512,
+                   slab_dtype=jnp.bfloat16,
+                   model_size: int = 1,
+                   counts: Optional[np.ndarray] = None,
+                   min_hot_pad: int = 0,
+                   min_cold_pad: int = 0,
+                   plan: Optional[dict] = None) -> HotColdStack:
+    """Frequency analysis + feature permutation + per-group entry split.
+
+    The ``hot_k`` features with the most stored entries (ties broken by
+    lower id) become slab columns; everything else keeps segment-CSR form
+    with ids remapped into the permuted cold range.  ``model_size > 1``
+    produces the feature-sharded layout documented on
+    :class:`HotColdStack` (``hot_k`` rounds up to a model-axis multiple;
+    the extra slab columns are dead).  Multi-process: pass the globally
+    summed ``counts`` (every process must select the same hot set) and the
+    agreed pad floors (``min_hot_pad``/``min_cold_pad``) so all processes
+    fill identical shapes.  ``plan`` short-circuits the analysis phase with
+    the plan :func:`hotcold_layout_floors` already computed — the caller
+    owns the invariant that it came from the same (sstack, hot_k,
+    model_size, counts)."""
+    ints, floats = sstack.ints, sstack.floats
+    mb, nnz_pad, dim = sstack.mb, sstack.nnz_pad, sstack.dim
+    n_groups = ints.shape[0]
+    model_size = int(max(model_size, 1))
+    if plan is None:
+        plan = _hotcold_plan(sstack, hot_k, pad_multiple, model_size, counts)
+    hot_k_eff = plan["hot_k_eff"]
+    dim_pad = plan["dim_pad"]
+    perm, inv_perm = plan["perm"], plan["inv_perm"]
+    ranks, new_idx = plan["ranks"], plan["new_idx"]
+    is_hot, is_cold = plan["is_hot"], plan["is_cold"]
+    hot_counts, cold_counts = plan["hot_counts"], plan["cold_counts"]
+    rid = ints[:, 1, :]
+    hot_pad = max(plan["hot_pad"], int(min_hot_pad))
+    cold_pad = max(plan["cold_pad"], int(min_cold_pad))
 
     hot_ints = np.zeros((n_groups, 2, hot_pad), dtype=np.int32)
     hot_ints[:, 1, :] = mb  # pad row id -> dropped row

@@ -335,17 +335,24 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             raise NotImplementedError(
                 f"{type(self).__name__} has no sparse loss kind"
             )
-        import jax as _jax
-
         from flink_ml_tpu.parallel.mesh import agree_max
 
         num_features = self.get_num_features()
-        if _jax.process_count() > 1 and num_features is None:
-            raise ValueError(
-                "multi-process sparse training requires numFeatures (each "
-                "process would otherwise infer a different dimension from "
-                "its own file shard)"
-            )
+        if jax.process_count() > 1:
+            if num_features is None:
+                raise ValueError(
+                    "multi-process sparse training requires numFeatures "
+                    "(each process would otherwise infer a different "
+                    "dimension from its own file shard)"
+                )
+            if not batch_share or batch_share <= 0:
+                raise ValueError(
+                    "multi-process sparse training requires an explicit "
+                    "globalBatchSize: the full-batch default would derive "
+                    "the per-device minibatch from each process's LOCAL "
+                    "row count, compiling mismatched block shapes when "
+                    "shards are unequal"
+                )
         # multi-process: the packed nnz width and step count derive from
         # LOCAL rows, but every process must compile the same block shapes.
         # A cheap pre-scan (row counts only, no stack materialized) computes
@@ -355,7 +362,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         # differs when shards are unequal-sized, where the shorter shard's
         # trailing all-pad steps contribute zero gradient (with reg > 0
         # those steps still apply weight decay, like any zero-gradient step)
-        if _jax.process_count() > 1:
+        if jax.process_count() > 1:
             from flink_ml_tpu.lib.common import (
                 sparse_layout_floors,
                 sparse_row_counts,
@@ -428,16 +435,42 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         )
         from flink_ml_tpu.parallel.mesh import require_single_process
 
-        # each process would pick hot features from its OWN shard's
-        # frequencies and permute weights differently — needs a cross-
-        # process count allreduce before the split
-        require_single_process("hot/cold sparse training (numHotFeatures)")
         model_size = dict(mesh.shape).get("model", 1)
+        counts = None
+        plan = None
+        min_hot_pad = min_cold_pad = 0
+        if jax.process_count() > 1:
+            if model_size > 1:
+                # the model-axis weight placement (device_put to a global
+                # NamedSharding) is single-controller; multi-process needs
+                # a per-process model-shard assembly first
+                require_single_process(
+                    "feature-sharded (2-D) hot/cold training"
+                )
+            # every process must select the same hot set and fill the same
+            # shapes: agree on the GLOBAL frequency vector (sum of local
+            # entry counts) and the max pad widths before splitting
+            from flink_ml_tpu.lib.common import (
+                hotcold_entry_counts,
+                hotcold_layout_floors,
+            )
+            from flink_ml_tpu.parallel.mesh import agree_max, agree_sum
+
+            counts = agree_sum(hotcold_entry_counts(sstack))
+            (hp, cp), plan = hotcold_layout_floors(
+                sstack, hot_k, model_size=model_size, counts=counts
+            )
+            min_hot_pad, min_cold_pad = agree_max(hp, cp)
         # thunks: the host split AND the device slab build resolve lazily,
         # so a no-op checkpoint resume pays neither
         hstack = lambda: table.cached_pack(  # noqa: E731
-            layout_key + ("hot", hot_k, model_size),
-            lambda: split_hot_cold(sstack, hot_k, model_size=model_size),
+            layout_key + ("hot", hot_k, model_size, min_hot_pad,
+                          min_cold_pad),
+            lambda: split_hot_cold(
+                sstack, hot_k, model_size=model_size, counts=counts,
+                min_hot_pad=min_hot_pad, min_cold_pad=min_cold_pad,
+                plan=plan,
+            ),
         )
         device_batch = lambda: table.cached_pack(  # noqa: E731
             layout_key + ("hotdev", hot_k, mesh),

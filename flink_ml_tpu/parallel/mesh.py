@@ -112,6 +112,19 @@ def agree_max(*values: int):
     return tuple(int(v) for v in np.max(gathered, axis=0))
 
 
+def agree_sum(array: np.ndarray) -> np.ndarray:
+    """Cross-process element-wise SUM (identity single-process) — e.g. the
+    global feature-frequency vector every process must derive identically
+    before a hot/cold split (each process only sees its own shard's
+    counts)."""
+    if jax.process_count() == 1:
+        return np.asarray(array)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(array))
+    return np.sum(gathered, axis=0)
+
+
 def require_single_process(what: str) -> None:
     """Loud guard for paths whose multi-process semantics are not yet
     defined (data-dependent per-process layout or init would silently

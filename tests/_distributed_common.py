@@ -154,7 +154,7 @@ def interleaved_sparse_rows(shards, num_processes):
     return vecs, np.asarray(ys)
 
 
-def fit_sparse_shard_table(table):
+def fit_sparse_shard_table(table, hot_k: int = 0):
     from flink_ml_tpu.lib import LogisticRegression
 
     est = (
@@ -164,6 +164,8 @@ def fit_sparse_shard_table(table):
         .set_learning_rate(LEARNING_RATE).set_max_iter(SHARD_EPOCHS)
         .set_global_batch_size(SHARD_G)
     )
+    if hot_k:
+        est.set_num_hot_features(hot_k)
     model = est.fit(table)
     (mt,) = model.get_model_data()
     w = np.asarray(mt.col("coefficients")[0].to_dense().values)

@@ -146,4 +146,17 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # hot/cold fit across processes: the hot set must come from the GLOBAL
+    # frequency vector (agree_sum of per-shard counts — each shard's local
+    # top-K differs) and both processes must fill the agreed pad widths
+    w_hc, b_hc = fit_sparse_shard_table(sparse_table, hot_k=16)
+    digest = [float(np.sum(w_hc)), float(np.sum(w_hc * w_hc))]
+    probe = [float(v) for v in w_hc[:8]]
+    print(
+        "FITHOT " + " ".join(
+            f"{v:.9e}" for v in digest + probe + [b_hc]
+        ),
+        flush=True,
+    )
+
 shutdown_distributed()

@@ -150,3 +150,25 @@ def test_two_process_mesh_psum(tmp_path):
                 "from the single-process interleaved-order fit"
             ),
         )
+
+    # hot/cold across processes: hot selection from the globally-summed
+    # frequency vector, pad widths from agree_max — must equal the
+    # single-process hot/cold fit over the interleaved order (f32 slab
+    # rounding differs only in summation grouping; the bf16 slab is used
+    # on both sides, so results are bit-comparable)
+    w_href, b_href = fit_sparse_shard_table(sref, hot_k=16)
+    expected_hot = (
+        [float(np.sum(w_href)), float(np.sum(w_href * w_href))]
+        + [float(v) for v in w_href[:8]] + [b_href]
+    )
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITHOT ")]
+        assert line, f"worker {pid} printed no FITHOT line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_hot, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid} FITHOT: per-process hot/cold fit diverged "
+                "from the single-process interleaved-order fit"
+            ),
+        )

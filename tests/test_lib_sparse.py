@@ -456,6 +456,52 @@ class TestLayoutFloors:
 
         assert agree_max(512, 7) == (512, 7)
 
+    def test_hotcold_floors_and_counts_are_neutral(self):
+        """split_hot_cold with explicit (local) counts and the natural pads
+        as floors reproduces the default split exactly — the multi-process
+        agreement path is a no-op when there is one process."""
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.lib.common import (
+            hotcold_layout_floors,
+            split_hot_cold,
+        )
+
+        from flink_ml_tpu.lib.common import hotcold_entry_counts
+
+        vecs, ys, _ = sparse_data(n=200, dim=48, nnz=5, seed=12)
+        s = pack_sparse_minibatches(vecs, ys, n_dev=4, global_batch_size=32)
+        counts = hotcold_entry_counts(s)
+        (hp, cp), plan = hotcold_layout_floors(s, 8, counts=counts)
+        h_def = split_hot_cold(s, 8, slab_dtype=jnp.float32)
+        h_agr = split_hot_cold(s, 8, slab_dtype=jnp.float32, counts=counts,
+                               min_hot_pad=hp, min_cold_pad=cp, plan=plan)
+        np.testing.assert_array_equal(h_agr.perm, h_def.perm)
+        np.testing.assert_array_equal(h_agr.hot_ints, h_def.hot_ints)
+        np.testing.assert_array_equal(h_agr.hot_vals, h_def.hot_vals)
+        np.testing.assert_array_equal(h_agr.cold.ints, h_def.cold.ints)
+        np.testing.assert_array_equal(h_agr.cold.floats, h_def.cold.floats)
+        # larger floors widen the pads but keep training identical
+        from flink_ml_tpu.lib.common import train_glm_sparse_hotcold
+        from flink_ml_tpu.parallel.mesh import create_mesh
+
+        import jax
+
+        h_wide = split_hot_cold(s, 8, slab_dtype=jnp.float32, counts=counts,
+                                min_hot_pad=hp * 2, min_cold_pad=cp * 2)
+        assert h_wide.hot_ints.shape[2] == hp * 2
+        mesh = create_mesh({"data": 4}, jax.devices()[:4])
+        p0 = lambda: (  # noqa: E731
+            jnp.zeros((s.dim,), jnp.float32), jnp.zeros((), jnp.float32)
+        )
+        r1 = train_glm_sparse_hotcold(p0(), h_def, "logistic", mesh,
+                                      learning_rate=0.5, max_iter=8)
+        r2 = train_glm_sparse_hotcold(p0(), h_wide, "logistic", mesh,
+                                      learning_rate=0.5, max_iter=8)
+        np.testing.assert_array_equal(
+            np.asarray(r1.params[0]), np.asarray(r2.params[0])
+        )
+
     def test_layout_prescan_predicts_pack_exactly(self):
         """sparse_layout_floors must predict the pack's natural layout for
         both column forms — a divergence would hang multi-process runs
