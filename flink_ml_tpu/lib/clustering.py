@@ -273,35 +273,82 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         X, dim = resolve_features(table, self)
         k = self.get_k()
         n = X.shape[0]
-        if n < k:
-            raise ValueError(f"k={k} exceeds number of rows {n}")
+        n_proc = jax.process_count()
 
         checkpoint = self._checkpoint_config()
-
-        def init():
-            # the k-means++ host pass, as a thunk: resolved by train_kmeans
-            # only on a fresh start — a snapshot resume skips it entirely
-            rng = np.random.RandomState(self.get_seed())
-            sample = X if n <= self.INIT_SAMPLE_CAP else X[
-                rng.choice(n, self.INIT_SAMPLE_CAP, replace=False)
-            ]
-            return kmeans_plus_plus(sample.astype(np.float64), k, rng)
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
         from flink_ml_tpu.parallel.mesh import (
-            data_parallel_size,
-            require_single_process,
+            agree_max,
+            agree_sum,
+            local_data_parallel_size,
             shard_batch,
         )
 
-        # k-means++ init samples from the local table, so per-process shards
-        # would seed divergent (silently wrong) replicated centroids
-        require_single_process("KMeans from per-process shards")
-        n_dev = data_parallel_size(mesh)
+        n_global = int(agree_sum(np.asarray([n]))[0]) if n_proc > 1 else n
+        if n_global < k:
+            raise ValueError(f"k={k} exceeds number of rows {n_global}")
+        n_dev = local_data_parallel_size(mesh)
+
+        if n_proc > 1:
+            # cross-process consistent seeding: each process contributes an
+            # equal-size deterministic sample of ITS shard; the allgathered
+            # pool is identical on every process, so the same-seeded
+            # k-means++ pass picks the same replicated centroids everywhere.
+            # Eager (not inside the init thunk): the gather is a collective
+            # every process must reach, never skipped by a lazy resolve.
+            from jax.experimental import multihost_utils
+
+            rng = np.random.RandomState(self.get_seed())
+            per = -(-self.INIT_SAMPLE_CAP // n_proc)
+            s_p = min(n, per)
+            # gathers need equal shapes, but shards may be skewed: each
+            # process pads its contribution to ``per`` rows and ships a
+            # validity mask alongside — a small shard contributes all its
+            # rows instead of capping every other process's sample
+            local = np.zeros((per, dim), dtype=np.float64)
+            mask = np.zeros((per,), dtype=bool)
+            if s_p:
+                local[:s_p] = (
+                    X if n == s_p else X[rng.choice(n, s_p, replace=False)]
+                ).astype(np.float64)
+                mask[:s_p] = True
+            pool_rows = multihost_utils.process_allgather(
+                np.ascontiguousarray(local)
+            ).reshape(-1, dim)
+            pool_mask = multihost_utils.process_allgather(mask).ravel()
+            pool = pool_rows[pool_mask]
+            if pool.shape[0] < k:
+                raise ValueError(
+                    f"k={k} exceeds the {pool.shape[0]}-row init pool "
+                    f"(raise INIT_SAMPLE_CAP or lower k)"
+                )
+
+            def init():
+                return kmeans_plus_plus(
+                    pool, k, np.random.RandomState(self.get_seed())
+                )
+        else:
+            def init():
+                # the k-means++ host pass, as a thunk: resolved by
+                # train_kmeans only on a fresh start — a snapshot resume
+                # skips it entirely
+                rng = np.random.RandomState(self.get_seed())
+                sample = X if n <= self.INIT_SAMPLE_CAP else X[
+                    rng.choice(n, self.INIT_SAMPLE_CAP, replace=False)
+                ]
+                return kmeans_plus_plus(sample.astype(np.float64), k, rng)
+
+        # local rows pad to a per-shard row count agreed across processes
+        # (shard_batch needs identically-shaped local blocks; pad rows
+        # carry zero weight)
+        rows_per_shard = -(-n // n_dev)
+        if n_proc > 1:
+            (rows_per_shard,) = agree_max(rows_per_shard)
 
         def build():
-            n_pad = -(-n // n_dev) * n_dev
+            n_pad = rows_per_shard * n_dev
             Xp = np.zeros((n_pad, dim), dtype=np.float32)
             Xp[:n] = X
             wp = np.zeros((n_pad,), dtype=np.float32)
@@ -309,7 +356,8 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             return Xp, wp
 
         layout_key = ("kmeans", self.get_vector_col(),
-                      tuple(self.get_feature_cols() or ()), n_dev)
+                      tuple(self.get_feature_cols() or ()), n_dev,
+                      rows_per_shard)
         Xp, wp = table.cached_pack(layout_key, build)
         # a thunk: a no-op resume (finished snapshot) must not pay the
         # host->device transfer, so placement resolves lazily downstream
@@ -320,7 +368,8 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
 
         result = train_kmeans(
             init, k, Xp, wp, mesh,
-            max_iter=self.get_max_iter(), tol=self.get_tol(), n_rows=n,
+            max_iter=self.get_max_iter(), tol=self.get_tol(),
+            n_rows=n_global,
             checkpoint=checkpoint, device_batch=device_batch,
         )
         return self._finish(result, k)

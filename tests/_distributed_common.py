@@ -154,6 +154,32 @@ def interleaved_sparse_rows(shards, num_processes):
     return vecs, np.asarray(ys)
 
 
+KM_K = 5
+KM_EPOCHS = 5
+KM_SEED = 3
+
+
+def fit_kmeans_shard_table(table):
+    """KMeans fit both sides run.  NOTE the single-process reference table
+    must hold the shards CONCATENATED in process order (not interleaved):
+    KMeans shards rows as contiguous device blocks, so process p's rows map
+    to devices [p*4, (p+1)*4) — the same partition the concatenated order
+    produces on the 8-device mesh."""
+    from flink_ml_tpu.lib import KMeans
+
+    est = (
+        KMeans().set_feature_cols(SHARD_FEATURES)
+        .set_prediction_col("cluster").set_k(KM_K)
+        .set_max_iter(KM_EPOCHS).set_seed(KM_SEED)
+    )
+    model = est.fit(table)
+    (mt,) = model.get_model_data()
+    cents = np.asarray(
+        [v.to_dense().values for v in mt.col("centroid")], dtype=np.float64
+    )
+    return cents, float(model.train_cost_)
+
+
 def fit_sparse_shard_table(table, hot_k: int = 0):
     from flink_ml_tpu.lib import LogisticRegression
 

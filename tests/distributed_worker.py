@@ -159,4 +159,17 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # KMeans across processes: the k-means++ init must seed from the
+    # allgathered cross-process sample pool (identical on every process),
+    # and Lloyd epochs psum cluster sums across the process boundary
+    from tests._distributed_common import fit_kmeans_shard_table
+
+    cents, cost = fit_kmeans_shard_table(source.read())
+    digest = [float(np.sum(cents)), float(np.sum(cents * cents)), cost]
+    probe = [float(v) for v in cents[0]]
+    print(
+        "FITKM " + " ".join(f"{v:.9e}" for v in digest + probe),
+        flush=True,
+    )
+
 shutdown_distributed()

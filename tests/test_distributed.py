@@ -172,3 +172,32 @@ def test_two_process_mesh_psum(tmp_path):
                 "from the single-process interleaved-order fit"
             ),
         )
+
+    # KMeans: the single-process reference runs over the shards
+    # CONCATENATED in process order (contiguous device blocks — see
+    # fit_kmeans_shard_table docstring), with the same seed, so the
+    # allgathered init pool and the Lloyd row partition match exactly
+    from tests._distributed_common import fit_kmeans_shard_table
+
+    Xc = np.concatenate([s[0] for s in shards])
+    yc = np.concatenate([s[1] for s in shards])
+    km_ref_table = Table.from_columns(
+        shard_schema(),
+        {**{f"f{i}": Xc[:, i] for i in range(Xc.shape[1])}, "label": yc},
+    )
+    cents_ref, cost_ref = fit_kmeans_shard_table(km_ref_table)
+    expected_km = (
+        [float(np.sum(cents_ref)), float(np.sum(cents_ref * cents_ref)),
+         cost_ref] + [float(v) for v in cents_ref[0]]
+    )
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITKM ")]
+        assert line, f"worker {pid} printed no FITKM line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_km, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid} FITKM: per-process KMeans fit diverged "
+                "from the single-process concatenated-order fit"
+            ),
+        )

@@ -460,14 +460,16 @@ class TestLayoutFloors:
         """split_hot_cold with explicit (local) counts and the natural pads
         as floors reproduces the default split exactly — the multi-process
         agreement path is a no-op when there is one process."""
+        import jax
         import jax.numpy as jnp
 
         from flink_ml_tpu.lib.common import (
+            hotcold_entry_counts,
             hotcold_layout_floors,
             split_hot_cold,
+            train_glm_sparse_hotcold,
         )
-
-        from flink_ml_tpu.lib.common import hotcold_entry_counts
+        from flink_ml_tpu.parallel.mesh import create_mesh
 
         vecs, ys, _ = sparse_data(n=200, dim=48, nnz=5, seed=12)
         s = pack_sparse_minibatches(vecs, ys, n_dev=4, global_batch_size=32)
@@ -482,11 +484,6 @@ class TestLayoutFloors:
         np.testing.assert_array_equal(h_agr.cold.ints, h_def.cold.ints)
         np.testing.assert_array_equal(h_agr.cold.floats, h_def.cold.floats)
         # larger floors widen the pads but keep training identical
-        from flink_ml_tpu.lib.common import train_glm_sparse_hotcold
-        from flink_ml_tpu.parallel.mesh import create_mesh
-
-        import jax
-
         h_wide = split_hot_cold(s, 8, slab_dtype=jnp.float32, counts=counts,
                                 min_hot_pad=hp * 2, min_cold_pad=cp * 2)
         assert h_wide.hot_ints.shape[2] == hp * 2
