@@ -925,19 +925,19 @@ def hotcold_entry_counts(sstack: SparseMinibatchStack) -> np.ndarray:
     )
 
 
-def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
-                  pad_multiple: int, model_size: int,
-                  counts: Optional[np.ndarray]):
-    """The deterministic first half of the hot/cold split: hot selection,
-    permutation, per-entry masks, and the NATURAL pad widths — everything
-    except materializing the entry arrays.  Shared by :func:`split_hot_cold`
-    (which fills) and :func:`hotcold_layout_floors` (the multi-process
-    pre-scan), so the two cannot drift.  ``counts`` overrides the local
-    frequency analysis with externally-agreed (global) counts; it must have
-    length ``dim``."""
-    ints = sstack.ints
-    mb, dim = sstack.mb, sstack.dim
+def hotcold_feature_plan(dim: int, hot_k: int, model_size: int,
+                         counts: np.ndarray) -> dict:
+    """The feature-level half of the hot/cold split — hot selection and
+    permutation from a frequency vector, independent of any packed stack.
+    Deterministic in ``counts``, so out-of-core fits compute it ONCE from
+    a counting pre-pass and reuse it for every streamed block (and a
+    checkpoint resume re-derives the identical permutation)."""
     model_size = int(max(model_size, 1))
+    counts = np.asarray(counts)
+    if counts.shape != (dim,):
+        raise ValueError(
+            f"counts must have shape ({dim},), got {counts.shape}"
+        )
     n_hot = int(min(max(hot_k, 1), dim))
     hot_k_eff = -(-n_hot // model_size) * model_size
     hk_l = hot_k_eff // model_size
@@ -946,17 +946,6 @@ def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
     dim_local = hk_l + cold_l
     dim_pad = model_size * dim_local
 
-    idx = ints[:, 0, :]
-    rid = ints[:, 1, :]
-    valid = rid < mb
-    if counts is None:
-        counts = hotcold_entry_counts(sstack)
-    else:
-        counts = np.asarray(counts)
-        if counts.shape != (dim,):
-            raise ValueError(
-                f"counts must have shape ({dim},), got {counts.shape}"
-            )
     order = np.lexsort((np.arange(dim), -counts))  # by count desc, id asc
     hot_ids = np.sort(order[:n_hot])
     # slab column per hot feature (rank in id order); -1 marks cold
@@ -973,7 +962,36 @@ def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
         perm[cold_ids] = (r // cold_l) * dim_local + hk_l + (r % cold_l)
     inv_perm = np.zeros(dim_pad, dtype=np.int32)
     inv_perm[perm] = np.arange(dim, dtype=np.int32)
+    return dict(
+        hot_k_eff=hot_k_eff, dim_pad=dim_pad, perm=perm, inv_perm=inv_perm,
+        slab_col=slab_col,
+    )
 
+
+def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
+                  pad_multiple: int, model_size: int,
+                  counts: Optional[np.ndarray],
+                  feature_plan: Optional[dict] = None):
+    """The deterministic first half of the hot/cold split: hot selection,
+    permutation, per-entry masks, and the NATURAL pad widths — everything
+    except materializing the entry arrays.  Shared by :func:`split_hot_cold`
+    (which fills) and :func:`hotcold_layout_floors` (the multi-process
+    pre-scan), so the two cannot drift.  ``counts`` overrides the local
+    frequency analysis with externally-agreed (global) counts;
+    ``feature_plan`` short-circuits the feature-level work entirely (the
+    out-of-core per-block path, which reuses one plan across the stream)."""
+    ints = sstack.ints
+    mb, dim = sstack.mb, sstack.dim
+    if feature_plan is None:
+        if counts is None:
+            counts = hotcold_entry_counts(sstack)
+        feature_plan = hotcold_feature_plan(dim, hot_k, model_size, counts)
+    slab_col = feature_plan["slab_col"]
+    perm = feature_plan["perm"]
+
+    idx = ints[:, 0, :]
+    rid = ints[:, 1, :]
+    valid = rid < mb
     ranks = np.where(valid, slab_col[idx], -1)
     new_idx = np.where(valid, perm[idx], 0)
     is_hot = ranks >= 0
@@ -985,7 +1003,7 @@ def _hotcold_plan(sstack: SparseMinibatchStack, hot_k: int,
     cold_pad = max(-(-int(cold_counts.max(initial=1)) // pad_multiple)
                    * pad_multiple, pad_multiple)
     return dict(
-        hot_k_eff=hot_k_eff, dim_pad=dim_pad, perm=perm, inv_perm=inv_perm,
+        feature_plan,
         ranks=ranks, new_idx=new_idx, is_hot=is_hot, is_cold=is_cold,
         hot_counts=hot_counts, cold_counts=cold_counts,
         hot_pad=hot_pad, cold_pad=cold_pad,
@@ -1013,7 +1031,8 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
                    counts: Optional[np.ndarray] = None,
                    min_hot_pad: int = 0,
                    min_cold_pad: int = 0,
-                   plan: Optional[dict] = None) -> HotColdStack:
+                   plan: Optional[dict] = None,
+                   feature_plan: Optional[dict] = None) -> HotColdStack:
     """Frequency analysis + feature permutation + per-group entry split.
 
     The ``hot_k`` features with the most stored entries (ties broken by
@@ -1033,7 +1052,8 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
     n_groups = ints.shape[0]
     model_size = int(max(model_size, 1))
     if plan is None:
-        plan = _hotcold_plan(sstack, hot_k, pad_multiple, model_size, counts)
+        plan = _hotcold_plan(sstack, hot_k, pad_multiple, model_size, counts,
+                             feature_plan=feature_plan)
     hot_k_eff = plan["hot_k_eff"]
     dim_pad = plan["dim_pad"]
     perm, inv_perm = plan["perm"], plan["inv_perm"]
@@ -1154,15 +1174,43 @@ def hotcold_device_batch(mesh, hstack: HotColdStack):
     return (slab, cold_ints, cold_floats)
 
 
+def _hotcold_core(kind: str, slab, wts, b, idx, rid, vals, y, w,
+                  mb: int, hot_k: int, dim: int, keep_b: float):
+    """The hot/cold minibatch math: two MXU GEMMs over the slab (forward
+    logits, backward feature gradient) + segment-CSR for the cold tail.
+    The vectors are widened to 128 GEMM columns — the N=1 matvec lowers to
+    a catastrophic lane-reduction on TPU (measured 400x slower), while
+    N=128 engages the MXU at stream bandwidth; the extra columns are free
+    (the pass is memory-bound on the slab).  Shared by the in-memory step
+    (slab pre-densified, HBM-resident across epochs) and the out-of-core
+    step (slab densified in-program per minibatch)."""
+    dtype = slab.dtype
+    w_hot = jnp.broadcast_to(
+        wts[:hot_k].astype(dtype)[:, None], (hot_k, 128)
+    )
+    hot_logits = jax.lax.dot_general(
+        slab, w_hot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    logits = hot_logits + _segment_csr_forward(wts, idx, rid, vals, mb) + b
+    err, loss_sum = _sparse_loss(kind, logits, y, w)
+    err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
+    g_hot = jax.lax.dot_general(
+        slab, err_m, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    g_w = _segment_csr_backward(err, idx, rid, vals, dim)
+    g_w = g_w.at[:hot_k].add(g_hot)
+    g_b = jnp.sum(err) * keep_b
+    return (g_w, g_b), loss_sum, jnp.sum(w)
+
+
 def make_hotcold_mb_grad_step(kind: str, mb: int, cold_nnz_pad: int,
                               hot_k: int, dim: int,
                               with_intercept: bool = True):
-    """The hot/cold minibatch gradient: two MXU GEMMs over the bf16 slab
-    (forward logits, backward feature gradient) + segment-CSR for the cold
-    tail.  The vectors are widened to 128 GEMM columns — the N=1 matvec
-    lowers to a catastrophic lane-reduction on TPU (measured 400x slower),
-    while N=128 engages the MXU at stream bandwidth; the extra columns are
-    free (the pass is memory-bound on the slab)."""
+    """The in-memory hot/cold minibatch gradient over a PRE-DENSIFIED slab
+    (built once on device, resident across epochs — see
+    :func:`densify_hot_slabs`); math in :func:`_hotcold_core`."""
     keep_b = 1.0 if with_intercept else 0.0
 
     def mb_grad_step(params, xs):
@@ -1171,25 +1219,51 @@ def make_hotcold_mb_grad_step(kind: str, mb: int, cold_nnz_pad: int,
         idx, rid, vals, y, w = _segment_csr_unpack(
             ints, floats, cold_nnz_pad, mb
         )
-        dtype = slab.dtype
-        w_hot = jnp.broadcast_to(
-            wts[:hot_k].astype(dtype)[:, None], (hot_k, 128)
+        return _hotcold_core(
+            kind, slab, wts, b, idx, rid, vals, y, w, mb, hot_k, dim, keep_b
         )
-        hot_logits = jax.lax.dot_general(
-            slab, w_hot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )[:, 0]
-        logits = hot_logits + _segment_csr_forward(wts, idx, rid, vals, mb) + b
-        err, loss_sum = _sparse_loss(kind, logits, y, w)
-        err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
-        g_hot = jax.lax.dot_general(
-            slab, err_m, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )[:, 0]
-        g_w = _segment_csr_backward(err, idx, rid, vals, dim)
-        g_w = g_w.at[:hot_k].add(g_hot)
-        g_b = jnp.sum(err) * keep_b
-        return (g_w, g_b), loss_sum, jnp.sum(w)
+
+    return mb_grad_step
+
+
+def make_hotcold_stream_mb_grad_step(kind: str, mb: int,
+                                     cold_nnz_pad: int, hot_k: int,
+                                     dim: int,
+                                     with_intercept: bool = True,
+                                     slab_dtype=jnp.bfloat16):
+    """Out-of-core hot/cold minibatch gradient: the slab densifies
+    IN-PROGRAM from the minibatch's packed hot entries (one scatter over
+    ~hot entries), then the same GEMM+segment-CSR math as the in-memory
+    step runs (:func:`_hotcold_core`).
+
+    The in-memory path builds slabs once and keeps them HBM-resident
+    across epochs; out-of-core the data must not stay resident anywhere,
+    so each epoch re-streams the entries and pays one scatter per
+    minibatch — still one random-access pass where the all-segment-CSR
+    step pays three (weight gather, forward segment_sum, gradient
+    scatter) over the hot traffic.  ``xs`` is one scanned slice of the
+    hot/cold block layout: (hot ints (2, hot_pad), hot vals (hot_pad,),
+    cold ints (2, cold_nnz_pad), cold floats (cold_nnz_pad + 2*mb,));
+    pad entries carry row id ``mb`` (the scatter sink row, sliced away).
+    """
+    keep_b = 1.0 if with_intercept else 0.0
+    dtype = jnp.dtype(slab_dtype)
+
+    def mb_grad_step(params, xs):
+        h_ints, h_vals, ints, floats = xs
+        wts, b = params
+        pos, hrid = h_ints[0], h_ints[1]
+        slab = (
+            jnp.zeros((mb + 1, hot_k), dtype)  # row mb = pad sink
+            .at[hrid, pos]
+            .add(h_vals.astype(dtype))[:mb]
+        )
+        idx, rid, vals, y, w = _segment_csr_unpack(
+            ints, floats, cold_nnz_pad, mb
+        )
+        return _hotcold_core(
+            kind, slab, wts, b, idx, rid, vals, y, w, mb, hot_k, dim, keep_b
+        )
 
     return mb_grad_step
 

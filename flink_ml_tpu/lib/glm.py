@@ -524,12 +524,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 "out-of-core training requires an explicit globalBatchSize "
                 "(full batch would need the whole dataset resident)"
             )
-        if int(self.get_num_hot_features() or 0) > 0:
-            raise NotImplementedError(
-                "numHotFeatures > 0 (hot/cold slab training) is not "
-                "implemented for out-of-core fits yet; unset it or "
-                "materialize the table"
-            )
+        hot_k = int(self.get_num_hot_features() or 0)
         mb = max(1, -(-gbs // n_dev))
         G_local = mb * n_dev_pack
         steps_per_chunk = max(1, table.chunk_rows // G_local)
@@ -570,6 +565,17 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     np.asarray(t.col(label), dtype=np.float64),
                 )
 
+            if hot_k > 0 and model_size > 1:
+                raise NotImplementedError(
+                    "numHotFeatures > 0 is not supported together with a "
+                    "model-sharded (2-D) mesh for out-of-core fits; pick "
+                    "one wide-model strategy"
+                )
+            if hot_k > 0:
+                return self._fit_out_of_core_hotcold(
+                    table, mesh, extract, n_dev, mb, steps_per_chunk,
+                    dim, nnz_pad, hot_k, vector_col, lr, reg, checkpoint,
+                )
             blocks = oc.sparse_blocks_factory(
                 table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad
             )
@@ -623,6 +629,13 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     raise ValueError("empty source")
                 _, dim = resolve_features(first, self)
 
+            if hot_k > 0:
+                raise ValueError(
+                    "numHotFeatures applies only to sparse vector columns "
+                    "(dense features already stream through the MXU); "
+                    "unset it for dense training"
+                )
+
             def extract(t):
                 X, _ = resolve_features(t, self, dim=dim)
                 return np.asarray(X), np.asarray(
@@ -661,6 +674,63 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         if trim is not None:  # the placer's own inverse: trim 2-D padding
             w_t, b_t = trim(result.params)
             result.params = (np.asarray(w_t), b_t)
+        return self._finish(result)
+
+    def _fit_out_of_core_hotcold(self, table, mesh, extract, n_dev, mb,
+                                 steps_per_chunk, dim, nnz_pad, hot_k,
+                                 vector_col, lr, reg,
+                                 checkpoint) -> GlmModelBase:
+        """Out-of-core hot/cold fit: the stream's frequency head rides the
+        MXU slab while the data never materializes.
+
+        A dedicated counting pre-pass fixes the hot set and permutation for
+        the whole fit (a prefix sample would bias selection on sorted
+        files — the KMeans reservoir-init reasoning); each streamed block
+        then packs and splits with that one plan, and the chunk program
+        densifies each minibatch's slab IN-PROGRAM (the in-memory path's
+        HBM-resident slabs cannot exist here by contract — the slab
+        footprint stays one (mb, hot_k) scratch).  Training runs in
+        permuted feature space start to finish: the initial params are
+        zeros (permutation-invariant), STREAM CHECKPOINTS HOLD THE
+        PERMUTED representation (a resume re-derives the identical
+        permutation from the deterministic pre-pass), and only the final
+        coefficients unpermute."""
+        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.lib.common import (
+            hotcold_feature_plan,
+            make_hotcold_stream_mb_grad_step,
+        )
+
+        counts = oc.count_feature_frequencies(table, vector_col, dim)
+        fplan = hotcold_feature_plan(dim, hot_k, 1, counts)
+        dim_pad = fplan["dim_pad"]
+        hot_k_eff = fplan["hot_k_eff"]
+        blocks = oc.hotcold_blocks_factory(
+            table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad,
+            hot_k, fplan,
+        )
+        mb_grad = make_hotcold_stream_mb_grad_step(
+            self.LOSS_KIND, mb, nnz_pad, hot_k_eff, dim_pad,
+            self.get_with_intercept(),
+        )
+        key = ("chunk-hotcold", self.LOSS_KIND, mesh, mb, nnz_pad,
+               hot_k_eff, dim_pad, float(lr), float(reg),
+               self.get_with_intercept())
+        w0 = jnp.zeros((dim_pad,), dtype=jnp.float32)
+        b0 = jnp.zeros((), dtype=jnp.float32)
+        use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
+        with oc.maybe_spill(blocks, use_spill) as blocks:
+            result = oc.train_out_of_core(
+                (w0, b0),
+                blocks,
+                lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
+                mesh,
+                max_iter=self.get_max_iter(),
+                tol=self.get_tol(),
+                checkpoint=checkpoint,
+            )
+        w_t = np.asarray(result.params[0])[fplan["perm"]]
+        result.params = (w_t, result.params[1])
         return self._finish(result)
 
     def _finish(self, result) -> GlmModelBase:

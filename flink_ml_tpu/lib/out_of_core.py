@@ -425,6 +425,28 @@ def dense_blocks_factory(
     return factory
 
 
+def _pack_sparse_block(vectors, y, n_dev: int, mb: int,
+                       steps_per_chunk: int, dim: int, nnz_pad: int):
+    """Pack one streamed block into the segment-CSR layout with the
+    stream-wide fixed ``nnz_pad`` — the shared prologue of the sparse and
+    hot/cold block factories.  A block denser than ``nnz_pad`` fails
+    loudly rather than silently recompiling per block."""
+    if not isinstance(vectors, CsrRows):
+        vectors = list(vectors)
+    stack = pack_sparse_minibatches(
+        vectors, np.asarray(y), n_dev,
+        global_batch_size=mb * n_dev, dim=dim,
+        min_nnz_pad=nnz_pad, min_steps=steps_per_chunk,
+    )
+    if stack.nnz_pad != nnz_pad:
+        raise ValueError(
+            f"a minibatch holds {stack.nnz_pad} nnz > the configured "
+            f"nnz_pad={nnz_pad}; raise nnz_pad (or lower the batch size) "
+            f"so one compiled program covers the stream"
+        )
+    return stack
+
+
 def sparse_blocks_factory(
     chunked_table,
     extract: Callable[[Table], Tuple[list, np.ndarray]],
@@ -435,9 +457,8 @@ def sparse_blocks_factory(
     nnz_pad: int,
 ):
     """Sparse counterpart: blocks in the segment-CSR layout with a fixed
-    ``nnz_pad`` so every block reuses one compiled program.  A block denser
-    than ``nnz_pad`` fails loudly — callers size it from the data
-    (``estimate_nnz_pad``) rather than silently recompiling per block."""
+    ``nnz_pad`` so every block reuses one compiled program (sizing via
+    ``estimate_nnz_pad``; see :func:`_pack_sparse_block`)."""
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
@@ -445,20 +466,9 @@ def sparse_blocks_factory(
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
-                if not isinstance(vectors, CsrRows):
-                    vectors = list(vectors)
-                stack = pack_sparse_minibatches(
-                    vectors, np.asarray(y), n_dev,
-                    global_batch_size=mb * n_dev, dim=dim,
-                    min_nnz_pad=nnz_pad, min_steps=steps_per_chunk,
+                stack = _pack_sparse_block(
+                    vectors, y, n_dev, mb, steps_per_chunk, dim, nnz_pad
                 )
-                if stack.nnz_pad != nnz_pad:
-                    raise ValueError(
-                        f"a minibatch holds {stack.nnz_pad} nnz > the "
-                        f"configured nnz_pad={nnz_pad}; raise nnz_pad (or "
-                        f"lower the batch size) so one compiled program "
-                        f"covers the stream"
-                    )
                 yield (stack.ints, stack.floats), stack.n_rows
 
         return gen()
@@ -686,6 +696,98 @@ class BlockSpill:
         import shutil
 
         shutil.rmtree(self.directory, ignore_errors=True)
+
+
+def count_feature_frequencies(chunked_table, vector_col: str,
+                              dim: int) -> np.ndarray:
+    """One full stream pass accumulating the per-feature stored-entry
+    counts — the hot/cold split's frequency vector for out-of-core fits.
+
+    The permutation must be fixed BEFORE the first training block packs,
+    and a prefix sample would bias hot selection on sorted/grouped files
+    (the same reasoning as the KMeans reservoir init), so this pays one
+    dedicated read of the source; a checkpoint resume re-runs it and
+    derives the identical permutation (deterministic in the data)."""
+    counts = np.zeros((dim,), dtype=np.int64)
+    chunks = chunked_table.chunks()
+    try:
+        for t in chunks:
+            col = t.col(vector_col)
+            if isinstance(col, CsrRows):
+                idx = col.indices
+                if idx.size and (idx.min() < 0 or idx.max() >= dim):
+                    raise ValueError(
+                        f"feature index out of range for numFeatures={dim}"
+                    )
+                counts += np.bincount(idx, minlength=dim)
+            else:
+                for v in col:
+                    if len(v.indices):
+                        if int(v.indices.min()) < 0 or int(v.indices.max()) >= dim:
+                            raise ValueError(
+                                "feature index out of range for "
+                                f"numFeatures={dim}"
+                            )
+                        counts[v.indices] += 1
+    finally:
+        close = getattr(chunks, "close", None)
+        if close is not None:
+            close()
+    return counts
+
+
+def hotcold_blocks_factory(
+    chunked_table,
+    extract: Callable[[Table], Tuple[list, np.ndarray]],
+    n_dev: int,
+    mb: int,
+    steps_per_chunk: int,
+    dim: int,
+    nnz_pad: int,
+    hot_k: int,
+    feature_plan: dict,
+):
+    """Hot/cold counterpart of :func:`sparse_blocks_factory`: each block
+    packs to the segment-CSR layout, then splits into (hot ints, hot vals,
+    cold ints, cold floats) using the stream-wide ``feature_plan`` (one
+    permutation for the whole fit) with BOTH pads fixed at ``nnz_pad`` —
+    a group's hot (or cold) entries can never exceed its total entries, so
+    the ceiling is safe and every block reuses one compiled program.  Cold
+    ids are in PERMUTED space; the chunk program's weight vector lives
+    there too."""
+    from flink_ml_tpu.lib.common import split_hot_cold
+
+    rows_per_block = steps_per_chunk * mb * n_dev
+
+    def factory():
+        def gen():
+            for vectors, y in _block_rows(
+                chunked_table.chunks(), extract, rows_per_block
+            ):
+                stack = _pack_sparse_block(
+                    vectors, y, n_dev, mb, steps_per_chunk, dim, nnz_pad
+                )
+                h = split_hot_cold(
+                    stack, hot_k, feature_plan=feature_plan,
+                    min_hot_pad=nnz_pad, min_cold_pad=nnz_pad,
+                )
+                if (h.hot_ints.shape[2] != nnz_pad
+                        or h.cold.nnz_pad != nnz_pad):
+                    # only possible when nnz_pad is not pad-multiple-aligned
+                    raise ValueError(
+                        f"hot/cold block pads ({h.hot_ints.shape[2]}, "
+                        f"{h.cold.nnz_pad}) diverged from nnz_pad="
+                        f"{nnz_pad}; nnz_pad must be a pad-multiple-"
+                        "aligned ceiling"
+                    )
+                yield (
+                    (h.hot_ints, h.hot_vals, h.cold.ints, h.cold.floats),
+                    stack.n_rows,
+                )
+
+        return gen()
+
+    return factory
 
 
 def estimate_nnz_pad(

@@ -112,20 +112,6 @@ def agree_max(*values: int):
     return tuple(int(v) for v in np.max(gathered, axis=0))
 
 
-def agree_min(*values: int):
-    """Cross-process element-wise MIN (identity single-process) — e.g. the
-    largest per-process sample size every process can actually contribute
-    to an allgathered pool (gathers need equal shapes)."""
-    if jax.process_count() == 1:
-        return values
-    from jax.experimental import multihost_utils
-
-    gathered = multihost_utils.process_allgather(
-        np.asarray(values, np.int64)
-    )
-    return tuple(int(v) for v in np.min(gathered, axis=0))
-
-
 def agree_sum(array: np.ndarray) -> np.ndarray:
     """Cross-process element-wise SUM (identity single-process) — e.g. the
     global feature-frequency vector every process must derive identically

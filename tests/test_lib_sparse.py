@@ -306,18 +306,100 @@ class TestHotColdSplit:
                 .set_num_hot_features(2).fit(t)
             )
 
-    def test_out_of_core_with_hot_k_rejected(self):
+    def _ooc_est(self, hot, dim, max_iter=20, **kw):
+        est = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_num_features(dim).set_learning_rate(0.5)
+            .set_max_iter(max_iter).set_global_batch_size(64)
+            .set_num_hot_features(hot)
+        )
+        for k, v in kw.items():
+            getattr(est, f"set_{k}")(v)
+        return est
+
+    def test_out_of_core_bit_matches_in_memory(self):
+        """Streamed hot/cold training equals the in-memory hot/cold fit
+        bit for bit: same permutation (the counting pre-pass sees the same
+        entries), same update schedule (step-major packing), same slab
+        values (the in-program per-minibatch scatter adds the same bf16
+        entries the resident-slab build does)."""
         from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        vecs, ys = self._power_law_data(n=400)
+        t = Table.from_columns(SCHEMA, {"features": vecs, "label": ys})
+        rows = list(zip(vecs, ys))
+        m_mem = self._ooc_est(8, 64).fit(t)
+        m_ooc = self._ooc_est(8, 64).fit(
+            ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=96)
+        )
+        np.testing.assert_array_equal(
+            m_ooc.coefficients(), m_mem.coefficients()
+        )
+        assert m_ooc.intercept() == m_mem.intercept()
+
+    def test_out_of_core_checkpoint_resume(self, tmp_path):
+        """A killed-and-resumed streamed hot/cold fit lands on the
+        uninterrupted result: the resume re-derives the identical
+        permutation from the deterministic counting pre-pass and continues
+        from the permuted-space checkpoint."""
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        vecs, ys = self._power_law_data(n=300)
+        rows = list(zip(vecs, ys))
+
+        def chunked():
+            return ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=64)
+
+        full = self._ooc_est(8, 64, max_iter=12).fit(chunked())
+        ck = str(tmp_path / "ck")
+        # run half, then resume to completion
+        self._ooc_est(8, 64, max_iter=6, checkpoint_dir=ck,
+                      checkpoint_interval=3).fit(chunked())
+        resumed = self._ooc_est(8, 64, max_iter=12, checkpoint_dir=ck,
+                                checkpoint_interval=3).fit(chunked())
+        # same tolerance as the plain OOC resume test: a resumed engine
+        # re-places loaded host params, which can fuse differently at the
+        # sub-ulp level (test_out_of_core.py:164)
+        np.testing.assert_allclose(
+            resumed.coefficients(), full.coefficients(),
+            rtol=1e-6, atol=1e-9,
+        )
+
+    def test_out_of_core_2d_mesh_with_hot_k_rejected(self):
+        from flink_ml_tpu.parallel.mesh import create_mesh
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
         vecs, ys = self._power_law_data(n=50, dim=16)
         rows = list(zip(vecs, ys))
-        chunked = ChunkedTable(CollectionSource(rows, SCHEMA), chunk_rows=16)
-        with pytest.raises(NotImplementedError, match="out-of-core"):
+        env = MLEnvironmentFactory.get_default()
+        old = env.get_mesh()
+        env.set_mesh(create_mesh({"data": 4, "model": 2}))
+        try:
+            with pytest.raises(NotImplementedError, match="out-of-core"):
+                self._ooc_est(4, 16).fit(
+                    ChunkedTable(CollectionSource(rows, SCHEMA),
+                                 chunk_rows=16)
+                )
+        finally:
+            env.set_mesh(old)
+
+    def test_out_of_core_dense_with_hot_k_rejected(self):
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(40, 4)
+        schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                           ("label", "double"))
+        rows = [(DenseVector(r), float(r[0] > 0)) for r in X]
+        with pytest.raises(ValueError, match="sparse vector columns"):
             (
                 LogisticRegression().set_vector_col("features")
                 .set_label_col("label").set_prediction_col("p")
-                .set_num_features(16).set_global_batch_size(16)
-                .set_num_hot_features(4).fit(chunked)
+                .set_global_batch_size(16).set_num_hot_features(2)
+                .fit(ChunkedTable(CollectionSource(rows, schema),
+                                  chunk_rows=16))
             )
 
     def test_2d_f32_slab_matches_1d(self):
