@@ -545,17 +545,59 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 raise NotImplementedError(
                     f"{type(self).__name__} has no sparse loss kind"
                 )
-            # the estimated nnz_pad is data-dependent: per-process shards
-            # would compile mismatched block shapes across processes
-            require_single_process("sparse out-of-core training from "
-                                   "per-process shards")
             dim = self.get_num_features()
             if dim is None:
                 raise ValueError(
                     "out-of-core sparse training requires numFeatures (the "
                     "global dimension cannot be inferred from a stream)"
                 )
-            nnz_pad = oc.estimate_nnz_pad(table, vector_col, mb, n_dev)
+            # config-only guards BEFORE any stream pass: a misconfigured
+            # multi-process fit must fail in milliseconds, not after every
+            # process read its whole shard
+            if hot_k > 0 and model_size > 1:
+                raise NotImplementedError(
+                    "numHotFeatures > 0 is not supported together with a "
+                    "model-sharded (2-D) mesh for out-of-core fits; pick "
+                    "one wide-model strategy"
+                )
+            if model_size > 1:
+                # single-controller: the model-axis placement is a plain
+                # device_put, not a per-process assembly
+                require_single_process(
+                    "feature-sharded (2-D) sparse out-of-core training"
+                )
+            pad_to_blocks = None
+            counts = None
+            if jax.process_count() > 1:
+                from flink_ml_tpu.parallel.mesh import agree_max
+
+                # every process must compile the same block shapes AND
+                # dispatch the same number of collective chunk calls per
+                # epoch: ONE exact scan of the local shard (the sampled
+                # estimate would disagree across processes; the hot/cold
+                # frequency vector rides the same pass), then agree on
+                # the pad and the per-epoch block count — short shards pad
+                # their epochs with gated no-op blocks
+                scanned = oc.scan_sparse_stream(
+                    table, vector_col, mb,
+                    count_dim=dim if hot_k > 0 else None,
+                )
+                nnz_local, rows_local = scanned[0], scanned[1]
+                counts = scanned[2] if hot_k > 0 else None
+                rows_per_block = steps_per_chunk * mb * n_dev_pack
+                nnz_pad, pad_to_blocks = agree_max(
+                    nnz_local, -(-rows_local // rows_per_block)
+                )
+            elif hot_k > 0:
+                # the hot/cold counting pass doubles as an EXACT pad scan:
+                # one read yields both (out-of-core means every pass is a
+                # full disk/network read — never pay two), and the exact
+                # pad removes the sampled estimate's mid-fit failure mode
+                nnz_pad, _, counts = oc.scan_sparse_stream(
+                    table, vector_col, mb, count_dim=dim
+                )
+            else:
+                nnz_pad = oc.estimate_nnz_pad(table, vector_col, mb, n_dev)
 
             def extract(t):
                 # the column passes through as-is: CsrRows (native stream)
@@ -565,19 +607,15 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     np.asarray(t.col(label), dtype=np.float64),
                 )
 
-            if hot_k > 0 and model_size > 1:
-                raise NotImplementedError(
-                    "numHotFeatures > 0 is not supported together with a "
-                    "model-sharded (2-D) mesh for out-of-core fits; pick "
-                    "one wide-model strategy"
-                )
             if hot_k > 0:
                 return self._fit_out_of_core_hotcold(
-                    table, mesh, extract, n_dev, mb, steps_per_chunk,
-                    dim, nnz_pad, hot_k, vector_col, lr, reg, checkpoint,
+                    table, mesh, extract, n_dev_pack, mb, steps_per_chunk,
+                    dim, nnz_pad, hot_k, lr, reg, checkpoint,
+                    pad_to_blocks, local_counts=counts,
                 )
             blocks = oc.sparse_blocks_factory(
-                table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad
+                table, extract, n_dev_pack, mb, steps_per_chunk, dim,
+                nnz_pad, pad_to_blocks=pad_to_blocks,
             )
             if model_size > 1:
                 # the north-star 2-D configuration: rows stream over 'data'
@@ -678,14 +716,17 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
     def _fit_out_of_core_hotcold(self, table, mesh, extract, n_dev, mb,
                                  steps_per_chunk, dim, nnz_pad, hot_k,
-                                 vector_col, lr, reg,
-                                 checkpoint) -> GlmModelBase:
+                                 lr, reg, checkpoint,
+                                 pad_to_blocks=None,
+                                 local_counts=None) -> GlmModelBase:
         """Out-of-core hot/cold fit: the stream's frequency head rides the
         MXU slab while the data never materializes.
 
-        A dedicated counting pre-pass fixes the hot set and permutation for
-        the whole fit (a prefix sample would bias selection on sorted
-        files — the KMeans reservoir-init reasoning); each streamed block
+        The caller's ONE layout pre-pass (scan_sparse_stream with
+        count_dim) yields both the exact pad and the frequency vector that
+        fixes the hot set and permutation for the whole fit (a prefix
+        sample would bias selection on sorted files — the KMeans
+        reservoir-init reasoning); each streamed block
         then packs and splits with that one plan, and the chunk program
         densifies each minibatch's slab IN-PROGRAM (the in-memory path's
         HBM-resident slabs cannot exist here by contract — the slab
@@ -701,13 +742,26 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             make_hotcold_stream_mb_grad_step,
         )
 
-        counts = oc.count_feature_frequencies(table, vector_col, dim)
+        # counts always arrive from the caller's combined layout scan —
+        # one stream pass yields the pad AND the frequency vector
+        if local_counts is None:
+            raise ValueError(
+                "hot/cold out-of-core fits require the caller's "
+                "scan-derived frequency vector"
+            )
+        counts = local_counts
+        if jax.process_count() > 1:
+            # the hot set must come from the GLOBAL frequency vector;
+            # pads need no extra agreement (both ride the agreed nnz_pad)
+            from flink_ml_tpu.parallel.mesh import agree_sum
+
+            counts = agree_sum(counts)
         fplan = hotcold_feature_plan(dim, hot_k, 1, counts)
         dim_pad = fplan["dim_pad"]
         hot_k_eff = fplan["hot_k_eff"]
         blocks = oc.hotcold_blocks_factory(
             table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad,
-            hot_k, fplan,
+            hot_k, fplan, pad_to_blocks=pad_to_blocks,
         )
         mb_grad = make_hotcold_stream_mb_grad_step(
             self.LOSS_KIND, mb, nnz_pad, hot_k_eff, dim_pad,

@@ -262,6 +262,15 @@ def train_out_of_core(
     """
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
+    # cross-process chunk programs carry collectives; letting several run
+    # concurrently on the CPU gloo backend intermittently livelocks the
+    # in-process rendezvous (observed: both workers wedge mid-epoch with
+    # all programs dispatched).  Serialize: each chunk completes —
+    # collectives included — before the next dispatches.  Prefetch still
+    # overlaps host parse/pack with device compute; only device-side
+    # concurrency is given up.
+    serialize_chunks = jax.process_count() > 1
+
     start_epoch = 0
     losses: list = []
     if checkpoint is not None:
@@ -318,6 +327,9 @@ def train_out_of_core(
         for placed, real_rows in _prefetch(placed_blocks()):
             carry, tick = chunk_fn(carry, placed)
             n_rows += real_rows
+            if serialize_chunks:
+                jax.block_until_ready(tick)
+                continue
             inflight.append(tick)
             if len(inflight) > max_inflight_chunks:
                 jax.block_until_ready(inflight.popleft())
@@ -447,6 +459,19 @@ def _pack_sparse_block(vectors, y, n_dev: int, mb: int,
     return stack
 
 
+def _empty_sparse_block(n_groups: int, mb: int, nnz_pad: int):
+    """An all-pad segment-CSR block (zero live rows): every entry carries
+    the pad row id ``mb``, every weight is zero.  The chunk program's
+    ``live = w_sum > 0`` gate makes its steps exact no-ops (no update, no
+    decay) — the multi-process filler for shards with fewer blocks than
+    the agreed per-epoch count (every process must dispatch the same
+    number of collective chunk calls or the mesh hangs)."""
+    ints = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
+    ints[:, 1, :] = mb
+    floats = np.zeros((n_groups, nnz_pad + 2 * mb), dtype=np.float32)
+    return ints, floats
+
+
 def sparse_blocks_factory(
     chunked_table,
     extract: Callable[[Table], Tuple[list, np.ndarray]],
@@ -455,14 +480,18 @@ def sparse_blocks_factory(
     steps_per_chunk: int,
     dim: int,
     nnz_pad: int,
+    pad_to_blocks: Optional[int] = None,
 ):
     """Sparse counterpart: blocks in the segment-CSR layout with a fixed
     ``nnz_pad`` so every block reuses one compiled program (sizing via
-    ``estimate_nnz_pad``; see :func:`_pack_sparse_block`)."""
+    ``estimate_nnz_pad``, or :func:`scan_sparse_stream` + ``agree_max``
+    multi-process; see :func:`_pack_sparse_block`).  ``pad_to_blocks``
+    appends empty no-op blocks up to the agreed per-epoch count."""
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
         def gen():
+            emitted = 0
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
@@ -470,6 +499,13 @@ def sparse_blocks_factory(
                     vectors, y, n_dev, mb, steps_per_chunk, dim, nnz_pad
                 )
                 yield (stack.ints, stack.floats), stack.n_rows
+                emitted += 1
+            if pad_to_blocks is not None and emitted < pad_to_blocks:
+                empty = _empty_sparse_block(
+                    n_dev * steps_per_chunk, mb, nnz_pad
+                )
+                for _ in range(pad_to_blocks - emitted):
+                    yield empty, 0
 
         return gen()
 
@@ -698,42 +734,75 @@ class BlockSpill:
         shutil.rmtree(self.directory, ignore_errors=True)
 
 
-def count_feature_frequencies(chunked_table, vector_col: str,
-                              dim: int) -> np.ndarray:
-    """One full stream pass accumulating the per-feature stored-entry
-    counts — the hot/cold split's frequency vector for out-of-core fits.
+def scan_sparse_stream(chunked_table, vector_col: str, mb: int,
+                       pad_multiple: int = 512,
+                       count_dim: Optional[int] = None):
+    """One full pass over the stream: (exact nnz_pad, total rows[, counts]).
 
-    The permutation must be fixed BEFORE the first training block packs,
-    and a prefix sample would bias hot selection on sorted/grouped files
-    (the same reasoning as the KMeans reservoir init), so this pays one
-    dedicated read of the source; a checkpoint resume re-runs it and
-    derives the identical permutation (deterministic in the data)."""
-    counts = np.zeros((dim,), dtype=np.int64)
+    The multi-process replacement for :func:`estimate_nnz_pad`'s
+    sampled+safety heuristic — processes must agree on EXACT block shapes,
+    so each scans its whole shard (window max over the mb-aligned row
+    windows the packer budgets; block boundaries are mb-aligned, so the
+    window set equals the packer's group set) and ``agree_max`` reconciles
+    the results.  Also the row count, from which the per-epoch block count
+    derives (short shards pad their epochs with empty no-op blocks).
+
+    ``count_dim`` additionally accumulates the per-feature frequency
+    vector in the SAME pass (the hot/cold selection input) — out-of-core
+    means every pass is a full disk/network read, so the hot/cold
+    multi-process path must not pay two."""
+    worst = 1
+    n_rows = 0
+    carry = np.zeros((0,), dtype=np.int64)  # partial trailing mb-window
+    freq = (
+        np.zeros((count_dim,), dtype=np.int64)
+        if count_dim is not None else None
+    )
     chunks = chunked_table.chunks()
     try:
         for t in chunks:
             col = t.col(vector_col)
             if isinstance(col, CsrRows):
-                idx = col.indices
-                if idx.size and (idx.min() < 0 or idx.max() >= dim):
-                    raise ValueError(
-                        f"feature index out of range for numFeatures={dim}"
-                    )
-                counts += np.bincount(idx, minlength=dim)
+                counts = col.nnz_per_row()
+                if freq is not None:
+                    idx = col.indices
+                    if idx.size and (idx.min() < 0 or idx.max() >= count_dim):
+                        raise ValueError(
+                            "feature index out of range for "
+                            f"numFeatures={count_dim}"
+                        )
+                    freq += np.bincount(idx, minlength=count_dim)
             else:
-                for v in col:
-                    if len(v.indices):
-                        if int(v.indices.min()) < 0 or int(v.indices.max()) >= dim:
-                            raise ValueError(
-                                "feature index out of range for "
-                                f"numFeatures={dim}"
-                            )
-                        counts[v.indices] += 1
+                counts = np.fromiter(
+                    (len(v.indices) for v in col), np.int64, len(col)
+                )
+                if freq is not None:
+                    for v in col:
+                        if len(v.indices):
+                            if (int(v.indices.min()) < 0
+                                    or int(v.indices.max()) >= count_dim):
+                                raise ValueError(
+                                    "feature index out of range for "
+                                    f"numFeatures={count_dim}"
+                                )
+                            freq[v.indices] += 1
+            n_rows += len(counts)
+            arr = np.concatenate([carry, np.asarray(counts, np.int64)])
+            n_full = len(arr) // mb
+            if n_full:
+                sums = arr[: n_full * mb].reshape(n_full, mb).sum(axis=1)
+                worst = max(worst, int(sums.max()))
+            carry = arr[n_full * mb:]
     finally:
         close = getattr(chunks, "close", None)
         if close is not None:
             close()
-    return counts
+    if carry.size:
+        worst = max(worst, int(carry.sum()))
+    nnz_pad = -(-worst // pad_multiple) * pad_multiple
+    if freq is not None:
+        return nnz_pad, n_rows, freq
+    return nnz_pad, n_rows
 
 
 def hotcold_blocks_factory(
@@ -746,6 +815,7 @@ def hotcold_blocks_factory(
     nnz_pad: int,
     hot_k: int,
     feature_plan: dict,
+    pad_to_blocks: Optional[int] = None,
 ):
     """Hot/cold counterpart of :func:`sparse_blocks_factory`: each block
     packs to the segment-CSR layout, then splits into (hot ints, hot vals,
@@ -761,6 +831,7 @@ def hotcold_blocks_factory(
 
     def factory():
         def gen():
+            emitted = 0
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
@@ -784,6 +855,15 @@ def hotcold_blocks_factory(
                     (h.hot_ints, h.hot_vals, h.cold.ints, h.cold.floats),
                     stack.n_rows,
                 )
+                emitted += 1
+            if pad_to_blocks is not None and emitted < pad_to_blocks:
+                n_groups = n_dev * steps_per_chunk
+                ci, cf = _empty_sparse_block(n_groups, mb, nnz_pad)
+                hi = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
+                hi[:, 1, :] = mb  # pad rows -> the scatter sink row
+                hv = np.zeros((n_groups, nnz_pad), dtype=np.float32)
+                for _ in range(pad_to_blocks - emitted):
+                    yield (hi, hv, ci, cf), 0
 
         return gen()
 

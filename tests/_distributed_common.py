@@ -134,6 +134,27 @@ def make_sparse_shard_rows(num_processes):
     return shards
 
 
+def make_unequal_sparse_shard_rows(num_processes):
+    """Shards with UNEQUAL row counts (process p holds SHARD_ROWS + 32*p
+    rows): the shorter shard must pad its out-of-core epochs with gated
+    no-op blocks up to the agreed per-epoch block count, or the collective
+    chunk calls deadlock."""
+    from flink_ml_tpu.ops.vector import SparseVector
+
+    rng = np.random.RandomState(29)
+    true_w = rng.randn(SPARSE_DIM)
+    shards = []
+    for p in range(num_processes):
+        vecs, ys = [], []
+        for _ in range(SHARD_ROWS + 32 * p):
+            idx = np.sort(rng.choice(SPARSE_DIM, 5, replace=False))
+            vals = rng.randn(5)
+            vecs.append(SparseVector(SPARSE_DIM, idx.astype(np.int64), vals))
+            ys.append(float((vals @ true_w[idx]) > 0))
+        shards.append((vecs, np.asarray(ys)))
+    return shards
+
+
 def sparse_shard_schema():
     from flink_ml_tpu.table.schema import DataTypes, Schema
 

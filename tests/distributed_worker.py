@@ -17,6 +17,14 @@ process_id = int(sys.argv[1])
 num_processes = int(sys.argv[2])
 port = sys.argv[3]
 
+if os.environ.get("FMT_WORKER_DUMP"):
+    # debug aid: dump all thread stacks if the worker wedges
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ["FMT_WORKER_DUMP"]), exit=True
+    )
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
 )
@@ -156,6 +164,58 @@ if len(sys.argv) > 4:
         "FITHOT " + " ".join(
             f"{v:.9e}" for v in digest + probe + [b_hc]
         ),
+        flush=True,
+    )
+
+    # sparse OUT-OF-CORE across processes: one exact local stream scan +
+    # agree_max fixes the block shapes; equal shards here, so the result
+    # must bit-match the in-memory sparse fit (the OOC engine's
+    # schedule-exact contract) and hence the parent's single-process
+    # reference digest
+    from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+    ooc_table = ChunkedTable(
+        CollectionSource(list(zip(svecs, sy)), sparse_shard_schema()),
+        chunk_rows=64,
+    )
+    w_so, b_so = fit_sparse_shard_table(ooc_table)
+    digest = [float(np.sum(w_so)), float(np.sum(w_so * w_so))]
+    probe = [float(v) for v in w_so[:8]]
+    print(
+        "FITSOOC " + " ".join(f"{v:.9e}" for v in digest + probe + [b_so]),
+        flush=True,
+    )
+
+    # hot/cold OUT-OF-CORE across processes: the scan-derived local counts
+    # agree_sum into the global frequency vector, the shared feature plan
+    # permutes identically everywhere, and the streamed fit must bit-match
+    # the in-memory hot/cold fit (-> the parent's FITHOT reference digest)
+    ooc_hot = ChunkedTable(
+        CollectionSource(list(zip(svecs, sy)), sparse_shard_schema()),
+        chunk_rows=64,
+    )
+    w_ho, b_ho = fit_sparse_shard_table(ooc_hot, hot_k=16)
+    digest = [float(np.sum(w_ho)), float(np.sum(w_ho * w_ho))]
+    probe = [float(v) for v in w_ho[:8]]
+    print(
+        "FITHOOC " + " ".join(f"{v:.9e}" for v in digest + probe + [b_ho]),
+        flush=True,
+    )
+
+    # UNEQUAL shards: the short shard pads its epochs with gated no-op
+    # blocks; both processes must land on the identical global model
+    from tests._distributed_common import make_unequal_sparse_shard_rows
+
+    uvecs, uy = make_unequal_sparse_shard_rows(num_processes)[process_id]
+    ooc_unequal = ChunkedTable(
+        CollectionSource(list(zip(uvecs, uy)), sparse_shard_schema()),
+        chunk_rows=64,
+    )
+    w_su, b_su = fit_sparse_shard_table(ooc_unequal)
+    digest = [float(np.sum(w_su)), float(np.sum(w_su * w_su))]
+    probe = [float(v) for v in w_su[:8]]
+    print(
+        "FITSOOCU " + " ".join(f"{v:.9e}" for v in digest + probe + [b_su]),
         flush=True,
     )
 

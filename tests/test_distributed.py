@@ -50,11 +50,22 @@ def test_two_process_mesh_psum(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=180)
+            # generous: the workers compile every fit variant from a cold
+            # jit cache, and the suite may be sharing the host's one core
+            out, _ = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
+            partials = []
             for q in procs:
                 q.kill()
-            raise
+                try:
+                    partial, _ = q.communicate(timeout=10)
+                except Exception:
+                    partial = "<unreadable>"
+                partials.append(partial)
+            raise AssertionError(
+                "distributed workers timed out; partial outputs:\n"
+                + "\n---\n".join(partials)
+            )
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
@@ -172,6 +183,50 @@ def test_two_process_mesh_psum(tmp_path):
                 "from the single-process interleaved-order fit"
             ),
         )
+
+    # sparse out-of-core: equal shards, so the streamed fit bit-matches
+    # the in-memory fit and shares its expected digest
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITSOOC ")]
+        assert line, f"worker {pid} printed no FITSOOC line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_sparse, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid} FITSOOC: per-process sparse out-of-core fit "
+                "diverged from the single-process interleaved-order fit"
+            ),
+        )
+
+    # hot/cold out-of-core: streamed hot/cold bit-matches the in-memory
+    # hot/cold fit, so it shares FITHOT's expected digest
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITHOOC ")]
+        assert line, f"worker {pid} printed no FITHOOC line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_hot, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid} FITHOOC: per-process hot/cold out-of-core "
+                "fit diverged from the single-process in-memory fit"
+            ),
+        )
+
+    # unequal shards: no single-process reference is expressible (the
+    # short shard's trailing no-op windows interleave mid-stream), but the
+    # two processes must land on the identical global model — and on
+    # anything at all (a block-count mismatch would deadlock, caught by
+    # the subprocess timeout)
+    lines = []
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITSOOCU ")]
+        assert line, f"worker {pid} printed no FITSOOCU line:\n{out}"
+        lines.append([float(v) for v in line[0].split()[1:]])
+    assert all(np.isfinite(lines[0]))
+    np.testing.assert_allclose(
+        lines[1], lines[0], rtol=1e-12,
+        err_msg="workers disagree on the unequal-shard out-of-core model",
+    )
 
     # KMeans: the single-process reference runs over the shards
     # CONCATENATED in process order (contiguous device blocks — see
