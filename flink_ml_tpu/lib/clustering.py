@@ -174,6 +174,35 @@ def train_kmeans(
     )
 
 
+def _allgather_sample_pool(local_sample: np.ndarray, per: int, dim: int,
+                           k: int) -> np.ndarray:
+    """Build the cross-process k-means++ init pool: every process ships a
+    mask-padded ``per``-row block of its local sample (gathers need equal
+    shapes, but shards may be skewed — a small shard contributes all its
+    rows instead of capping everyone else), and the concatenated masked
+    rows are identical on every process.  Shared by the in-memory and
+    out-of-core multi-process fits."""
+    from jax.experimental import multihost_utils
+
+    s_p = int(local_sample.shape[0])
+    local = np.zeros((per, dim), dtype=np.float64)
+    mask = np.zeros((per,), dtype=bool)
+    if s_p:
+        local[:s_p] = np.asarray(local_sample, dtype=np.float64)
+        mask[:s_p] = True
+    pool_rows = multihost_utils.process_allgather(
+        np.ascontiguousarray(local)
+    ).reshape(-1, dim)
+    pool_mask = multihost_utils.process_allgather(mask).ravel()
+    pool = pool_rows[pool_mask]
+    if pool.shape[0] < k:
+        raise ValueError(
+            f"k={k} exceeds the {pool.shape[0]}-row init pool "
+            f"(raise INIT_SAMPLE_CAP or lower k)"
+        )
+    return pool
+
+
 def kmeans_plus_plus(X: np.ndarray, k: int, rng: np.random.RandomState) -> np.ndarray:
     """Standard k-means++ seeding on the host (runs on a bounded sample)."""
     n = X.shape[0]
@@ -298,32 +327,13 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             # k-means++ pass picks the same replicated centroids everywhere.
             # Eager (not inside the init thunk): the gather is a collective
             # every process must reach, never skipped by a lazy resolve.
-            from jax.experimental import multihost_utils
-
             rng = np.random.RandomState(self.get_seed())
             per = -(-self.INIT_SAMPLE_CAP // n_proc)
             s_p = min(n, per)
-            # gathers need equal shapes, but shards may be skewed: each
-            # process pads its contribution to ``per`` rows and ships a
-            # validity mask alongside — a small shard contributes all its
-            # rows instead of capping every other process's sample
-            local = np.zeros((per, dim), dtype=np.float64)
-            mask = np.zeros((per,), dtype=bool)
-            if s_p:
-                local[:s_p] = (
-                    X if n == s_p else X[rng.choice(n, s_p, replace=False)]
-                ).astype(np.float64)
-                mask[:s_p] = True
-            pool_rows = multihost_utils.process_allgather(
-                np.ascontiguousarray(local)
-            ).reshape(-1, dim)
-            pool_mask = multihost_utils.process_allgather(mask).ravel()
-            pool = pool_rows[pool_mask]
-            if pool.shape[0] < k:
-                raise ValueError(
-                    f"k={k} exceeds the {pool.shape[0]}-row init pool "
-                    f"(raise INIT_SAMPLE_CAP or lower k)"
-                )
+            local_sample = (
+                X if n == s_p else X[rng.choice(n, s_p, replace=False)]
+            )
+            pool = _allgather_sample_pool(local_sample, per, dim, k)
 
             def init():
                 return kmeans_plus_plus(
@@ -404,14 +414,15 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         """
         from flink_ml_tpu.lib import out_of_core as oc
         from flink_ml_tpu.parallel.mesh import (
-            data_parallel_size,
-            require_single_process,
+            agree_max,
+            agree_sum,
+            local_data_parallel_size,
         )
 
         env = MLEnvironmentFactory.get_default()
         mesh = env.get_mesh()
-        require_single_process("KMeans from per-process shards")
-        n_dev = data_parallel_size(mesh)
+        n_proc = jax.process_count()
+        n_dev = local_data_parallel_size(mesh)
         # on a 2-D mesh the centroids replicate over 'model' (like the
         # in-memory Lloyd path); rows shard over 'data' only
         k = self.get_k()
@@ -422,13 +433,43 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             return (np.asarray(X),)
 
         # init from a uniform reservoir sample; skipped entirely on resume
+        # single-process.  Multi-process always runs the sampling pass:
+        # the per-epoch block count derives from the row count it returns
+        # (every process must dispatch the same number of collective chunk
+        # calls — short shards pad with zero-weight blocks), and the
+        # allgather is a collective every process must reach.
         resuming = False
         if checkpoint is not None:
             from flink_ml_tpu.iteration.checkpoint import latest_checkpoint
 
             resuming = latest_checkpoint(checkpoint.directory) is not None
         rng = np.random.RandomState(self.get_seed())
-        if resuming:
+        rows_per_block = max(n_dev, (table.chunk_rows // n_dev) * n_dev)
+        pad_to_blocks = None
+        if n_proc > 1:
+            per = -(-self.INIT_SAMPLE_CAP // n_proc)
+            sample, n_seen = oc.reservoir_sample_rows(
+                table.chunks(), extract, per, rng, allow_empty=True
+            )
+            # an empty local shard cannot know the feature width, but it
+            # still owes every collective: agree the width first, then
+            # contribute an empty masked block to the pool
+            (dim,) = agree_max(sample.shape[1] if n_seen else 0)
+            if dim == 0:
+                raise ValueError("empty source")
+            pool = _allgather_sample_pool(
+                sample.reshape(-1, dim) if n_seen else
+                np.zeros((0, dim), dtype=np.float64),
+                per, dim, k,
+            )
+            n_global = int(agree_sum(np.asarray([n_seen]))[0])
+            if n_global < k:
+                raise ValueError(f"k={k} exceeds number of rows {n_global}")
+            (pad_to_blocks,) = agree_max(-(-n_seen // rows_per_block))
+            cents0 = kmeans_plus_plus(
+                pool, k, np.random.RandomState(self.get_seed())
+            )
+        elif resuming:
             first = next(iter(table.chunks()), None)
             if first is None:
                 raise ValueError("empty source")
@@ -443,8 +484,9 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
                 raise ValueError(f"k={k} exceeds number of rows {n_seen}")
             cents0 = kmeans_plus_plus(sample.astype(np.float64), k, rng)
 
-        rows_per_block = max(n_dev, (table.chunk_rows // n_dev) * n_dev)
-        blocks = oc.rows_blocks_factory(table, extract, n_dev, rows_per_block)
+        blocks = oc.rows_blocks_factory(table, extract, n_dev, rows_per_block,
+                                        pad_to_blocks=pad_to_blocks,
+                                        pad_dim=dim)
         key = ("chunk-kmeans", mesh, int(k), rows_per_block, dim)
         use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
         with oc.maybe_spill(blocks, use_spill) as blocks:

@@ -680,8 +680,20 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     t.col(label), dtype=np.float64
                 )
 
+            pad_to_blocks = None
+            if jax.process_count() > 1:
+                from flink_ml_tpu.parallel.mesh import agree_max
+
+                # every process must dispatch the same number of collective
+                # chunk calls per epoch: one row-count pass, then agree —
+                # short shards pad with gated no-op blocks
+                rows_per_block = steps_per_chunk * mb * n_dev_pack
+                (pad_to_blocks,) = agree_max(
+                    -(-oc.count_stream_rows(table) // rows_per_block)
+                )
             blocks = oc.dense_blocks_factory(
-                table, extract, n_dev_pack, mb, steps_per_chunk
+                table, extract, n_dev_pack, mb, steps_per_chunk,
+                pad_to_blocks=pad_to_blocks, pad_dim=dim,
             )
             grad_fn = self._grad_fn()
 

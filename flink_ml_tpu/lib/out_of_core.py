@@ -263,13 +263,17 @@ def train_out_of_core(
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
     # cross-process chunk programs carry collectives; letting several run
-    # concurrently on the CPU gloo backend intermittently livelocks the
+    # concurrently on the CPU gloo backend intermittently livelocks its
     # in-process rendezvous (observed: both workers wedge mid-epoch with
-    # all programs dispatched).  Serialize: each chunk completes —
-    # collectives included — before the next dispatches.  Prefetch still
-    # overlaps host parse/pack with device compute; only device-side
-    # concurrency is given up.
-    serialize_chunks = jax.process_count() > 1
+    # all programs dispatched).  Serialize there: each chunk completes —
+    # collectives included — before the next dispatches (prefetch still
+    # overlaps host parse/pack with device compute).  Scoped to the CPU
+    # backend: multihost TPU collectives run on per-core hardware queues
+    # where concurrent in-flight programs are the designed norm, so the
+    # async pipeline stays on for the production platform.
+    serialize_chunks = (
+        jax.process_count() > 1 and jax.default_backend() == "cpu"
+    )
 
     start_epoch = 0
     losses: list = []
@@ -407,30 +411,66 @@ def _drain_pending(pending: list):
 # -- block builders -----------------------------------------------------------
 
 
+def count_stream_rows(chunked_table) -> int:
+    """Row count of a chunk stream — the dense multi-process pre-pass
+    (the per-epoch block count must agree across processes; sparse fits
+    get the count from their layout scan, dense fits only need this)."""
+    n = 0
+    chunks = chunked_table.chunks()
+    try:
+        for t in chunks:
+            n += t.num_rows()
+    finally:
+        close = getattr(chunks, "close", None)
+        if close is not None:
+            close()
+    return n
+
+
 def dense_blocks_factory(
     chunked_table,
     extract: Callable[[Table], Tuple[np.ndarray, np.ndarray]],
     n_dev: int,
     mb: int,
     steps_per_chunk: int,
+    pad_to_blocks: Optional[int] = None,
+    pad_dim: Optional[int] = None,
 ):
     """Blocks of ``steps_per_chunk`` global steps in the combined dense
     layout, packed step-major; yields host ``(batch, n_rows)`` (the engine's
-    prefetch thread does the mesh placement)."""
+    prefetch thread does the mesh placement).  ``pad_to_blocks`` appends
+    all-pad blocks (zero weight — the chunk program's live gate makes
+    their steps exact no-ops) up to the agreed multi-process per-epoch
+    count; ``pad_dim`` is the feature width for those pads."""
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
         def gen():
+            emitted = 0
+            dim = pad_dim
             for X, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
                 X = np.asarray(X)
                 y = np.asarray(y)
+                dim = X.shape[1]
                 stack = pack_minibatches(
                     X, y, n_dev, global_batch_size=mb * n_dev,
                     min_steps=steps_per_chunk,
                 )
                 yield _combined_view(stack), stack.n_rows
+                emitted += 1
+            if pad_to_blocks is not None and emitted < pad_to_blocks:
+                if dim is None:
+                    raise ValueError(
+                        "cannot pad an empty stream to the agreed block "
+                        "count without a known feature width"
+                    )
+                empty = np.zeros(
+                    (n_dev * steps_per_chunk, mb, dim + 2), dtype=np.float32
+                )
+                for _ in range(pad_to_blocks - emitted):
+                    yield empty, 0
 
         return gen()
 
@@ -517,26 +557,48 @@ def rows_blocks_factory(
     extract: Callable[[Table], Tuple[np.ndarray]],
     n_dev: int,
     rows_per_block: int,
+    pad_to_blocks: Optional[int] = None,
+    pad_dim: Optional[int] = None,
 ):
     """Plain padded row blocks ``(X, w)`` for whole-batch epoch algorithms
     (KMeans' Lloyd step): every block has exactly ``rows_per_block`` rows
     (multiple of ``n_dev``; the final block zero-weight-pads), so one
-    compiled program covers the stream."""
+    compiled program covers the stream.  ``pad_to_blocks`` appends
+    all-zero-weight blocks up to the agreed per-epoch count (multi-process
+    short shards; zero-weight rows contribute nothing to the Lloyd
+    accumulators exactly); ``pad_dim`` supplies the feature width when the
+    local stream could be empty."""
     if rows_per_block % n_dev:
         raise ValueError("rows_per_block must be a multiple of n_dev")
 
     def factory():
         def gen():
+            emitted = 0
+            dim = pad_dim
             for (X,) in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
                 X = np.asarray(X, dtype=np.float32)
+                dim = X.shape[1]
                 n = X.shape[0]
                 Xp = np.zeros((rows_per_block, X.shape[1]), dtype=np.float32)
                 wp = np.zeros((rows_per_block,), dtype=np.float32)
                 Xp[:n] = X
                 wp[:n] = 1.0
                 yield (Xp, wp), n
+                emitted += 1
+            if pad_to_blocks is not None and emitted < pad_to_blocks:
+                if dim is None:
+                    raise ValueError(
+                        "cannot pad an empty stream to the agreed block "
+                        "count without a known feature width"
+                    )
+                empty = (
+                    np.zeros((rows_per_block, dim), dtype=np.float32),
+                    np.zeros((rows_per_block,), dtype=np.float32),
+                )
+                for _ in range(pad_to_blocks - emitted):
+                    yield empty, 0
 
         return gen()
 
@@ -629,7 +691,8 @@ def maybe_spill(blocks_factory, enabled: bool):
         spill.close()
 
 
-def reservoir_sample_rows(chunks: Iterator[Table], extract, cap: int, rng):
+def reservoir_sample_rows(chunks: Iterator[Table], extract, cap: int, rng,
+                          allow_empty: bool = False):
     """Uniform sample of ``cap`` rows over a chunk stream (vectorized
     Algorithm R), plus the true row count.
 
@@ -662,6 +725,10 @@ def reservoir_sample_rows(chunks: Iterator[Table], extract, cap: int, rng):
             sample[j[hit]] = rest[hit]
         seen += m
     if sample is None:
+        if allow_empty:
+            # multi-process: an empty local shard is legal — the caller
+            # still owes its collectives, so it must not raise unilaterally
+            return np.zeros((0, 0), dtype=np.float64), 0
         raise ValueError("empty source")
     return sample[:filled] if filled < cap else sample, seen
 
@@ -758,34 +825,28 @@ def scan_sparse_stream(chunked_table, vector_col: str, mb: int,
         np.zeros((count_dim,), dtype=np.int64)
         if count_dim is not None else None
     )
+    from flink_ml_tpu.lib.common import sparse_row_counts
+
     chunks = chunked_table.chunks()
     try:
         for t in chunks:
             col = t.col(vector_col)
-            if isinstance(col, CsrRows):
-                counts = col.nnz_per_row()
-                if freq is not None:
+            counts = sparse_row_counts(col)
+            if freq is not None:
+                if isinstance(col, CsrRows):
                     idx = col.indices
-                    if idx.size and (idx.min() < 0 or idx.max() >= count_dim):
-                        raise ValueError(
-                            "feature index out of range for "
-                            f"numFeatures={count_dim}"
-                        )
-                    freq += np.bincount(idx, minlength=count_dim)
-            else:
-                counts = np.fromiter(
-                    (len(v.indices) for v in col), np.int64, len(col)
-                )
-                if freq is not None:
-                    for v in col:
-                        if len(v.indices):
-                            if (int(v.indices.min()) < 0
-                                    or int(v.indices.max()) >= count_dim):
-                                raise ValueError(
-                                    "feature index out of range for "
-                                    f"numFeatures={count_dim}"
-                                )
-                            freq[v.indices] += 1
+                else:
+                    idx = np.concatenate(
+                        [v.indices for v in col]
+                    ) if len(col) else np.zeros((0,), np.int64)
+                if idx.size and (
+                    int(idx.min()) < 0 or int(idx.max()) >= count_dim
+                ):
+                    raise ValueError(
+                        "feature index out of range for "
+                        f"numFeatures={count_dim}"
+                    )
+                freq += np.bincount(idx, minlength=count_dim)
             n_rows += len(counts)
             arr = np.concatenate([carry, np.asarray(counts, np.int64)])
             n_full = len(arr) // mb

@@ -232,4 +232,19 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # KMeans OUT-OF-CORE across processes: the reservoir pass doubles as
+    # the row count for the agreed per-epoch block count, the init pool
+    # allgathers, and Lloyd accumulators psum across the process boundary
+    # block by block
+    cents_o, cost_o = fit_kmeans_shard_table(
+        ChunkedTable(source, chunk_rows=64)
+    )
+    digest = [float(np.sum(cents_o)), float(np.sum(cents_o * cents_o)),
+              cost_o]
+    probe = [float(v) for v in cents_o[0]]
+    print(
+        "FITKMOOC " + " ".join(f"{v:.9e}" for v in digest + probe),
+        flush=True,
+    )
+
 shutdown_distributed()

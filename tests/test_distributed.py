@@ -256,3 +256,29 @@ def test_two_process_mesh_psum(tmp_path):
                 "from the single-process concatenated-order fit"
             ),
         )
+
+    # KMeans out-of-core: same init (under-cap reservoir = the dataset in
+    # concatenated order on both sides), Lloyd accumulation differs only
+    # in per-device grouping — looser float tolerance than the GLMs'
+    # schedule-exact paths (see KMeans._fit_out_of_core docstring)
+    from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+    km_rows = [tuple(Xc[i]) + (yc[i],) for i in range(len(yc))]
+    cents_oref, cost_oref = fit_kmeans_shard_table(
+        ChunkedTable(CollectionSource(km_rows, shard_schema()), chunk_rows=64)
+    )
+    expected_km_ooc = (
+        [float(np.sum(cents_oref)), float(np.sum(cents_oref * cents_oref)),
+         cost_oref] + [float(v) for v in cents_oref[0]]
+    )
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITKMOOC ")]
+        assert line, f"worker {pid} printed no FITKMOOC line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected_km_ooc, rtol=1e-4, atol=1e-6,
+            err_msg=(
+                f"worker {pid} FITKMOOC: per-process out-of-core KMeans "
+                "diverged from the single-process concatenated-order fit"
+            ),
+        )
