@@ -457,14 +457,18 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             (dim,) = agree_max(sample.shape[1] if n_seen else 0)
             if dim == 0:
                 raise ValueError("empty source")
+            # the row-count check precedes the pool build so an under-k
+            # dataset reports 'k exceeds number of rows', not the pool's
+            # 'raise INIT_SAMPLE_CAP' (which could not help) — matching
+            # the in-memory path's diagnostic order
+            n_global = int(agree_sum(np.asarray([n_seen]))[0])
+            if n_global < k:
+                raise ValueError(f"k={k} exceeds number of rows {n_global}")
             pool = _allgather_sample_pool(
                 sample.reshape(-1, dim) if n_seen else
                 np.zeros((0, dim), dtype=np.float64),
                 per, dim, k,
             )
-            n_global = int(agree_sum(np.asarray([n_seen]))[0])
-            if n_global < k:
-                raise ValueError(f"k={k} exceeds number of rows {n_global}")
             (pad_to_blocks,) = agree_max(-(-n_seen // rows_per_block))
             cents0 = kmeans_plus_plus(
                 pool, k, np.random.RandomState(self.get_seed())

@@ -1433,27 +1433,26 @@ def train_glm_sparse_hotcold(
         return resolved[0]
 
     def place(params):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
 
-        from flink_ml_tpu.parallel.mesh import replicate
+        from flink_ml_tpu.parallel.mesh import global_put, replicate
 
         w0, b0 = params
         h = hs()
         # scatter (not gather-by-inv_perm): dead positions of a rounded-up
         # 2-D layout must hold zero, not a duplicated weight
-        w_perm = (
-            jnp.zeros((h.dim_pad,), jnp.float32)
-            .at[jnp.asarray(h.perm)]
-            .set(jnp.asarray(w0, jnp.float32))
-        )
+        w_perm = np.zeros((h.dim_pad,), np.float32)
+        w_perm[h.perm] = np.asarray(w0, np.float32)
         if h.model_size > 1:
+            # multi-process-safe: every process derives the same permuted
+            # vector and materializes only its model-axis slice
             return (
-                jax.device_put(w_perm, NamedSharding(mesh, P("model"))),
-                jax.device_put(
-                    jnp.asarray(b0, jnp.float32), NamedSharding(mesh, P())
-                ),
+                global_put(mesh, w_perm, P("model")),
+                global_put(mesh, np.asarray(b0, np.float32), P()),
             )
-        return replicate(mesh, (w_perm, jnp.asarray(b0, jnp.float32)))
+        return replicate(
+            mesh, (jnp.asarray(w_perm), jnp.asarray(b0, jnp.float32))
+        )
 
     def trim(params):
         return (np.asarray(params[0])[hs().perm], params[1])
@@ -1553,7 +1552,13 @@ def make_dense_glm_train_fn_2d(
 def place_dense_2d_batch(mesh, stack: MinibatchStack, dim_pad: int):
     """Device placement for the feature-sharded dense layout: x's feature
     dim pads to the model-axis multiple and shards over ('data', -, 'model');
-    y/w shard over 'data' only (replicated across feature shards)."""
+    y/w shard over 'data' only (replicated across feature shards).
+
+    Multi-process, ``stack`` holds this process's LOCAL rows (the
+    per-process file-shard contract): each process owns whole data-axis
+    positions spanning ALL model columns, so its local block is its full
+    addressable portion and rides
+    ``jax.make_array_from_process_local_data`` like every other batch."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     x = stack.x
@@ -1561,6 +1566,20 @@ def place_dense_2d_batch(mesh, stack: MinibatchStack, dim_pad: int):
         xp = np.zeros((x.shape[0], x.shape[1], dim_pad), dtype=x.dtype)
         xp[..., : x.shape[2]] = x
         x = xp
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        def put(arr, spec):
+            arr = np.asarray(arr)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), arr,
+                global_shape=(arr.shape[0] * n_proc,) + arr.shape[1:],
+            )
+
+        return (
+            put(x, P("data", None, "model")),
+            put(stack.y, P("data")),
+            put(stack.w, P("data")),
+        )
     return (
         jax.device_put(x, NamedSharding(mesh, P("data", None, "model"))),
         jax.device_put(stack.y, NamedSharding(mesh, P("data"))),
@@ -1632,21 +1651,26 @@ def make_feature_shard_placer(mesh, dim: int, model_size: int):
     'model', intercept replicated); ``trim`` slices the padding back off.
     The ONE copy of this logic — the in-memory 2-D driver and the
     out-of-core 2-D path both use it, so their placements cannot drift.
+    Multi-process-safe: every process derives the identical full weight
+    vector and materializes only its model-axis slice
+    (:func:`~flink_ml_tpu.parallel.mesh.global_put`).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.mesh import global_put
 
     dim_pad = -(-dim // model_size) * model_size
 
     def place(params):
         w0, b0 = params
-        w0 = jnp.asarray(w0)
+        w0 = np.asarray(w0, dtype=np.float32)
         if dim_pad != int(w0.shape[0]):
-            w0 = jnp.concatenate(
-                [w0, jnp.zeros((dim_pad - w0.shape[0],), w0.dtype)]
+            w0 = np.concatenate(
+                [w0, np.zeros((dim_pad - w0.shape[0],), w0.dtype)]
             )
         return (
-            jax.device_put(w0, NamedSharding(mesh, P("model"))),
-            jax.device_put(jnp.asarray(b0), NamedSharding(mesh, P())),
+            global_put(mesh, w0, P("model")),
+            global_put(mesh, np.asarray(b0, dtype=np.float32), P()),
         )
 
     def trim(params):

@@ -241,12 +241,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 "for dense training"
             )
         model_sharded = dict(mesh.shape).get("model", 1) > 1
-        if model_sharded:
-            # guard BEFORE the full-dataset pack below: per-process assembly
-            # of a ('data', -, 'model')-sharded batch is not wired up yet
-            from flink_ml_tpu.parallel.mesh import require_single_process
-
-            require_single_process("dense feature-sharded (2-D) training")
         X, dim = resolve_features(table, self)
         layout_key = ("dense", vector_col, tuple(self.get_feature_cols() or ()),
                       self.get_label_col(), n_dev, batch_share)
@@ -433,23 +427,17 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             split_hot_cold,
             train_glm_sparse_hotcold,
         )
-        from flink_ml_tpu.parallel.mesh import require_single_process
 
         model_size = dict(mesh.shape).get("model", 1)
         counts = None
         plan = None
         min_hot_pad = min_cold_pad = 0
         if jax.process_count() > 1:
-            if model_size > 1:
-                # the model-axis weight placement (device_put to a global
-                # NamedSharding) is single-controller; multi-process needs
-                # a per-process model-shard assembly first
-                require_single_process(
-                    "feature-sharded (2-D) hot/cold training"
-                )
             # every process must select the same hot set and fill the same
             # shapes: agree on the GLOBAL frequency vector (sum of local
-            # entry counts) and the max pad widths before splitting
+            # entry counts) and the max pad widths before splitting; the
+            # model-axis weight placement rides global_put, so the 2-D
+            # layout works across processes too
             from flink_ml_tpu.lib.common import (
                 hotcold_entry_counts,
                 hotcold_layout_floors,
@@ -506,7 +494,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         from flink_ml_tpu.parallel.mesh import (
             data_parallel_size,
             local_data_parallel_size,
-            require_single_process,
         )
         from flink_ml_tpu.table.schema import DataTypes
 
@@ -559,12 +546,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     "numHotFeatures > 0 is not supported together with a "
                     "model-sharded (2-D) mesh for out-of-core fits; pick "
                     "one wide-model strategy"
-                )
-            if model_size > 1:
-                # single-controller: the model-axis placement is a plain
-                # device_put, not a per-process assembly
-                require_single_process(
-                    "feature-sharded (2-D) sparse out-of-core training"
                 )
             pad_to_blocks = None
             counts = None

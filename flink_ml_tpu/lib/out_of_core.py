@@ -411,6 +411,23 @@ def _drain_pending(pending: list):
 # -- block builders -----------------------------------------------------------
 
 
+def _pad_stream_to(blocks: Iterator[Tuple], pad_to_blocks: Optional[int],
+                   make_empty: Callable[[], Tuple]):
+    """Append empty no-op blocks to a block stream up to the agreed
+    per-epoch count — the ONE copy of the multi-process padding tail every
+    block factory wraps its generator with.  ``make_empty()`` builds the
+    (reusable) all-pad block lazily, after the stream pinned any
+    data-derived shape it needs."""
+    emitted = 0
+    for item in blocks:
+        yield item
+        emitted += 1
+    if pad_to_blocks is not None and emitted < pad_to_blocks:
+        empty = make_empty()
+        for _ in range(pad_to_blocks - emitted):
+            yield empty, 0
+
+
 def count_stream_rows(chunked_table) -> int:
     """Row count of a chunk stream — the dense multi-process pre-pass
     (the per-epoch block count must agree across processes; sparse fits
@@ -445,34 +462,33 @@ def dense_blocks_factory(
     rows_per_block = steps_per_chunk * mb * n_dev
 
     def factory():
+        seen_dim = [pad_dim]
+
         def gen():
-            emitted = 0
-            dim = pad_dim
             for X, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
                 X = np.asarray(X)
                 y = np.asarray(y)
-                dim = X.shape[1]
+                seen_dim[0] = X.shape[1]
                 stack = pack_minibatches(
                     X, y, n_dev, global_batch_size=mb * n_dev,
                     min_steps=steps_per_chunk,
                 )
                 yield _combined_view(stack), stack.n_rows
-                emitted += 1
-            if pad_to_blocks is not None and emitted < pad_to_blocks:
-                if dim is None:
-                    raise ValueError(
-                        "cannot pad an empty stream to the agreed block "
-                        "count without a known feature width"
-                    )
-                empty = np.zeros(
-                    (n_dev * steps_per_chunk, mb, dim + 2), dtype=np.float32
-                )
-                for _ in range(pad_to_blocks - emitted):
-                    yield empty, 0
 
-        return gen()
+        def make_empty():
+            if seen_dim[0] is None:
+                raise ValueError(
+                    "cannot pad an empty stream to the agreed block "
+                    "count without a known feature width"
+                )
+            return np.zeros(
+                (n_dev * steps_per_chunk, mb, seen_dim[0] + 2),
+                dtype=np.float32,
+            )
+
+        return _pad_stream_to(gen(), pad_to_blocks, make_empty)
 
     return factory
 
@@ -531,7 +547,6 @@ def sparse_blocks_factory(
 
     def factory():
         def gen():
-            emitted = 0
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
@@ -539,15 +554,11 @@ def sparse_blocks_factory(
                     vectors, y, n_dev, mb, steps_per_chunk, dim, nnz_pad
                 )
                 yield (stack.ints, stack.floats), stack.n_rows
-                emitted += 1
-            if pad_to_blocks is not None and emitted < pad_to_blocks:
-                empty = _empty_sparse_block(
-                    n_dev * steps_per_chunk, mb, nnz_pad
-                )
-                for _ in range(pad_to_blocks - emitted):
-                    yield empty, 0
 
-        return gen()
+        return _pad_stream_to(
+            gen(), pad_to_blocks,
+            lambda: _empty_sparse_block(n_dev * steps_per_chunk, mb, nnz_pad),
+        )
 
     return factory
 
@@ -572,35 +583,33 @@ def rows_blocks_factory(
         raise ValueError("rows_per_block must be a multiple of n_dev")
 
     def factory():
+        seen_dim = [pad_dim]
+
         def gen():
-            emitted = 0
-            dim = pad_dim
             for (X,) in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
                 X = np.asarray(X, dtype=np.float32)
-                dim = X.shape[1]
+                seen_dim[0] = X.shape[1]
                 n = X.shape[0]
                 Xp = np.zeros((rows_per_block, X.shape[1]), dtype=np.float32)
                 wp = np.zeros((rows_per_block,), dtype=np.float32)
                 Xp[:n] = X
                 wp[:n] = 1.0
                 yield (Xp, wp), n
-                emitted += 1
-            if pad_to_blocks is not None and emitted < pad_to_blocks:
-                if dim is None:
-                    raise ValueError(
-                        "cannot pad an empty stream to the agreed block "
-                        "count without a known feature width"
-                    )
-                empty = (
-                    np.zeros((rows_per_block, dim), dtype=np.float32),
-                    np.zeros((rows_per_block,), dtype=np.float32),
-                )
-                for _ in range(pad_to_blocks - emitted):
-                    yield empty, 0
 
-        return gen()
+        def make_empty():
+            if seen_dim[0] is None:
+                raise ValueError(
+                    "cannot pad an empty stream to the agreed block "
+                    "count without a known feature width"
+                )
+            return (
+                np.zeros((rows_per_block, seen_dim[0]), dtype=np.float32),
+                np.zeros((rows_per_block,), dtype=np.float32),
+            )
+
+        return _pad_stream_to(gen(), pad_to_blocks, make_empty)
 
     return factory
 
@@ -892,7 +901,6 @@ def hotcold_blocks_factory(
 
     def factory():
         def gen():
-            emitted = 0
             for vectors, y in _block_rows(
                 chunked_table.chunks(), extract, rows_per_block
             ):
@@ -916,17 +924,16 @@ def hotcold_blocks_factory(
                     (h.hot_ints, h.hot_vals, h.cold.ints, h.cold.floats),
                     stack.n_rows,
                 )
-                emitted += 1
-            if pad_to_blocks is not None and emitted < pad_to_blocks:
-                n_groups = n_dev * steps_per_chunk
-                ci, cf = _empty_sparse_block(n_groups, mb, nnz_pad)
-                hi = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
-                hi[:, 1, :] = mb  # pad rows -> the scatter sink row
-                hv = np.zeros((n_groups, nnz_pad), dtype=np.float32)
-                for _ in range(pad_to_blocks - emitted):
-                    yield (hi, hv, ci, cf), 0
 
-        return gen()
+        def make_empty():
+            n_groups = n_dev * steps_per_chunk
+            ci, cf = _empty_sparse_block(n_groups, mb, nnz_pad)
+            hi = np.zeros((n_groups, 2, nnz_pad), dtype=np.int32)
+            hi[:, 1, :] = mb  # pad rows -> the scatter sink row
+            hv = np.zeros((n_groups, nnz_pad), dtype=np.float32)
+            return hi, hv, ci, cf
+
+        return _pad_stream_to(gen(), pad_to_blocks, make_empty)
 
     return factory
 

@@ -170,6 +170,24 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     return jax.tree_util.tree_map(_put, batch)
 
 
+def global_put(mesh: Mesh, host_array, spec: P):
+    """Place a host array every process holds IN FULL (identical values —
+    the broadcast-variable contract) onto an arbitrary mesh sharding.
+
+    ``jax.device_put`` cannot target shardings spanning other processes'
+    devices; ``make_array_from_callback`` can — each process serves only
+    its addressable shards by slicing its full host copy.  This is what
+    unlocks model-axis (feature-sharded) parameters in multi-process runs:
+    the weight pytree is deterministically derived on every process, and
+    each process materializes just its slice.  Single-process it is
+    equivalent to a plain sharded device_put."""
+    arr = np.asarray(host_array)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def replicate(mesh: Mesh, pytree):
     """Replicate a pytree to every device — the broadcast-variable analog
     (BroadcastVariableModelSource.java:44-46 -> one all-devices placement).

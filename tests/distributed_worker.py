@@ -232,6 +232,44 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # 2-D (data x model) mesh ACROSS PROCESSES: the global mesh shards the
+    # feature dimension over 'model' while each process feeds its own data
+    # rows; model-axis params place via global_put (every process holds
+    # the full vector, materializes its slice).  Digests must match the
+    # parent's single-process fits on the same-shaped mesh.
+    from flink_ml_tpu.parallel.mesh import create_mesh
+
+    mesh2d = create_mesh({"data": 2 * num_processes, "model": 2})
+    MLEnvironmentFactory.get_default().set_mesh(mesh2d)
+    try:
+        w_d2, b_d2 = fit_shard_table(source.read())
+        print(
+            "FITD2D " + " ".join(
+                f"{v:.9e}" for v in list(w_d2) + [b_d2]
+            ),
+            flush=True,
+        )
+        w_s2, b_s2 = fit_sparse_shard_table(sparse_table)
+        digest = [float(np.sum(w_s2)), float(np.sum(w_s2 * w_s2))]
+        probe = [float(v) for v in w_s2[:8]]
+        print(
+            "FITS2D " + " ".join(
+                f"{v:.9e}" for v in digest + probe + [b_s2]
+            ),
+            flush=True,
+        )
+        w_h2, b_h2 = fit_sparse_shard_table(sparse_table, hot_k=16)
+        digest = [float(np.sum(w_h2)), float(np.sum(w_h2 * w_h2))]
+        probe = [float(v) for v in w_h2[:8]]
+        print(
+            "FITH2D " + " ".join(
+                f"{v:.9e}" for v in digest + probe + [b_h2]
+            ),
+            flush=True,
+        )
+    finally:
+        MLEnvironmentFactory.get_default().set_mesh(mesh)
+
     # KMeans OUT-OF-CORE across processes: the reservoir pass doubles as
     # the row count for the agreed per-epoch block count, the init pool
     # allgathers, and Lloyd accumulators psum across the process boundary

@@ -257,6 +257,45 @@ def test_two_process_mesh_psum(tmp_path):
             ),
         )
 
+    # 2-D (data x model) mesh: the single-process references run on the
+    # same-shaped mesh over this process's 8 local devices; the workers'
+    # global mesh spans both processes, with model-axis params placed via
+    # global_put from each process's full host copy
+    from flink_ml_tpu.parallel.mesh import create_mesh
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    env = MLEnvironmentFactory.get_default()
+    old_mesh = env.get_mesh()
+    env.set_mesh(create_mesh({"data": 4, "model": 2}))
+    try:
+        w_d2, b_d2 = fit_shard_table(ref_table)
+        expected_d2 = list(w_d2) + [b_d2]
+        w_s2, b_s2 = fit_sparse_shard_table(sref)
+        expected_s2 = (
+            [float(np.sum(w_s2)), float(np.sum(w_s2 * w_s2))]
+            + [float(v) for v in w_s2[:8]] + [b_s2]
+        )
+        w_h2, b_h2 = fit_sparse_shard_table(sref, hot_k=16)
+        expected_h2 = (
+            [float(np.sum(w_h2)), float(np.sum(w_h2 * w_h2))]
+            + [float(v) for v in w_h2[:8]] + [b_h2]
+        )
+    finally:
+        env.set_mesh(old_mesh)
+    for tag, expected in (("FITD2D", expected_d2), ("FITS2D", expected_s2),
+                          ("FITH2D", expected_h2)):
+        for pid, out in enumerate(outs):
+            line = [ln for ln in out.splitlines() if ln.startswith(tag + " ")]
+            assert line, f"worker {pid} printed no {tag} line:\n{out}"
+            got = [float(v) for v in line[0].split()[1:]]
+            np.testing.assert_allclose(
+                got, expected, rtol=1e-5, atol=1e-7,
+                err_msg=(
+                    f"worker {pid} {tag}: cross-process 2-D fit diverged "
+                    "from the single-process same-mesh fit"
+                ),
+            )
+
     # KMeans out-of-core: same init (under-cap reservoir = the dataset in
     # concatenated order on both sides), Lloyd accumulation differs only
     # in per-device grouping — looser float tolerance than the GLMs'
