@@ -1557,33 +1557,20 @@ def place_dense_2d_batch(mesh, stack: MinibatchStack, dim_pad: int):
     Multi-process, ``stack`` holds this process's LOCAL rows (the
     per-process file-shard contract): each process owns whole data-axis
     positions spanning ALL model columns, so its local block is its full
-    addressable portion and rides
-    ``jax.make_array_from_process_local_data`` like every other batch."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    addressable portion and rides the same local-block assembly as every
+    other batch (:func:`~flink_ml_tpu.parallel.mesh.shard_batch_specs`)."""
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel.mesh import shard_batch_specs
 
     x = stack.x
     if dim_pad != x.shape[2]:
         xp = np.zeros((x.shape[0], x.shape[1], dim_pad), dtype=x.dtype)
         xp[..., : x.shape[2]] = x
         x = xp
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        def put(arr, spec):
-            arr = np.asarray(arr)
-            return jax.make_array_from_process_local_data(
-                NamedSharding(mesh, spec), arr,
-                global_shape=(arr.shape[0] * n_proc,) + arr.shape[1:],
-            )
-
-        return (
-            put(x, P("data", None, "model")),
-            put(stack.y, P("data")),
-            put(stack.w, P("data")),
-        )
-    return (
-        jax.device_put(x, NamedSharding(mesh, P("data", None, "model"))),
-        jax.device_put(stack.y, NamedSharding(mesh, P("data"))),
-        jax.device_put(stack.w, NamedSharding(mesh, P("data"))),
+    return shard_batch_specs(
+        mesh, (x, stack.y, stack.w),
+        (P("data", None, "model"), P("data"), P("data")),
     )
 
 
@@ -2014,11 +2001,14 @@ def apply_sharded(apply_factory, X: np.ndarray, *args, bucket_minimum: int = 256
     :func:`~flink_ml_tpu.parallel.collectives.make_data_parallel_apply`);
     rows pad to a multiple of the data-axis size so the shard_map sees equal
     shards.  The single shared entry point for every ModelMapper hot path.
+    Multi-process it runs on the process-LOCAL mesh
+    (:func:`~flink_ml_tpu.parallel.mesh.inference_mesh`): each process
+    scores its own rows with its own model copy, no collectives.
     """
-    from flink_ml_tpu.parallel.mesh import data_parallel_size
+    from flink_ml_tpu.parallel.mesh import data_parallel_size, inference_mesh
     from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
-    mesh = MLEnvironmentFactory.get_default().get_mesh()
+    mesh = inference_mesh(MLEnvironmentFactory.get_default().get_mesh())
     return apply_batched(
         apply_factory(mesh), X, *args,
         bucket_minimum=bucket_minimum,

@@ -235,12 +235,15 @@ class KnnModelMapper(ModelMapper):
 
         from flink_ml_tpu.parallel.mesh import (
             data_parallel_size,
-            require_single_process,
+            inference_mesh,
         )
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
-        mesh = MLEnvironmentFactory.get_default().get_mesh()
-        require_single_process("Knn model placement")
+        # multi-process, the model places on the process-LOCAL mesh: each
+        # process holds its own full model copy and scores its own rows
+        # (subtask-local ModelMapperAdapter semantics); shardModelData then
+        # spreads the reference set over this process's chips only
+        mesh = inference_mesh(MLEnvironmentFactory.get_default().get_mesh())
         n_dev = data_parallel_size(mesh)
         self._sharded = (
             bool(self._model_stage.get_shard_model_data()) and n_dev > 1
@@ -258,9 +261,13 @@ class KnnModelMapper(ModelMapper):
         yp = np.full((n_pad,), np.inf, dtype=np.float32)
         yp[: y.shape[0]] = y_ids
         if self._sharded:
-            from flink_ml_tpu.parallel.mesh import shard_batch
+            # direct local placement (not shard_batch, whose multi-process
+            # branch assembles GLOBAL batches): the inference mesh is fully
+            # addressable by this process in every configuration
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self._xt, self._yt = shard_batch(mesh, (Xp, yp))
+            self._xt = jax.device_put(Xp, NamedSharding(mesh, P("data")))
+            self._yt = jax.device_put(yp, NamedSharding(mesh, P("data")))
         else:
             self._xt = jnp.asarray(Xp)
             self._yt = jnp.asarray(yp)

@@ -125,16 +125,6 @@ def agree_sum(array: np.ndarray) -> np.ndarray:
     return np.sum(gathered, axis=0)
 
 
-def require_single_process(what: str) -> None:
-    """Loud guard for paths whose multi-process semantics are not yet
-    defined (data-dependent per-process layout or init would silently
-    diverge across processes)."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            f"{what} is not yet supported in multi-process runs"
-        )
-
-
 def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     """Place a host batch pytree on the mesh, sharded along ``axis`` on dim 0.
 
@@ -152,22 +142,57 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     :func:`local_data_parallel_size` shards and the per-process slice of the
     global batch size).  Single-process behavior is unchanged.
     """
-    n_proc = jax.process_count()
-
     def _put(x):
         ndim = getattr(x, "ndim", 0)
-        spec = P(axis) if ndim >= 1 else P()
-        if n_proc > 1:
-            x = np.asarray(x)
-            global_shape = (
-                (x.shape[0] * n_proc,) + x.shape[1:] if ndim >= 1 else x.shape
-            )
-            return jax.make_array_from_process_local_data(
-                NamedSharding(mesh, spec), x, global_shape=global_shape
-            )
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _place_local_block(
+            mesh, x, P(axis) if ndim >= 1 else P()
+        )
 
     return jax.tree_util.tree_map(_put, batch)
+
+
+def _place_local_block(mesh: Mesh, x, spec: P):
+    """The ONE copy of the per-process batch-assembly contract: a host
+    array holding this process's LOCAL rows becomes its slice of the
+    global batch (``jax.make_array_from_process_local_data``; global
+    leading dim = local * process_count in process order), or a plain
+    sharded device_put single-process.  ``spec``'s leading entry is the
+    row axis; other entries may shard trailing dims the process spans in
+    full (e.g. the dense 2-D ('data', None, 'model') layout)."""
+    n_proc = jax.process_count()
+    ndim = getattr(x, "ndim", 0)
+    if n_proc > 1:
+        x = np.asarray(x)
+        global_shape = (
+            (x.shape[0] * n_proc,) + x.shape[1:] if ndim >= 1 else x.shape
+        )
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), x, global_shape=global_shape
+        )
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_batch_specs(mesh: Mesh, arrays: Sequence, specs: Sequence[P]):
+    """Per-leaf-spec variant of :func:`shard_batch` for layouts beyond
+    row-axis-only sharding; same multi-process local-block contract
+    (:func:`_place_local_block`)."""
+    return tuple(
+        _place_local_block(mesh, a, s) for a, s in zip(arrays, specs)
+    )
+
+
+def inference_mesh(mesh: Mesh) -> Mesh:
+    """The mesh model-apply paths run on: the session mesh single-process;
+    multi-process, a LOCAL data-parallel mesh over this process's devices.
+
+    Inference is row-parallel with a broadcast model — the reference's
+    ModelMapperAdapter semantic (ModelMapperAdapter.java:53-61: every
+    subtask materializes the model and maps its own partition
+    independently) — so transform time never needs a cross-process
+    collective; each process scores its own rows on its own chips."""
+    if jax.process_count() == 1:
+        return mesh
+    return Mesh(np.array(jax.local_devices()), ("data",))
 
 
 def global_put(mesh: Mesh, host_array, spec: P):

@@ -232,6 +232,46 @@ if len(sys.argv) > 4:
         flush=True,
     )
 
+    # TRANSFORM in a multi-process session runs on the process-LOCAL mesh
+    # (subtask-local ModelMapperAdapter semantics): each process scores its
+    # own rows with its own model copy, no collectives.  GLM scoring and
+    # sharded-reference Knn both must match the parent's single-process
+    # transform of the same shard.
+    from flink_ml_tpu.lib import Knn, LogisticRegression
+    from tests._distributed_common import (
+        LEARNING_RATE,
+        SHARD_EPOCHS,
+        SHARD_FEATURES,
+        SHARD_G,
+    )
+
+    est = (
+        LogisticRegression().set_feature_cols(SHARD_FEATURES)
+        .set_label_col("label").set_prediction_col("pred")
+        .set_learning_rate(LEARNING_RATE).set_max_iter(SHARD_EPOCHS)
+        .set_global_batch_size(SHARD_G)
+    )
+    local_table = source.read()
+    glm_model = est.fit(local_table)
+    (scored,) = glm_model.transform(local_table)
+    preds = np.asarray(scored.col("pred"), dtype=np.float64)
+    print(
+        "XFORM " + " ".join(f"{v:.0f}" for v in preds[:32]),
+        flush=True,
+    )
+
+    knn = (
+        Knn().set_feature_cols(SHARD_FEATURES).set_label_col("label")
+        .set_prediction_col("knnp").set_k(3).set_shard_model_data(True)
+        .fit(local_table)
+    )
+    (kscored,) = knn.transform(local_table)
+    kpreds = np.asarray(kscored.col("knnp"), dtype=np.float64)
+    print(
+        "XFORMKNN " + " ".join(f"{v:.0f}" for v in kpreds[:32]),
+        flush=True,
+    )
+
     # 2-D (data x model) mesh ACROSS PROCESSES: the global mesh shards the
     # feature dimension over 'model' while each process feeds its own data
     # rows; model-axis params place via global_put (every process holds

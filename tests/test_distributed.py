@@ -257,6 +257,48 @@ def test_two_process_mesh_psum(tmp_path):
             ),
         )
 
+    # transform runs per-process on the local mesh: worker p's predictions
+    # over ITS shard must match the single-process transform of that shard
+    from flink_ml_tpu.lib import Knn
+    from tests._distributed_common import SHARD_FEATURES
+
+    from flink_ml_tpu.lib.classification import LogisticRegressionModel
+    from flink_ml_tpu.lib.glm import make_model_table
+
+    for pid, out in enumerate(outs):
+        Xs, ys = shards[pid]
+        shard_table = Table.from_columns(
+            shard_schema(),
+            {**{f"f{i}": Xs[:, i] for i in range(Xs.shape[1])}, "label": ys},
+        )
+        # the worker's GLM model is the cross-process (global) fit — the
+        # same coefficients as the FITMEM reference; its transform runs on
+        # the process-local mesh over the worker's own shard
+        glm_ref = (
+            LogisticRegressionModel().set_feature_cols(SHARD_FEATURES)
+            .set_prediction_col("pred")
+        )
+        glm_ref.set_model_data(make_model_table(w_ref, b_ref))
+        (ref_scored,) = glm_ref.transform(shard_table)
+        ref_preds = np.asarray(ref_scored.col("pred"))[:32]
+        line = [ln for ln in out.splitlines() if ln.startswith("XFORM ")]
+        assert line, f"worker {pid} printed no XFORM line:\n{out}"
+        got = np.asarray([float(v) for v in line[0].split()[1:]])
+        np.testing.assert_allclose(got, ref_preds, atol=0,
+                                   err_msg=f"worker {pid} XFORM diverged")
+        knn_ref = (
+            Knn().set_feature_cols(SHARD_FEATURES).set_label_col("label")
+            .set_prediction_col("knnp").set_k(3).set_shard_model_data(True)
+            .fit(shard_table)
+        )
+        (kref,) = knn_ref.transform(shard_table)
+        kref_preds = np.asarray(kref.col("knnp"))[:32]
+        line = [ln for ln in out.splitlines() if ln.startswith("XFORMKNN ")]
+        assert line, f"worker {pid} printed no XFORMKNN line:\n{out}"
+        got = np.asarray([float(v) for v in line[0].split()[1:]])
+        np.testing.assert_allclose(got, kref_preds, atol=0,
+                                   err_msg=f"worker {pid} XFORMKNN diverged")
+
     # 2-D (data x model) mesh: the single-process references run on the
     # same-shaped mesh over this process's 8 local devices; the workers'
     # global mesh spans both processes, with model-axis params placed via
