@@ -1321,36 +1321,92 @@ def make_hotcold_mb_grad_step_2d(kind: str, mb: int, cold_nnz_pad: int,
         idx, rid, vals, y, w = _segment_csr_unpack(
             ints, floats, cold_nnz_pad, mb
         )
-        lo = jax.lax.axis_index("model") * dim_local
-        local_idx = idx - lo
-        mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
-        safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
-        dtype = slab.dtype
-        w_hot = jnp.broadcast_to(
-            wts_local[:hot_k_local].astype(dtype)[:, None], (hot_k_local, 128)
+        return _hotcold_core_2d(
+            kind, slab, wts_local, b, idx, rid, vals, y, w,
+            mb, hot_k_local, dim_local, keep_b,
         )
-        hot_partial = jax.lax.dot_general(
-            slab, w_hot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )[:, 0]
-        contrib = jnp.where(
-            mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
+
+    return mb_grad_step
+
+
+def _hotcold_core_2d(kind: str, slab, wts_local, b, idx, rid, vals, y, w,
+                     mb: int, hot_k_local: int, dim_local: int,
+                     keep_b: float):
+    """The feature-sharded hot/cold minibatch math (the model-axis analog
+    of :func:`_hotcold_core`): shard-local slab GEMMs + cold entries masked
+    to local ownership + one psum over ``model`` completing the logits.
+    Shared by the in-memory step (pre-densified slab) and the out-of-core
+    step (slab densified in-program), so the two cannot drift — the
+    streamed-vs-in-memory bit-match contract depends on it."""
+    lo = jax.lax.axis_index("model") * dim_local
+    local_idx = idx - lo
+    mine = jnp.logical_and(local_idx >= 0, local_idx < dim_local)
+    safe_idx = jnp.clip(local_idx, 0, dim_local - 1)
+    dtype = slab.dtype
+    w_hot = jnp.broadcast_to(
+        wts_local[:hot_k_local].astype(dtype)[:, None], (hot_k_local, 128)
+    )
+    hot_partial = jax.lax.dot_general(
+        slab, w_hot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    contrib = jnp.where(
+        mine, vals * jnp.take(wts_local, safe_idx, axis=0), 0.0
+    )
+    cold_partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
+    # the TP allreduce: complete logits across feature shards
+    logits = jax.lax.psum(hot_partial + cold_partial, "model") + b
+    err, loss_sum = _sparse_loss(kind, logits, y, w)
+    err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
+    g_hot = jax.lax.dot_general(
+        slab, err_m, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+    scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
+    g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
+    g_w = g_w.at[:hot_k_local].add(g_hot)
+    g_b = jnp.sum(err) * keep_b
+    return (g_w, g_b), loss_sum, jnp.sum(w)
+
+
+def make_hotcold_stream_mb_grad_step_2d(kind: str, mb: int,
+                                        cold_nnz_pad: int, hot_k_local: int,
+                                        dim_local: int,
+                                        with_intercept: bool = True,
+                                        slab_dtype=jnp.bfloat16):
+    """Feature-sharded out-of-core hot/cold minibatch gradient: the
+    model-axis composition of :func:`make_hotcold_stream_mb_grad_step`
+    (in-program slab densify from packed entries) and
+    :func:`make_hotcold_mb_grad_step_2d` (shard-local slab columns + cold
+    range, one psum completing logits).  Consumes the SAME block layout as
+    the 1-D stream step — entries carry global slab columns / permuted
+    ids, and each shard masks to its ownership in-program."""
+    keep_b = 1.0 if with_intercept else 0.0
+    dtype = jnp.dtype(slab_dtype)
+
+    def mb_grad_step(params, xs):
+        h_ints, h_vals, ints, floats = xs
+        wts_local, b = params  # (dim_local,), ()
+        pos, hrid = h_ints[0], h_ints[1]
+        lo_col = jax.lax.axis_index("model") * hot_k_local
+        lpos = pos - lo_col
+        mine_h = jnp.logical_and(lpos >= 0, lpos < hot_k_local)
+        slab = (
+            jnp.zeros((mb + 1, hot_k_local), dtype)  # row mb = pad sink
+            .at[
+                jnp.where(mine_h, hrid, mb),
+                jnp.clip(lpos, 0, hot_k_local - 1),
+            ]
+            .add(jnp.where(mine_h, h_vals, 0.0).astype(dtype))[:mb]
         )
-        cold_partial = jax.ops.segment_sum(contrib, rid, num_segments=mb)
-        # the TP allreduce: complete logits across feature shards
-        logits = jax.lax.psum(hot_partial + cold_partial, "model") + b
-        err, loss_sum = _sparse_loss(kind, logits, y, w)
-        err_m = jnp.broadcast_to(err.astype(dtype)[:, None], (mb, 128))
-        g_hot = jax.lax.dot_general(
-            slab, err_m, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )[:, 0]
-        err_ext = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
-        scatter = jnp.where(mine, vals * jnp.take(err_ext, rid, axis=0), 0.0)
-        g_w = jax.ops.segment_sum(scatter, safe_idx, num_segments=dim_local)
-        g_w = g_w.at[:hot_k_local].add(g_hot)
-        g_b = jnp.sum(err) * keep_b
-        return (g_w, g_b), loss_sum, jnp.sum(w)
+        idx, rid, vals, y, w = _segment_csr_unpack(
+            ints, floats, cold_nnz_pad, mb
+        )
+        return _hotcold_core_2d(
+            kind, slab, wts_local, b, idx, rid, vals, y, w,
+            mb, hot_k_local, dim_local, keep_b,
+        )
 
     return mb_grad_step
 

@@ -538,15 +538,6 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                     "out-of-core sparse training requires numFeatures (the "
                     "global dimension cannot be inferred from a stream)"
                 )
-            # config-only guards BEFORE any stream pass: a misconfigured
-            # multi-process fit must fail in milliseconds, not after every
-            # process read its whole shard
-            if hot_k > 0 and model_size > 1:
-                raise NotImplementedError(
-                    "numHotFeatures > 0 is not supported together with a "
-                    "model-sharded (2-D) mesh for out-of-core fits; pick "
-                    "one wide-model strategy"
-                )
             pad_to_blocks = None
             counts = None
             if jax.process_count() > 1:
@@ -749,32 +740,94 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             from flink_ml_tpu.parallel.mesh import agree_sum
 
             counts = agree_sum(counts)
-        fplan = hotcold_feature_plan(dim, hot_k, 1, counts)
+        model_size = dict(mesh.shape).get("model", 1)
+        fplan = hotcold_feature_plan(dim, hot_k, model_size, counts)
         dim_pad = fplan["dim_pad"]
         hot_k_eff = fplan["hot_k_eff"]
+        # the SAME block layout serves 1-D and 2-D (entries carry global
+        # slab columns / permuted ids; the 2-D step masks to its shard
+        # ownership in-program)
         blocks = oc.hotcold_blocks_factory(
             table, extract, n_dev, mb, steps_per_chunk, dim, nnz_pad,
             hot_k, fplan, pad_to_blocks=pad_to_blocks,
         )
-        mb_grad = make_hotcold_stream_mb_grad_step(
-            self.LOSS_KIND, mb, nnz_pad, hot_k_eff, dim_pad,
-            self.get_with_intercept(),
-        )
-        key = ("chunk-hotcold", self.LOSS_KIND, mesh, mb, nnz_pad,
-               hot_k_eff, dim_pad, float(lr), float(reg),
-               self.get_with_intercept())
+        if model_size > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from flink_ml_tpu.lib.common import (
+                make_hotcold_stream_mb_grad_step_2d,
+            )
+            from flink_ml_tpu.parallel.mesh import global_put
+
+            mb_grad = make_hotcold_stream_mb_grad_step_2d(
+                self.LOSS_KIND, mb, nnz_pad, hot_k_eff // model_size,
+                dim_pad // model_size, self.get_with_intercept(),
+            )
+            param_spec = (P("model"), P())
+
+            def place_params(params):
+                # params are ALREADY in permuted space (zeros init or a
+                # permuted-representation checkpoint): place, don't permute
+                w0, b0 = params
+                return (
+                    global_put(
+                        mesh, np.asarray(w0, np.float32), P("model")
+                    ),
+                    global_put(mesh, np.asarray(b0, np.float32), P()),
+                )
+
+            key = ("chunk-hotcold2d", self.LOSS_KIND, mesh, mb, nnz_pad,
+                   hot_k_eff, dim_pad, float(lr), float(reg),
+                   self.get_with_intercept())
+        else:
+            mb_grad = make_hotcold_stream_mb_grad_step(
+                self.LOSS_KIND, mb, nnz_pad, hot_k_eff, dim_pad,
+                self.get_with_intercept(),
+            )
+            param_spec = None
+            place_params = None
+            key = ("chunk-hotcold", self.LOSS_KIND, mesh, mb, nnz_pad,
+                   hot_k_eff, dim_pad, float(lr), float(reg),
+                   self.get_with_intercept())
         w0 = jnp.zeros((dim_pad,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
+        # checkpointed params are in PERMUTED space: stamp the layout into
+        # the snapshot and refuse resumes under a different one (a changed
+        # mesh model size or hot_k yields a shape-compatible but
+        # differently-permuted vector — silently wrong without this)
+        import zlib
+
+        layout_sig = {
+            "model_size": model_size,
+            "hot_k_eff": hot_k_eff,
+            "dim_pad": dim_pad,
+            "perm_crc": int(zlib.crc32(fplan["perm"].tobytes())),
+        }
+
+        def validate_meta(meta):
+            stored = meta.get("hotcold_layout")
+            if stored is not None and stored != layout_sig:
+                raise ValueError(
+                    "checkpoint was written under a different hot/cold "
+                    f"layout ({stored} != {layout_sig}); resume with the "
+                    "original mesh/numHotFeatures or start fresh"
+                )
+
         use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
         with oc.maybe_spill(blocks, use_spill) as blocks:
             result = oc.train_out_of_core(
                 (w0, b0),
                 blocks,
-                lambda: oc.make_chunk_step_fn(key, mb_grad, mesh, lr, reg),
+                lambda: oc.make_chunk_step_fn(
+                    key, mb_grad, mesh, lr, reg, param_spec=param_spec
+                ),
                 mesh,
                 max_iter=self.get_max_iter(),
                 tol=self.get_tol(),
                 checkpoint=checkpoint,
+                place_params=place_params,
+                meta_extra={"hotcold_layout": layout_sig},
+                validate_meta=validate_meta,
             )
         w_t = np.asarray(result.params[0])[fplan["perm"]]
         result.params = (w_t, result.params[1])

@@ -228,6 +228,8 @@ def train_out_of_core(
     finalize: Optional[Callable] = None,
     place_params: Optional[Callable] = None,
     max_inflight_chunks: int = 4,
+    meta_extra: Optional[dict] = None,
+    validate_meta: Optional[Callable[[dict], None]] = None,
 ) -> TrainResult:
     """The streaming epoch engine.
 
@@ -286,6 +288,13 @@ def train_out_of_core(
         latest = latest_checkpoint(checkpoint.directory)
         if latest is not None:
             init_params, meta = load_checkpoint(latest, like=init_params)
+            if validate_meta is not None:
+                # the caller's chance to reject a checkpoint whose params
+                # encode a configuration-dependent representation (e.g. the
+                # hot/cold permuted layout) that no longer matches — a
+                # shape-compatible mismatch would otherwise resume silently
+                # wrong
+                validate_meta(meta)
             start_epoch = int(meta["epoch"]) + 1
             losses = list(meta.get("losses", []))
             if _meta_converged(meta, tol) or start_epoch >= max_iter:
@@ -368,7 +377,7 @@ def train_out_of_core(
             save_checkpoint(
                 checkpoint.directory, epoch - 1, host_params,
                 meta={"losses": losses, "converged": converged, "tol": tol,
-                      "final_delta": final_delta},
+                      "final_delta": final_delta, **(meta_extra or {})},
             )
             prune_checkpoints(checkpoint.directory, checkpoint.keep)
 

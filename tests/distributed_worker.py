@@ -307,6 +307,27 @@ if len(sys.argv) > 4:
             ),
             flush=True,
         )
+        # the full formulation matrix's last corner: hot/cold +
+        # out-of-core + 2-D mesh + multi-process (agree_sum'd counts feed
+        # the model_size-aware plan; the streamed 2-D chunk program masks
+        # to shard ownership; model-axis params ride global_put)
+        w_ho2, b_ho2 = fit_sparse_shard_table(
+            ChunkedTable(
+                CollectionSource(
+                    list(zip(svecs, sy)), sparse_shard_schema()
+                ),
+                chunk_rows=64,
+            ),
+            hot_k=16,
+        )
+        digest = [float(np.sum(w_ho2)), float(np.sum(w_ho2 * w_ho2))]
+        probe = [float(v) for v in w_ho2[:8]]
+        print(
+            "FITH2DOOC " + " ".join(
+                f"{v:.9e}" for v in digest + probe + [b_ho2]
+            ),
+            flush=True,
+        )
     finally:
         MLEnvironmentFactory.get_default().set_mesh(mesh)
 

@@ -304,6 +304,7 @@ def test_two_process_mesh_psum(tmp_path):
     # global mesh spans both processes, with model-axis params placed via
     # global_put from each process's full host copy
     from flink_ml_tpu.parallel.mesh import create_mesh
+    from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
     from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
     env = MLEnvironmentFactory.get_default()
@@ -322,10 +323,22 @@ def test_two_process_mesh_psum(tmp_path):
             [float(np.sum(w_h2)), float(np.sum(w_h2 * w_h2))]
             + [float(v) for v in w_h2[:8]] + [b_h2]
         )
+        w_ho2, b_ho2 = fit_sparse_shard_table(
+            ChunkedTable(
+                CollectionSource(list(zip(svecs, sy)), sparse_shard_schema()),
+                chunk_rows=64,
+            ),
+            hot_k=16,
+        )
+        expected_ho2 = (
+            [float(np.sum(w_ho2)), float(np.sum(w_ho2 * w_ho2))]
+            + [float(v) for v in w_ho2[:8]] + [b_ho2]
+        )
     finally:
         env.set_mesh(old_mesh)
     for tag, expected in (("FITD2D", expected_d2), ("FITS2D", expected_s2),
-                          ("FITH2D", expected_h2)):
+                          ("FITH2D", expected_h2),
+                          ("FITH2DOOC", expected_ho2)):
         for pid, out in enumerate(outs):
             line = [ln for ln in out.splitlines() if ln.startswith(tag + " ")]
             assert line, f"worker {pid} printed no {tag} line:\n{out}"

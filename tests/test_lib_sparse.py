@@ -366,24 +366,69 @@ class TestHotColdSplit:
             rtol=1e-6, atol=1e-9,
         )
 
-    def test_out_of_core_2d_mesh_with_hot_k_rejected(self):
+    def test_out_of_core_checkpoint_rejects_layout_change(self, tmp_path):
+        """A permuted-space stream checkpoint must refuse to resume under
+        a different hot/cold layout (changed mesh model size permutes the
+        same-shaped vector differently — silently wrong without the
+        stamp)."""
         from flink_ml_tpu.parallel.mesh import create_mesh
         from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
 
-        vecs, ys = self._power_law_data(n=50, dim=16)
+        vecs, ys = self._power_law_data(n=200)
         rows = list(zip(vecs, ys))
+
+        def chunked():
+            return ChunkedTable(CollectionSource(rows, SCHEMA),
+                                chunk_rows=64)
+
+        ck = str(tmp_path / "ck")
+        self._ooc_est(8, 64, max_iter=6, checkpoint_dir=ck,
+                      checkpoint_interval=3).fit(chunked())
         env = MLEnvironmentFactory.get_default()
         old = env.get_mesh()
         env.set_mesh(create_mesh({"data": 4, "model": 2}))
         try:
-            with pytest.raises(NotImplementedError, match="out-of-core"):
-                self._ooc_est(4, 16).fit(
-                    ChunkedTable(CollectionSource(rows, SCHEMA),
-                                 chunk_rows=16)
-                )
+            with pytest.raises(ValueError, match="different hot/cold"):
+                self._ooc_est(8, 64, max_iter=12, checkpoint_dir=ck,
+                              checkpoint_interval=3).fit(chunked())
         finally:
             env.set_mesh(old)
+
+    def test_out_of_core_2d_mesh_matches_1d(self):
+        """The full formulation matrix closes: hot/cold + out-of-core +
+        feature-sharded (2-D) mesh.  The same streamed blocks feed the
+        model-sharded chunk program (shard-local slab densify + masked
+        cold + one psum), and predictions match the 1-D streamed fit."""
+        from flink_ml_tpu.parallel.mesh import create_mesh
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        vecs, ys = self._power_law_data(n=300)
+        t = Table.from_columns(SCHEMA, {"features": vecs, "label": ys})
+        rows = list(zip(vecs, ys))
+
+        def chunked():
+            return ChunkedTable(CollectionSource(rows, SCHEMA),
+                                chunk_rows=64)
+
+        m1 = self._ooc_est(8, 64).fit(chunked())
+        env = MLEnvironmentFactory.get_default()
+        old = env.get_mesh()
+        env.set_mesh(create_mesh({"data": 4, "model": 2}))
+        try:
+            m2 = self._ooc_est(8, 64).fit(chunked())
+        finally:
+            env.set_mesh(old)
+        (p1,) = m1.transform(t)
+        (p2,) = m2.transform(t)
+        agree = np.mean(
+            np.asarray(p1.col("pred")) == np.asarray(p2.col("pred"))
+        )
+        assert agree >= 0.98, agree
+        np.testing.assert_allclose(
+            m2.coefficients(), m1.coefficients(), rtol=0.05, atol=0.02
+        )
 
     def test_out_of_core_dense_with_hot_k_rejected(self):
         from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
