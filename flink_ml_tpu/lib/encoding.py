@@ -179,7 +179,14 @@ class StringIndexerModelMapper(ModelMapper):
         result = {}
         for c, out in zip(model.get_selected_cols(),
                           model.resolved_output_cols()):
-            sorted_vals, idx = self._lookup[c]
+            entry = self._lookup.get(c)
+            if entry is None:
+                raise ValueError(
+                    f"column {c!r} has no fitted vocabulary in the model "
+                    "data (the model was fit without this column, or its "
+                    "model rows were filtered out)"
+                )
+            sorted_vals, idx = entry
             vals = _stringify(batch.col(c))
             pos = np.searchsorted(sorted_vals, vals)
             pos_safe = np.clip(pos, 0, len(sorted_vals) - 1)
@@ -404,15 +411,14 @@ def binary_auc(labels: np.ndarray, scores: np.ndarray) -> float:
     order = np.argsort(scores, kind="mergesort")
     ranks = np.empty(len(scores), dtype=np.float64)
     sorted_scores = scores[order]
-    # average ranks over ties
-    i = 0
-    rank_base = np.arange(1, len(scores) + 1, dtype=np.float64)
-    while i < len(scores):
-        j = i
-        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        ranks[order[i : j + 1]] = rank_base[i : j + 1].mean()
-        i = j + 1
+    # average ranks over ties, fully vectorized: group equal scores, then
+    # each group's average rank is (first_rank + last_rank) / 2
+    new_group = np.r_[True, sorted_scores[1:] != sorted_scores[:-1]]
+    group_id = np.cumsum(new_group) - 1
+    counts = np.bincount(group_id)
+    ends = np.cumsum(counts).astype(np.float64)  # 1-based rank of group end
+    avg_rank = ends - (counts - 1) / 2.0
+    ranks[order] = avg_rank[group_id]
     return float(
         (ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
     )

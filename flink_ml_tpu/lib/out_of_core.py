@@ -35,8 +35,10 @@ wider-than-one-chip model at once.
 from __future__ import annotations
 
 import contextlib
+import os
 import queue
 import threading
+import warnings
 from collections import deque
 from typing import Callable, Iterator, Optional, Tuple
 
@@ -216,6 +218,25 @@ def _prefetch(items: Iterator, depth: int = 2) -> Iterator:
                 pass
 
 
+_serialized_chunks_warned = False
+
+
+def _warn_serialized_chunks_once():
+    """One-time notice that the async chunk pipeline is serialized (gloo
+    rendezvous livelock workaround on multi-process CPU); set
+    FLINK_ML_TPU_ASYNC_CPU_CHUNKS=1 to keep the pipeline async."""
+    global _serialized_chunks_warned
+    if not _serialized_chunks_warned:
+        _serialized_chunks_warned = True
+        warnings.warn(
+            "multi-process CPU backend: serializing out-of-core chunk "
+            "programs to avoid a gloo in-process rendezvous livelock; "
+            "set FLINK_ML_TPU_ASYNC_CPU_CHUNKS=1 to keep the async "
+            "pipeline on",
+            stacklevel=3,
+        )
+
+
 def train_out_of_core(
     init_params,
     blocks_factory: Callable[[], Iterator[Tuple]],
@@ -273,9 +294,16 @@ def train_out_of_core(
     # backend: multihost TPU collectives run on per-core hardware queues
     # where concurrent in-flight programs are the designed norm, so the
     # async pipeline stays on for the production platform.
+    # Escape hatch for intentional multi-process CPU deployments that do
+    # not hit the gloo livelock: FLINK_ML_TPU_ASYNC_CPU_CHUNKS=1 keeps the
+    # async pipeline on.
     serialize_chunks = (
-        jax.process_count() > 1 and jax.default_backend() == "cpu"
+        jax.process_count() > 1
+        and jax.default_backend() == "cpu"
+        and os.environ.get("FLINK_ML_TPU_ASYNC_CPU_CHUNKS", "0") != "1"
     )
+    if serialize_chunks:
+        _warn_serialized_chunks_once()
 
     start_epoch = 0
     losses: list = []

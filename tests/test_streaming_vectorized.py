@@ -136,6 +136,19 @@ class TestEquivalence:
         r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
         _assert_same(r_vec, r_rec)
 
+    def test_lateness_with_small_flush_rows(self):
+        """allowed_lateness > 0 combined with a tiny prediction_flush_rows
+        (ADVICE r4): the early-flush cut at watermark+1 must group flushes
+        identically on both paths even while lateness holds windows open."""
+        ts, x, y = _train_rows(400)
+        tp, xp = _pred_rows(300)
+        kw = dict(window_ms=100, allowed_lateness_ms=150,
+                  prediction_flush_rows=8, keep_model_history=True)
+        r_vec = _run(kw, *_columnar_sources(ts, x, y, tp, xp))
+        r_rec = _run(kw, *_per_record_sources(ts, x, y, tp, xp))
+        assert len(r_vec.predictions) == 300
+        _assert_same(r_vec, r_rec)
+
     @pytest.mark.parametrize("max_windows", [1, 3, 7])
     def test_max_windows_stop(self, max_windows):
         """Mid-stream stop: the vectorized path serves exactly the
