@@ -21,6 +21,13 @@ Layer map (bottom-up, cf. SURVEY.md §7.1):
 
 __version__ = "0.1.0"
 
+from flink_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+# Warm-process startup parity with the reference's JVM (VERDICT r4 #7):
+# persist XLA executables across processes so only the first process ever
+# pays the fused-program compile.  FLINK_ML_TPU_COMPILE_CACHE=off opts out.
+enable_compilation_cache()
+
 from flink_ml_tpu.params import (  # noqa: F401
     ParamInfo,
     ParamValidator,

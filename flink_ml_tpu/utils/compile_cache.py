@@ -1,0 +1,81 @@
+"""Persistent XLA compilation cache — warm-process startup parity.
+
+The reference rides the JVM: a Flink job's operators are bytecode that
+starts in milliseconds, every run (`/root/reference/pom.xml:71-80` — plain
+Java 8, no AOT step).  The TPU framework's equivalent startup tax is XLA
+compilation: the first fit of a process pays ~10-20 s of HLO->LLO compile
+for the fused training program (measured `first_fit_s` in BENCH_r04.json:
+16.8 s).  JAX ships a persistent compilation cache that keys compiled
+executables by (HLO, compile options, backend) and replays them across
+processes; enabling it turns every warm process's compile into a disk
+read, which is the closest a compiled-accelerator framework gets to JVM
+startup.
+
+Enabled automatically at package import (see ``flink_ml_tpu/__init__``):
+
+* cache directory: ``$FLINK_ML_TPU_COMPILE_CACHE`` if set, else
+  ``~/.cache/flink_ml_tpu/xla`` (created on first use);
+* opt out with ``FLINK_ML_TPU_COMPILE_CACHE=off``;
+* thresholds are set to cache everything (min entry size / min compile
+  time both disabled) — a pipeline of small stages benefits exactly as
+  much as one big program.
+
+``scripts/compile_cache_warmstart.py`` measures the effect: it runs the
+same fit in two fresh subprocesses against a fresh cache dir and reports
+cold vs warm ``first_fit_s``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+from pathlib import Path
+
+_enabled_dir: str | None = None
+
+
+def enable_compilation_cache(directory: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``directory`` (idempotent).
+
+    Returns the cache directory in use, or ``None`` when disabled via
+    ``FLINK_ML_TPU_COMPILE_CACHE=off``.  Safe to call before or after the
+    first jit: JAX reads these config values at compile time.
+    """
+    global _enabled_dir
+    env = os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", "")
+    if env.lower() in ("off", "0", "disable", "disabled"):
+        return None
+    if directory is None:
+        directory = env or str(Path.home() / ".cache" / "flink_ml_tpu" / "xla")
+    if _enabled_dir == directory:
+        return _enabled_dir
+
+    import jax
+
+    try:
+        Path(directory).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", directory)
+        # cache every program regardless of size or compile time: the
+        # pipeline API compiles many small per-stage programs whose
+        # compiles add up
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # bound on-disk growth (JAX evicts LRU past this); older jax
+        # versions without the knob just run uncapped
+        with contextlib.suppress(AttributeError, ValueError):
+            jax.config.update(
+                "jax_compilation_cache_max_size", 2 * 1024**3
+            )
+    except OSError as e:
+        # an unwritable cache dir (read-only $HOME, locked-down container)
+        # must never make the package unimportable — fall back to no cache
+        warnings.warn(
+            f"persistent compilation cache disabled: cannot use "
+            f"{directory!r} ({e}); set FLINK_ML_TPU_COMPILE_CACHE to a "
+            "writable directory or to 'off' to silence this",
+            stacklevel=2,
+        )
+        return None
+    _enabled_dir = directory
+    return _enabled_dir
