@@ -174,6 +174,7 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
         prediction_source: Optional[UnboundedSource] = None,
         max_windows: Optional[int] = None,
         keep_model_history: bool = False,
+        checkpoint=None,
     ) -> Tuple[LogisticRegressionModel, StreamingResult]:
         self._dim, training_source = self._infer_dim(training_source)
         lr = self.get_learning_rate()
@@ -214,8 +215,8 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
             keep_model_history=keep_model_history,
             allowed_lateness_ms=self.get_allowed_lateness_ms(),
         )
-        checkpoint = None
-        if self.get_checkpoint_dir() is not None:
+        # an explicit CheckpointConfig wins over the param-derived one
+        if checkpoint is None and self.get_checkpoint_dir() is not None:
             from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
 
             checkpoint = CheckpointConfig(

@@ -19,6 +19,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def default_mesh(axis_names: Sequence[str] = ("data",), devices=None) -> Mesh:
     """All available devices laid out on the first axis (pure data parallel)."""
+    from flink_ml_tpu.utils.compile_cache import (
+        ensure_compilation_cache_for_backend,
+    )
+
+    ensure_compilation_cache_for_backend()
     devices = list(jax.devices()) if devices is None else list(devices)
     shape = [len(devices)] + [1] * (len(axis_names) - 1)
     arr = np.array(devices).reshape(shape)
@@ -27,6 +32,11 @@ def default_mesh(axis_names: Sequence[str] = ("data",), devices=None) -> Mesh:
 
 def create_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     """Mesh from an ordered ``{axis_name: size}`` spec, e.g. {'data': 4, 'model': 2}."""
+    from flink_ml_tpu.utils.compile_cache import (
+        ensure_compilation_cache_for_backend,
+    )
+
+    ensure_compilation_cache_for_backend()
     devices = list(jax.devices()) if devices is None else list(devices)
     total = math.prod(axes.values())
     if total != len(devices):

@@ -11,11 +11,15 @@ processes; enabling it turns every warm process's compile into a disk
 read, which is the closest a compiled-accelerator framework gets to JVM
 startup.
 
-Enabled automatically at package import (see ``flink_ml_tpu/__init__``):
+Enabled automatically for non-CPU backends — at package import when
+``jax_platforms`` names one explicitly, else deferred to the first mesh
+construction (where the backend initializes anyway):
 
 * cache directory: ``$FLINK_ML_TPU_COMPILE_CACHE`` if set, else
   ``~/.cache/flink_ml_tpu/xla`` (created on first use);
-* opt out with ``FLINK_ML_TPU_COMPILE_CACHE=off``;
+* opt out with ``FLINK_ML_TPU_COMPILE_CACHE=off``; CPU backends are
+  opt-in only (set the env var to a directory) — see
+  :func:`enable_compilation_cache` for why;
 * thresholds are set to cache everything (min entry size / min compile
   time both disabled) — a pipeline of small stages benefits exactly as
   much as one big program.
@@ -35,23 +39,42 @@ from pathlib import Path
 _enabled_dir: str | None = None
 
 
-def enable_compilation_cache(directory: str | None = None) -> str | None:
+def enable_compilation_cache(directory: str | None = None, *,
+                             backend_known: bool = False) -> str | None:
     """Point JAX's persistent compilation cache at ``directory`` (idempotent).
 
     Returns the cache directory in use, or ``None`` when disabled via
-    ``FLINK_ML_TPU_COMPILE_CACHE=off``.  Safe to call before or after the
-    first jit: JAX reads these config values at compile time.
+    ``FLINK_ML_TPU_COMPILE_CACHE=off`` — or deferred: default-on applies
+    only off the CPU backend (XLA:CPU AOT replay checks host machine
+    features and logs SIGILL-risk errors when the compile-time feature set
+    disagrees, observed with jax 0.9.0's +prefer-no-scatter
+    pseudo-features; the compile the cache exists to skip is the TPU one
+    anyway).  At import time the backend must not be initialized, so the
+    decision reads ``jax_platforms`` only: an explicitly non-cpu platform
+    list enables now; unset/ambiguous defers to
+    :func:`ensure_compilation_cache_for_backend`, which the mesh layer
+    calls once the backend is actually being brought up
+    (``backend_known=True`` skips the platform-string heuristic).  CPU
+    users opt in by pointing ``FLINK_ML_TPU_COMPILE_CACHE`` at a
+    directory.
     """
     global _enabled_dir
     env = os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", "")
     if env.lower() in ("off", "0", "disable", "disabled"):
         return None
+
+    import jax
+
+    if directory is None and not env and not backend_known:
+        platforms = (jax.config.jax_platforms or "").strip()
+        names = [p.strip() for p in platforms.split(",") if p.strip()]
+        if not names or all(p == "cpu" for p in names):
+            # backend unknown (auto-detect) or cpu-only: defer / skip
+            return None
     if directory is None:
         directory = env or str(Path.home() / ".cache" / "flink_ml_tpu" / "xla")
     if _enabled_dir == directory:
         return _enabled_dir
-
-    import jax
 
     try:
         Path(directory).mkdir(parents=True, exist_ok=True)
@@ -67,7 +90,7 @@ def enable_compilation_cache(directory: str | None = None) -> str | None:
             jax.config.update(
                 "jax_compilation_cache_max_size", 2 * 1024**3
             )
-    except OSError as e:
+    except OSError as e:  # pragma: no cover - needs an unwritable dir
         # an unwritable cache dir (read-only $HOME, locked-down container)
         # must never make the package unimportable — fall back to no cache
         warnings.warn(
@@ -79,3 +102,24 @@ def enable_compilation_cache(directory: str | None = None) -> str | None:
         return None
     _enabled_dir = directory
     return _enabled_dir
+
+
+def ensure_compilation_cache_for_backend() -> str | None:
+    """Finish the deferred default-on decision once the backend is known.
+
+    Called by the mesh layer right where ``jax.devices()`` initializes the
+    backend anyway — so querying ``jax.default_backend()`` here adds no
+    side effect.  Enables the cache for any non-CPU backend; no-op when
+    already enabled or opted out.
+    """
+    if _enabled_dir is not None:
+        return _enabled_dir
+    env = os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", "")
+    if env.lower() in ("off", "0", "disable", "disabled"):
+        return None
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    return enable_compilation_cache(backend_known=True)

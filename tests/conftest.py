@@ -11,6 +11,11 @@ enough — the jax config must be updated before the first backend use.
 
 import os
 
+# the persistent compilation cache is a production warm-start feature; in
+# tests it only adds disk churn and cross-process atime races (and the
+# suite's programs are tiny), so keep it off unless a test opts in
+os.environ.setdefault("FLINK_ML_TPU_COMPILE_CACHE", "off")
+
 #: FMT_TEST_TPU=1 runs the suite on the real TPU backend instead of the
 #: virtual CPU mesh — the only way to exercise the Mosaic-lowered (non-
 #: interpret) Pallas tests, which are skipped on CPU.
