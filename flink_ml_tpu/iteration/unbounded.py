@@ -635,9 +635,18 @@ class StreamingDriver:
                         pending_ts.append(int(ts))
                         pending_buf.append(row)
                 skip = restored["consumed"]
-                for _ in range(skip):
+                for done in range(skip):
                     if next(merged, None) is None:
-                        break  # replayed stream shorter than the snapshot cut
+                        # same loud contract as _ChunkCursor.skip_rows: a
+                        # short replay would otherwise "resume" into a
+                        # silently empty continuation
+                        raise ValueError(
+                            f"resume position is {skip - done} records "
+                            "past the end of the replayed stream — the "
+                            "source is shorter than at snapshot time "
+                            "(sources must be replayable for checkpointed "
+                            "runs)"
+                        )
                 consumed = skip
                 consumed_train = restored["consumed_train"]
                 consumed_pred = restored["consumed_pred"]

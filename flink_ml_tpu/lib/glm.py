@@ -489,7 +489,22 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         Step-major packing makes the result bit-identical to the in-memory
         fit of the same rows.  Requires an explicit ``globalBatchSize``
         (full-batch SGD needs the entire dataset resident by definition).
+
+        Configurations with a full layout pre-pass (hot/cold frequency
+        scan, multi-process shape/count scans) run under a
+        :func:`~flink_ml_tpu.lib.out_of_core.chunk_cache`: the scan's text
+        parse records binary chunks, the pack pass replays them — ONE text
+        read of the source total (VERDICT r4 #3).
         """
+        from flink_ml_tpu.lib import out_of_core as oc
+
+        hot_k = int(self.get_num_hot_features() or 0)
+        with oc.chunk_cache(
+            table, enabled=jax.process_count() > 1 or hot_k > 0
+        ) as table:
+            return self._fit_out_of_core_impl(table)
+
+    def _fit_out_of_core_impl(self, table) -> GlmModelBase:
         from flink_ml_tpu.lib import out_of_core as oc
         from flink_ml_tpu.parallel.mesh import (
             data_parallel_size,

@@ -666,3 +666,156 @@ class TestFeatureInteractions:
         np.testing.assert_array_equal(
             spilled.coefficients(), direct.coefficients()
         )
+
+
+class _ParseCountingSource:
+    """Counts full chunk-stream iterations of the wrapped source — each one
+    is a text parse the chunk cache exists to eliminate."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.chunk_reads = 0
+
+    def schema(self):
+        return self.inner.schema()
+
+    def read_chunks(self, max_rows):
+        self.chunk_reads += 1
+        return self.inner.read_chunks(max_rows)
+
+    def read(self):
+        return self.inner.read()
+
+
+class TestChunkSpillCache:
+    """VERDICT r4 #3: fold the layout pre-pass into the spill pass — fits
+    with a full pre-pass read the text source exactly once."""
+
+    def _libsvm(self, tmp_path, n=1200, dim=400, nnz=6):
+        table, vectors, labels, dim = sparse_data(n=n, dim=dim, nnz=nnz)
+        path = tmp_path / "c.svm"
+        with open(path, "w") as f:
+            for label, v in zip(labels, vectors):
+                feats = " ".join(
+                    f"{int(i) + 1}:{val:.17g}"
+                    for i, val in zip(v.indices, v.vals)
+                )
+                f.write(f"{label:g} {feats}\n")
+        return LibSvmSource(str(path), n_features=dim), dim
+
+    def test_replay_matches_recorded_chunks(self, tmp_path):
+        from flink_ml_tpu.lib import out_of_core as oc
+
+        source, dim = self._libsvm(tmp_path)
+        counting = _ParseCountingSource(source)
+        chunked = ChunkedTable(counting, chunk_rows=300, spill=True)
+        with oc.chunk_cache(chunked) as cached:
+            first = [
+                (np.asarray(t.col("label")).copy(), t.col("features"))
+                for t in cached.chunks()
+            ]
+            second = [
+                (np.asarray(t.col("label")), t.col("features"))
+                for t in cached.chunks()
+            ]
+        assert counting.chunk_reads == 1  # second pass replayed binary
+        assert len(first) == len(second)
+        for (y1, v1), (y2, v2) in zip(first, second):
+            np.testing.assert_array_equal(y1, y2)
+            np.testing.assert_array_equal(
+                np.asarray(v1.indices), np.asarray(v2.indices)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(v1.values), np.asarray(v2.values)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(v1.indptr), np.asarray(v2.indptr)
+            )
+
+    def test_partial_pass_leaves_cache_incomplete(self, tmp_path):
+        from flink_ml_tpu.lib import out_of_core as oc
+
+        source, dim = self._libsvm(tmp_path)
+        counting = _ParseCountingSource(source)
+        chunked = ChunkedTable(counting, chunk_rows=300, spill=True)
+        with oc.chunk_cache(chunked) as cached:
+            it = cached.chunks()
+            next(it)  # schema/width peek shape: consume one chunk, stop
+            close = getattr(it, "close", None)
+            if close:
+                close()
+            full = list(cached.chunks())  # re-records from text
+            again = list(cached.chunks())  # replays
+        assert counting.chunk_reads == 2
+        assert len(full) == len(again)
+
+    def test_uncacheable_column_falls_back_to_reparsing(self, tmp_path):
+        from flink_ml_tpu.lib import out_of_core as oc
+
+        table, vectors, labels, dim = sparse_data(n=400)
+        # CollectionSource chunks carry per-row SparseVector objects (an
+        # object column) -> uncacheable; behavior must be unchanged
+        source = _ParseCountingSource(
+            CollectionSource(table.to_rows(), table.schema)
+        )
+        chunked = ChunkedTable(source, chunk_rows=150, spill=True)
+        with oc.chunk_cache(chunked) as cached:
+            a = sum(t.num_rows() for t in cached.chunks())
+            b = sum(t.num_rows() for t in cached.chunks())
+        assert a == b == 400
+        assert source.chunk_reads == 2  # no caching: both passes parse
+
+    def test_hotcold_ooc_fit_parses_text_once(self, tmp_path):
+        source, dim = self._libsvm(tmp_path, n=1500)
+        counting = _ParseCountingSource(source)
+        est = (
+            LogisticRegression()
+            .set_vector_col("features")
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_num_features(dim)
+            .set_learning_rate(0.1)
+            .set_global_batch_size(256)
+            .set_max_iter(3)
+            .set_num_hot_features(64)
+        )
+        cached_fit = est.fit(ChunkedTable(counting, 500, spill=True))
+        # the frequency/layout scan is the ONE text parse; the pack pass
+        # replays its binary recording and steady epochs read the packed
+        # BlockSpill
+        assert counting.chunk_reads == 1
+        # result identical to the uncached fit
+        est2 = (
+            LogisticRegression()
+            .set_vector_col("features")
+            .set_label_col("label")
+            .set_prediction_col("pred")
+            .set_num_features(dim)
+            .set_learning_rate(0.1)
+            .set_global_batch_size(256)
+            .set_max_iter(3)
+            .set_num_hot_features(64)
+        )
+        plain_fit = est2.fit(ChunkedTable(source, 500))
+        np.testing.assert_array_equal(
+            cached_fit.coefficients(), plain_fit.coefficients()
+        )
+
+    def test_kmeans_ooc_fit_parses_text_once(self, tmp_path):
+        rng = np.random.RandomState(0)
+        X = rng.randn(900, 8)
+        path = tmp_path / "k.csv"
+        np.savetxt(path, X, delimiter=",")
+        from flink_ml_tpu.lib import KMeans
+        from flink_ml_tpu.table.sources import CsvSource
+
+        schema = Schema.of(*[(f"f{i}", "double") for i in range(8)])
+        source = _ParseCountingSource(CsvSource(str(path), schema))
+        est = (
+            KMeans().set_feature_cols([f"f{i}" for i in range(8)])
+            .set_prediction_col("c").set_k(5).set_max_iter(3).set_seed(1)
+        )
+        est.fit(ChunkedTable(source, 250, spill=True))
+        # init reservoir pass records; first Lloyd epoch replays binary;
+        # steady epochs read the packed spill
+        assert source.chunk_reads == 1
