@@ -120,6 +120,52 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return best
 
 
+def checkpoint_path_for_epoch(directory: str, epoch: int) -> str:
+    """Path of a specific epoch's snapshot (existence not checked)."""
+    return os.path.join(directory, f"epoch_{epoch}{_DATA_SUFFIX}")
+
+
+def agreed_latest_checkpoint(directory: str) -> Optional[str]:
+    """Multi-process-safe :func:`latest_checkpoint`: the COMMON resume
+    point across all processes.
+
+    Each process snapshots independently (Flink's coordinated checkpoints
+    have a JobManager to align them; here alignment happens at restore): a
+    worker killed mid-save leaves the fleet with different newest epochs,
+    and resuming each process from its own latest would desynchronize the
+    lockstep collective schedule — a silent divergence or a deadlock.  The
+    processes agree on the MINIMUM available newest epoch (one collective)
+    and every process loads exactly that snapshot; ``keep`` > 1 (the
+    default) retains the window that makes the agreed epoch available on
+    the processes that had already moved ahead.  Single-process reduces to
+    :func:`latest_checkpoint`.
+    """
+    latest = latest_checkpoint(directory)
+    if jax.process_count() <= 1:
+        return latest
+    from flink_ml_tpu.parallel.mesh import agree_max
+
+    local_epoch = -1
+    if latest is not None:
+        m = _NAME_RE.match(os.path.basename(latest))
+        if m:
+            local_epoch = int(m.group(1))
+    # agree on the minimum via max of negatives
+    (neg_min,) = agree_max(-local_epoch)
+    agreed = -int(neg_min)
+    if agreed < 0:
+        return None
+    path = checkpoint_path_for_epoch(directory, agreed)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"coordinated resume needs epoch {agreed} (the fleet minimum) "
+            f"but {path} is missing — it was pruned; raise "
+            "CheckpointConfig.keep so slower processes' epochs stay "
+            "available"
+        )
+    return path
+
+
 def prune_checkpoints(directory: str, keep: int) -> None:
     """Delete all but the newest ``keep`` snapshots."""
     if keep <= 0 or not os.path.isdir(directory):

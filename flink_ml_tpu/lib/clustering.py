@@ -449,9 +449,11 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         # allgather is a collective every process must reach.
         resuming = False
         if checkpoint is not None:
-            from flink_ml_tpu.iteration.checkpoint import latest_checkpoint
+            from flink_ml_tpu.iteration.checkpoint import (
+                agreed_latest_checkpoint,
+            )
 
-            resuming = latest_checkpoint(checkpoint.directory) is not None
+            resuming = agreed_latest_checkpoint(checkpoint.directory) is not None
         rng = np.random.RandomState(self.get_seed())
         rows_per_block = max(n_dev, (table.chunk_rows // n_dev) * n_dev)
         pad_to_blocks = None

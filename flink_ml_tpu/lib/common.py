@@ -318,6 +318,26 @@ def _pack_sparse_minibatches_csr(
         first_bad = int(np.argmax(indices < 0))
         row = int(np.searchsorted(indptr, first_bad, side="right")) - 1
         raise ValueError(f"row {row}: negative feature index")
+    if nnz_total:
+        # per-row ascending ids are a layout invariant downstream (the
+        # hot-slab scatter declares its (rid, pos) tuples sorted); the
+        # SparseVector path sorts at construction, but CSR columns from
+        # the native loader carry file order verbatim — sort here when a
+        # file violates it (one vectorized pass detects; per-row argsort
+        # only runs on violation)
+        adjacent_same_row = np.ones(nnz_total - 1, dtype=bool)
+        row_ends = indptr[1:-1] - 1  # pair (i, i+1) crosses a row boundary
+        adjacent_same_row[row_ends[row_ends >= 0]] = False
+        if np.any((np.diff(indices.astype(np.int64)) <= 0)
+                  & adjacent_same_row):
+            order = np.argsort(
+                indices + (np.repeat(
+                    np.arange(n, dtype=np.int64), np.diff(indptr)
+                ) << 32),
+                kind="stable",
+            )
+            indices = indices[order]
+            values = values[order]
     if dim is None:
         dim = max(max_idx + 1, rows.dim)
     elif max_idx >= dim:
@@ -703,9 +723,13 @@ def _segment_csr_unpack(ints, floats, nnz_pad: int, mb: int):
 
 def _segment_csr_forward(wts, idx, rid, vals, mb: int):
     """Partial logits from stored entries: segment_sum(values * gather(w))
-    — pad entries carry rid == mb and drop out of the segment range."""
+    — pad entries carry rid == mb and drop out of the segment range.
+    Entries are packed row-major (rid non-decreasing, pads at the tail —
+    asserted by the pack tests), so the segment reduction takes the
+    sorted-indices lowering."""
     return jax.ops.segment_sum(
-        vals * jnp.take(wts, idx, axis=0), rid, num_segments=mb
+        vals * jnp.take(wts, idx, axis=0), rid, num_segments=mb,
+        indices_are_sorted=True,
     )
 
 
@@ -925,6 +949,16 @@ def hotcold_entry_counts(sstack: SparseMinibatchStack) -> np.ndarray:
     )
 
 
+def hotcold_hot_k_eff(dim: int, hot_k: int, model_size: int) -> int:
+    """The effective slab width the feature plan will choose — the ONE
+    rounding rule (clamp to [1, dim], round up to a model-axis multiple),
+    shared with :func:`hotcold_feature_plan` so budget estimates cannot
+    drift from the real layout."""
+    model_size = int(max(model_size, 1))
+    n_hot = int(min(max(hot_k, 1), dim))
+    return -(-n_hot // model_size) * model_size
+
+
 def hotcold_feature_plan(dim: int, hot_k: int, model_size: int,
                          counts: np.ndarray) -> dict:
     """The feature-level half of the hot/cold split — hot selection and
@@ -939,7 +973,7 @@ def hotcold_feature_plan(dim: int, hot_k: int, model_size: int,
             f"counts must have shape ({dim},), got {counts.shape}"
         )
     n_hot = int(min(max(hot_k, 1), dim))
-    hot_k_eff = -(-n_hot // model_size) * model_size
+    hot_k_eff = hotcold_hot_k_eff(dim, hot_k, model_size)
     hk_l = hot_k_eff // model_size
     cold_count = dim - n_hot
     cold_l = -(-cold_count // model_size) if cold_count else 0
@@ -1253,10 +1287,14 @@ def make_hotcold_stream_mb_grad_step(kind: str, mb: int,
         h_ints, h_vals, ints, floats = xs
         wts, b = params
         pos, hrid = h_ints[0], h_ints[1]
+        # (rid, pos) tuples are lexicographically sorted by construction
+        # (row-major packing; per-row feature ids ascending; pads at the
+        # tail with rid == mb) — the sorted lowering keeps the scatter's
+        # writes row-localized instead of random over the whole slab
         slab = (
             jnp.zeros((mb + 1, hot_k), dtype)  # row mb = pad sink
             .at[hrid, pos]
-            .add(h_vals.astype(dtype))[:mb]
+            .add(h_vals.astype(dtype), indices_are_sorted=True)[:mb]
         )
         idx, rid, vals, y, w = _segment_csr_unpack(
             ints, floats, cold_nnz_pad, mb
@@ -1266,6 +1304,64 @@ def make_hotcold_stream_mb_grad_step(kind: str, mb: int,
         )
 
     return mb_grad_step
+
+
+def hotcold_entries_device_batch(mesh, hstack: HotColdStack):
+    """Device placement for the SCALABLE hot/cold formulation: the packed
+    entry arrays (hot + cold) shard over 'data' and stay the only resident
+    copy of the data — HBM holds O(nnz), never O(n_rows x hot_k).  The
+    slab materializes in-program per minibatch
+    (:func:`make_hotcold_stream_mb_grad_step`)."""
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    return shard_batch(
+        mesh,
+        (hstack.hot_ints, hstack.hot_vals,
+         hstack.cold.ints, hstack.cold.floats),
+    )
+
+
+def hotcold_slab_bytes(n_rows: int, hot_k: int,
+                       slab_dtype=jnp.bfloat16) -> int:
+    """HBM footprint of the resident-slab formulation's slabs — the number
+    the auto policy compares against the budget (the packed entry arrays
+    are negligible next to it)."""
+    return int(n_rows) * int(hot_k) * jnp.dtype(slab_dtype).itemsize
+
+
+def make_hotcold_stream_glm_train_fn(
+    kind: str,
+    mesh,
+    mb: int,
+    cold_nnz_pad: int,
+    hot_k: int,
+    dim: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+    slab_dtype=jnp.bfloat16,
+):
+    """Fused training over packed hot/cold ENTRY batches (slab densified
+    in-program per minibatch) — the scalable in-memory formulation: the
+    resident-slab variant's HBM cost grows O(n_rows x hot_k) (~100 GB at
+    1M rows x 50k hot), this one holds only the entries (~12 B/nnz).  Same
+    loop scaffolding as every other path (:func:`_build_fused_train_fn`);
+    the per-step extra over the resident variant is one zeros+scatter
+    (~3x slab traffic per step vs 2x)."""
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    key = ("hotcold-stream", kind, mesh, mb, cold_nnz_pad, hot_k, dim,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept), jnp.dtype(slab_dtype).name)
+    mb_grad_step = make_hotcold_stream_mb_grad_step(
+        kind, mb, cold_nnz_pad, hot_k, dim, with_intercept,
+        slab_dtype=slab_dtype,
+    )
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol
+    )
 
 
 def make_hotcold_glm_train_fn(
@@ -1458,6 +1554,54 @@ def make_hotcold_glm_train_fn_2d(
     )
 
 
+def make_hotcold_stream_glm_train_fn_2d(
+    kind: str,
+    mesh,
+    mb: int,
+    cold_nnz_pad: int,
+    hot_k: int,
+    dim_pad: int,
+    learning_rate: float,
+    reg: float,
+    max_iter: int,
+    tol: float,
+    with_intercept: bool = True,
+    slab_dtype=jnp.bfloat16,
+):
+    """Feature-sharded counterpart of
+    :func:`make_hotcold_stream_glm_train_fn`: packed entries shard over
+    ``data`` (replicated over ``model`` — they carry global slab columns;
+    each shard masks to its ownership in-program), the permuted weight
+    vector over ``model``."""
+    if kind not in ("logistic", "squared"):
+        raise ValueError(f"unknown loss kind {kind!r}")
+    model_size = dict(mesh.shape)["model"]
+    if hot_k % model_size or dim_pad % model_size:
+        raise ValueError(
+            f"hot_k={hot_k} / dim_pad={dim_pad} not divisible by model "
+            f"axis size {model_size} (use split_hot_cold(model_size=...))"
+        )
+    key = ("hotcold-stream2d", kind, mesh, mb, cold_nnz_pad, hot_k, dim_pad,
+           float(learning_rate), float(reg), int(max_iter), float(tol),
+           bool(with_intercept), jnp.dtype(slab_dtype).name)
+    mb_grad_step = make_hotcold_stream_mb_grad_step_2d(
+        kind, mb, cold_nnz_pad, hot_k // model_size, dim_pad // model_size,
+        with_intercept, slab_dtype=slab_dtype,
+    )
+
+    from jax.sharding import PartitionSpec as P
+
+    return _build_fused_train_fn(
+        key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol,
+        in_specs=(
+            (P("model"), P()),
+            (P("data"), P("data"), P("data"), P("data")),
+        ),
+        out_specs=((P("model"), P()), P(), P(), P()),
+        delta_fn=_feature_sharded_delta,
+    )
+
+
 def train_glm_sparse_hotcold(
     init_params,
     hstack: HotColdStack,
@@ -1470,6 +1614,7 @@ def train_glm_sparse_hotcold(
     with_intercept: bool = True,
     checkpoint=None,
     device_batch=None,
+    resident_slabs: bool = True,
 ) -> TrainResult:
     """Hot/cold counterpart of :func:`train_glm_sparse`.  Training runs in
     permuted feature space; ``run`` unpermutes before returning, so BOTH
@@ -1480,7 +1625,14 @@ def train_glm_sparse_hotcold(
     when training actually runs, so a no-op checkpoint resume skips it
     entirely.  A stack laid out with ``model_size > 1`` trains
     feature-sharded over the mesh's ``model`` axis (slab columns and the
-    permuted weight vector sharded, one psum completing logits)."""
+    permuted weight vector sharded, one psum completing logits).
+
+    ``resident_slabs=False`` selects the SCALABLE formulation: HBM holds
+    only the packed entry arrays and each minibatch's slab densifies
+    in-program — O(nnz) device memory instead of O(n_rows x hot_k), the
+    only variant that exists at shapes where the slabs cannot fit (the
+    estimator's ``hotSlabMode`` auto policy decides; see
+    :func:`hotcold_slab_bytes`)."""
     resolved: list = [None]
 
     def hs() -> HotColdStack:
@@ -1516,22 +1668,34 @@ def train_glm_sparse_hotcold(
     def factory(n_epochs):
         h = hs()
         if h.model_size > 1:
-            return make_hotcold_glm_train_fn_2d(
+            maker = (
+                make_hotcold_glm_train_fn_2d if resident_slabs
+                else make_hotcold_stream_glm_train_fn_2d
+            )
+            return maker(
                 kind, mesh, h.cold.mb, h.cold.nnz_pad, h.hot_k, h.dim_pad,
                 learning_rate, reg, n_epochs, tol, with_intercept,
                 slab_dtype=h.slab_dtype,
             )
-        return make_hotcold_glm_train_fn(
+        maker = (
+            make_hotcold_glm_train_fn if resident_slabs
+            else make_hotcold_stream_glm_train_fn
+        )
+        return maker(
             kind, mesh, h.cold.mb, h.cold.nnz_pad, h.hot_k, h.cold.dim,
             learning_rate, reg, n_epochs, tol, with_intercept,
             slab_dtype=h.slab_dtype,
         )
 
+    def default_batch():
+        if resident_slabs:
+            return hotcold_device_batch(mesh, hs())
+        return hotcold_entries_device_batch(mesh, hs())
+
     def run(n_epochs, params, dev_batch=None):
         r = _run_fused_train(
             factory(n_epochs), params,
-            dev_batch if dev_batch is not None
-            else hotcold_device_batch(mesh, hs()),
+            dev_batch if dev_batch is not None else default_batch(),
             mesh, place_params=place, batch_preplaced=True,
             n_rows=hs().n_rows,
         )
@@ -1544,8 +1708,7 @@ def train_glm_sparse_hotcold(
     return run_chunked_checkpoint(
         run, init_params, max_iter, tol, checkpoint, mesh, None,
         device_batch=(
-            device_batch if device_batch is not None
-            else (lambda: hotcold_device_batch(mesh, hs()))
+            device_batch if device_batch is not None else default_batch
         ),
     )
 
@@ -1816,7 +1979,7 @@ def run_chunked_checkpoint(
     by the sparse GLM and KMeans paths (one copy of the resume semantics).
     """
     from flink_ml_tpu.iteration.checkpoint import (
-        latest_checkpoint,
+        agreed_latest_checkpoint,
         load_checkpoint,
         prune_checkpoints,
         save_checkpoint,
@@ -1825,7 +1988,7 @@ def run_chunked_checkpoint(
 
     start_epoch = 0
     losses: list = []
-    latest = latest_checkpoint(checkpoint.directory)
+    latest = agreed_latest_checkpoint(checkpoint.directory)
     if latest is None:
         params = _resolve_thunk(init_params)
     else:
@@ -1953,9 +2116,12 @@ def train_glm(
     start_epoch = 0
     losses: list = []
     if checkpoint is not None:
-        from flink_ml_tpu.iteration.checkpoint import latest_checkpoint, load_checkpoint
+        from flink_ml_tpu.iteration.checkpoint import (
+            agreed_latest_checkpoint,
+            load_checkpoint,
+        )
 
-        latest = latest_checkpoint(checkpoint.directory)
+        latest = agreed_latest_checkpoint(checkpoint.directory)
         if latest is not None:
             init_params, meta = load_checkpoint(latest, like=init_params)
             start_epoch = int(meta["epoch"]) + 1

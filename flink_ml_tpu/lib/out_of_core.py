@@ -309,11 +309,11 @@ def train_out_of_core(
     losses: list = []
     if checkpoint is not None:
         from flink_ml_tpu.iteration.checkpoint import (
-            latest_checkpoint,
+            agreed_latest_checkpoint,
             load_checkpoint,
         )
 
-        latest = latest_checkpoint(checkpoint.directory)
+        latest = agreed_latest_checkpoint(checkpoint.directory)
         if latest is not None:
             init_params, meta = load_checkpoint(latest, like=init_params)
             if validate_meta is not None:
@@ -718,6 +718,15 @@ def kmeans_finalize(carry, epoch_start):
     return new_c, cost, jnp.ones((), dtype=jnp.float32), delta
 
 
+def _atomic_np_save(path: str, arr) -> None:
+    """Raw .npy write with tmp-file + rename atomicity (shared by the
+    packed BlockSpill and the parsed ChunkSpillCache)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:  # file handle: np.save can't rename it
+        np.save(f, arr)
+    os.replace(tmp, path)
+
+
 @contextlib.contextmanager
 def maybe_spill(blocks_factory, enabled: bool):
     """Wrap a block factory in a :class:`BlockSpill` with a per-fit
@@ -830,10 +839,7 @@ class BlockSpill:
             leaves, treedef = jax.tree_util.tree_flatten(batch)
             self._treedef = treedef
             for j, x in enumerate(leaves):
-                tmp = self._path(i, j) + ".tmp"
-                with open(tmp, "wb") as f:  # file handle: save can't rename it
-                    np.save(f, np.asarray(x))
-                os.replace(tmp, self._path(i, j))
+                _atomic_np_save(self._path(i, j), np.asarray(x))
             self._meta.append((int(n_rows), len(leaves)))
             i += 1
             yield batch, n_rows
@@ -910,15 +916,6 @@ class ChunkSpillCache:
 
         return os.path.join(self.directory, f"chunk-{i:06d}-{j:02d}.npy")
 
-    @staticmethod
-    def _save_arr(path: str, arr) -> None:
-        import os
-
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, arr)
-        os.replace(tmp, path)
-
     def _record(self):
         self._chunks = []
         base_iter = self.base.chunks()
@@ -953,13 +950,13 @@ class ChunkSpillCache:
                 paths = []
                 for arr in (col.indptr, col.indices, col.values):
                     p = self._path(i, j)
-                    self._save_arr(p, np.ascontiguousarray(arr))
+                    _atomic_np_save(p, np.ascontiguousarray(arr))
                     paths.append(p)
                     j += 1
                 descs.append((name, ("csr", col.dim, paths)))
             elif isinstance(col, np.ndarray) and col.dtype != object:
                 p = self._path(i, j)
-                self._save_arr(p, np.ascontiguousarray(col))
+                _atomic_np_save(p, np.ascontiguousarray(col))
                 j += 1
                 descs.append((name, ("arr", p)))
             else:

@@ -204,6 +204,25 @@ class HasNumHotFeatures(WithParams):
     def set_num_hot_features(self, value: int):
         return self.set(self.NUM_HOT_FEATURES, int(value))
 
+    HOT_SLAB_MODE: ParamInfo = param_info(
+        "hotSlabMode",
+        "Hot/cold in-memory formulation: 'resident' pre-densifies every "
+        "minibatch's slab once and keeps them HBM-resident across epochs "
+        "(fastest; footprint rows*numHotFeatures*2 bytes grows with the "
+        "dataset), 'stream' densifies each slab in-program per step (HBM "
+        "holds only the packed entries — the scalable formulation), "
+        "'auto' picks resident only while the slabs fit the budget "
+        "(FMT_HOT_SLAB_BUDGET_MB, default 4096).",
+        default="auto", value_type=str,
+        validator=lambda v: v in ("auto", "resident", "stream"),
+    )
+
+    def get_hot_slab_mode(self) -> str:
+        return self.get(self.HOT_SLAB_MODE)
+
+    def set_hot_slab_mode(self, value: str):
+        return self.set(self.HOT_SLAB_MODE, value)
+
 
 class HasWindowMs(WithParams):
     WINDOW_MS: ParamInfo = param_info(

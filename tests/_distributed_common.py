@@ -201,18 +201,22 @@ def fit_kmeans_shard_table(table):
     return cents, float(model.train_cost_)
 
 
-def fit_sparse_shard_table(table, hot_k: int = 0):
+def fit_sparse_shard_table(table, hot_k: int = 0, checkpoint_dir=None,
+                           max_iter=None):
     from flink_ml_tpu.lib import LogisticRegression
 
     est = (
         LogisticRegression().set_vector_col("features")
         .set_label_col("label").set_prediction_col("pred")
         .set_num_features(SPARSE_DIM)
-        .set_learning_rate(LEARNING_RATE).set_max_iter(SHARD_EPOCHS)
+        .set_learning_rate(LEARNING_RATE)
+        .set_max_iter(SHARD_EPOCHS if max_iter is None else max_iter)
         .set_global_batch_size(SHARD_G)
     )
     if hot_k:
         est.set_num_hot_features(hot_k)
+    if checkpoint_dir is not None:
+        est.set_checkpoint_dir(str(checkpoint_dir)).set_checkpoint_interval(1)
     model = est.fit(table)
     (mt,) = model.get_model_data()
     w = np.asarray(mt.col("coefficients")[0].to_dense().values)

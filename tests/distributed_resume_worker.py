@@ -1,0 +1,95 @@
+"""Worker for the multi-process kill-and-resume test (VERDICT r4 #4).
+
+Run as: python distributed_resume_worker.py <pid> <nprocs> <port> <phase> <ckpt_root>
+
+Phase ``crash``: both processes run a checkpointed out-of-core sparse fit;
+process 1 simulates a machine failure (``os._exit``) right after its second
+snapshot commits, mid-fit — process 0 is left owing collectives and is
+killed by the parent.  Phase ``resume``: a fresh pair of processes re-runs
+the same fit over the same sources; each finds its own newest snapshot,
+the fleet agrees on the common resume epoch
+(``agreed_latest_checkpoint``'s one collective), and training continues to
+completion.  The parent asserts the final model equals the uninterrupted
+single-process reference bit-for-float — the Flink checkpoint/restart
+story (`/root/reference/pom.xml:396-401` randomizes exactly this in every
+reference test) on the jax.distributed data plane.
+"""
+
+import os
+import sys
+
+process_id = int(sys.argv[1])
+num_processes = int(sys.argv[2])
+port = sys.argv[3]
+phase = sys.argv[4]
+ckpt_root = sys.argv[5]
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from flink_ml_tpu.parallel.mesh import (  # noqa: E402
+    initialize_distributed,
+    shutdown_distributed,
+)
+
+initialize_distributed(
+    coordinator_address=f"localhost:{port}",
+    num_processes=num_processes,
+    process_id=process_id,
+)
+
+if phase == "crash" and process_id == 1:
+    # simulated machine failure: die hard right after the SECOND snapshot
+    # commits (mid-fit; the fit runs more epochs than that)
+    import flink_ml_tpu.iteration.checkpoint as ck
+
+    _orig_save = ck.save_checkpoint
+    _saves = {"n": 0}
+
+    def _killing_save(*args, **kwargs):
+        path = _orig_save(*args, **kwargs)
+        _saves["n"] += 1
+        if _saves["n"] >= 2:
+            os._exit(17)
+        return path
+
+    ck.save_checkpoint = _killing_save
+
+try:
+    from tests._distributed_common import (
+        fit_sparse_shard_table,
+        make_sparse_shard_rows,
+        sparse_shard_schema,
+    )
+    from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+    svecs, sy = make_sparse_shard_rows(num_processes)[process_id]
+    table = ChunkedTable(
+        CollectionSource(list(zip(svecs, sy)), sparse_shard_schema()),
+        chunk_rows=64,
+    )
+    w, b = fit_sparse_shard_table(
+        table,
+        checkpoint_dir=os.path.join(ckpt_root, f"p{process_id}"),
+        max_iter=6,
+    )
+    digest = [float(np.sum(w)), float(np.sum(w * w))]
+    probe = [float(v) for v in w[:8]]
+    print(
+        "FITRESUME " + " ".join(f"{v:.9e}" for v in digest + probe + [b]),
+        flush=True,
+    )
+finally:
+    shutdown_distributed()

@@ -376,3 +376,90 @@ def test_two_process_mesh_psum(tmp_path):
                 "diverged from the single-process concatenated-order fit"
             ),
         )
+
+
+def test_two_process_kill_and_resume(tmp_path):
+    """VERDICT r4 #4: kill one worker mid-out-of-core-fit, restart both,
+    resume from the chunked checkpoint, and land on the model an
+    uninterrupted run produces — the Flink checkpoint/restart story
+    (`/root/reference/pom.xml:396-401`) on the jax.distributed data plane."""
+    import numpy as np
+
+    RESUME_WORKER = HERE / "distributed_resume_worker.py"
+    ckpt_root = tmp_path / "ck"
+    ckpt_root.mkdir()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def spawn(phase, port):
+        return [
+            subprocess.Popen(
+                [sys.executable, str(RESUME_WORKER), str(pid), "2",
+                 str(port), phase, str(ckpt_root)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=str(HERE.parent),
+            )
+            for pid in range(2)
+        ]
+
+    # phase 1: crash.  Worker 1 os._exit(17)s right after its second
+    # snapshot commits; worker 0 is left owing collectives — give it a
+    # moment to finish its own epoch-2 snapshot, then kill it (the
+    # "machine failure" takes out both).
+    procs = spawn("crash", _free_port())
+    out1, _ = procs[1].communicate(timeout=420)
+    assert procs[1].returncode == 17, (
+        f"worker 1 should simulate a crash (exit 17):\n{out1}"
+    )
+    try:
+        out0, _ = procs[0].communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out0, _ = procs[0].communicate(timeout=30)
+    from flink_ml_tpu.iteration.checkpoint import latest_checkpoint
+
+    for pid in range(2):
+        assert latest_checkpoint(str(ckpt_root / f"p{pid}")) is not None, (
+            f"no snapshot survived for worker {pid}:\n{out0}\n{out1}"
+        )
+
+    # phase 2: restart both; each fleet member agrees on the common resume
+    # epoch and continues to completion
+    procs = spawn("resume", _free_port())
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume worker {pid} failed:\n{out}"
+
+    # uninterrupted single-process reference over the interleaved order
+    from tests._distributed_common import (
+        fit_sparse_shard_table,
+        interleaved_sparse_rows,
+        make_sparse_shard_rows,
+        sparse_shard_schema,
+    )
+    from flink_ml_tpu.table.table import Table
+
+    sshards = make_sparse_shard_rows(2)
+    svecs, sy = interleaved_sparse_rows(sshards, 2)
+    sref = Table.from_columns(
+        sparse_shard_schema(), {"features": svecs, "label": sy}
+    )
+    w_ref, b_ref = fit_sparse_shard_table(sref, max_iter=6)
+    expected = (
+        [float(np.sum(w_ref)), float(np.sum(w_ref * w_ref))]
+        + [float(v) for v in w_ref[:8]] + [b_ref]
+    )
+    for pid, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith("FITRESUME ")]
+        assert line, f"worker {pid} printed no FITRESUME line:\n{out}"
+        got = [float(v) for v in line[0].split()[1:]]
+        np.testing.assert_allclose(
+            got, expected, rtol=1e-5, atol=1e-7,
+            err_msg=(
+                f"worker {pid}: resumed model diverged from the "
+                "uninterrupted single-process reference"
+            ),
+        )

@@ -726,3 +726,125 @@ class TestNativeMalformed:
         p.write_text("1 2:\n0 3:1.5\n")
         with pytest.raises(ValueError):
             native.read_libsvm(str(p), None, False)
+
+
+class TestHotColdStreamFormulation:
+    """VERDICT r4 #1: the scalable in-memory formulation — slabs densify
+    in-program per minibatch (HBM holds O(nnz), never O(rows x hot_k))."""
+
+    def _data(self, n=500, dim=64, seed=3):
+        rng = np.random.RandomState(seed)
+        true_w = rng.randn(dim)
+        vecs, ys = [], []
+        for _ in range(n):
+            hot = rng.choice(8, 3, replace=False)
+            cold = 8 + rng.choice(dim - 8, 2, replace=False)
+            idx = np.sort(np.concatenate([hot, cold]))
+            x = np.zeros(dim)
+            x[idx] = 1.0
+            vecs.append(SparseVector(dim, idx.astype(np.int64), np.ones(5)))
+            ys.append(float((x @ true_w) > 0))
+        return vecs, np.asarray(ys)
+
+    def _fit(self, t, mode, hot=16):
+        return (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_max_iter(30)
+            .set_global_batch_size(64).set_num_hot_features(hot)
+            .set_hot_slab_mode(mode)
+            .fit(t)
+        )
+
+    def test_stream_mode_matches_resident_mode(self):
+        vecs, ys = self._data()
+        t = Table.from_columns(SCHEMA, {"features": vecs, "label": ys})
+        m_res = self._fit(t, "resident")
+        m_str = self._fit(t, "stream")
+        np.testing.assert_allclose(
+            m_str.coefficients(), m_res.coefficients(), rtol=1e-5, atol=1e-7
+        )
+
+    def test_auto_mode_picks_stream_over_budget(self, monkeypatch):
+        from flink_ml_tpu.lib import common as lc
+
+        calls = {}
+        orig = lc.train_glm_sparse_hotcold
+
+        def spy(*a, **kw):
+            calls["resident"] = kw.get("resident_slabs")
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(
+            "flink_ml_tpu.lib.glm.train_glm_sparse_hotcold", spy,
+            raising=False,
+        )
+        # glm imports inside the method; patch at source module
+        monkeypatch.setattr(lc, "train_glm_sparse_hotcold", spy)
+        vecs, ys = self._data()
+        t = Table.from_columns(SCHEMA, {"features": vecs, "label": ys})
+        monkeypatch.setenv("FMT_HOT_SLAB_BUDGET_MB", "0")
+        self._fit(t, "auto")
+        assert calls["resident"] is False
+        calls.clear()
+        monkeypatch.setenv("FMT_HOT_SLAB_BUDGET_MB", "100000")
+        self._fit(t, "auto")
+        assert calls["resident"] is True
+
+    def test_stream_mode_2d_matches_1d(self):
+        import jax
+
+        from flink_ml_tpu.lib.common import (
+            split_hot_cold,
+            train_glm_sparse_hotcold,
+        )
+        from flink_ml_tpu.parallel.mesh import create_mesh
+
+        vecs, ys = self._data(n=300, dim=32)
+        mesh = create_mesh({"data": 2, "model": 2},
+                           devices=jax.devices()[:4])
+        s = pack_sparse_minibatches(vecs, ys, n_dev=2, global_batch_size=32)
+        import jax.numpy as jnp
+
+        kw = dict(
+            kind="logistic", learning_rate=0.5, max_iter=10, reg=0.0,
+            tol=0.0, with_intercept=True, resident_slabs=False,
+        )
+        h2 = split_hot_cold(s, hot_k=8, pad_multiple=8,
+                            slab_dtype=jnp.float32, model_size=2)
+        w0 = (jnp.zeros((32,), jnp.float32), jnp.zeros((), jnp.float32))
+        r2 = train_glm_sparse_hotcold(w0, h2, mesh=mesh, **kw)
+        mesh1 = create_mesh({"data": 2}, devices=jax.devices()[:2])
+        h1 = split_hot_cold(s, hot_k=8, pad_multiple=8,
+                            slab_dtype=jnp.float32)
+        r1 = train_glm_sparse_hotcold(w0, h1, mesh=mesh1, **kw)
+        np.testing.assert_allclose(
+            np.asarray(r2.params[0]), np.asarray(r1.params[0]),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
+def test_unsorted_csr_rows_pack_sorted():
+    """CSR columns from file order may carry per-row ids out of order; the
+    pack must restore the per-row ascending invariant (the hot-slab
+    scatter declares its index tuples sorted)."""
+    from flink_ml_tpu.lib.common import pack_sparse_minibatches
+    from flink_ml_tpu.ops.batch import CsrRows
+
+    indptr = np.array([0, 3, 5, 8], dtype=np.int64)
+    indices = np.array([7, 3, 9, 4, 1, 0, 6, 2], dtype=np.int64)  # unsorted
+    values = np.arange(8, dtype=np.float64) + 1.0
+    rows = CsrRows(16, indptr, indices, values)
+    y = np.array([1.0, 0.0, 1.0])
+    s = pack_sparse_minibatches(rows, y, n_dev=1, global_batch_size=4)
+    idx = s.ints[0, 0, :]
+    rid = s.ints[0, 1, :]
+    valid = rid < s.mb
+    # per-row ascending after the pack
+    for r in range(3):
+        ids = idx[valid & (rid == r)]
+        assert np.all(np.diff(ids) > 0), ids
+    # entries conserved with their values
+    got = sorted(zip(idx[valid].tolist(), s.floats[0, : s.nnz_pad][valid].tolist()))
+    want = sorted(zip(indices.tolist(), values.tolist()))
+    assert got == want
