@@ -51,6 +51,12 @@ class Stage(WithParams):
         klass = _resolve_class(meta["module"], meta["class"])
         if not issubclass(klass, Stage):
             raise TypeError(f"{klass} is not a Stage")
+        # the static-load convention (Stage.java:41-43): a class owning its
+        # persistence layout (Pipeline/PipelineModel nest stage dirs)
+        # overrides load — delegate so Stage.load(path) works uniformly on
+        # any saved stage
+        if getattr(klass.load, "__func__", None) is not Stage.load.__func__:
+            return klass.load(path)
         stage = klass.__new__(klass)
         Stage.__init__(stage)  # params container
         stage._params = Params.from_json(meta["params"])
@@ -120,14 +126,11 @@ class Estimator(Stage):
 
 
 def load_stage(path: str) -> Stage:
-    """Load any saved stage by the recorded class (static-load convention)."""
-    with open(os.path.join(path, _STAGE_FILE)) as f:
-        meta = json.load(f)
-    klass = _resolve_class(meta["module"], meta["class"])
-    loader = getattr(klass, "load", None)
-    if loader is None:
-        raise TypeError(f"{klass} has no load classmethod")
-    return loader(path)
+    """Load any saved stage by the recorded class (static-load convention).
+
+    ``Stage.load`` already resolves the recorded class and delegates to its
+    override — this name remains as the discoverable module-level entry."""
+    return Stage.load(path)
 
 
 def _resolve_class(module: str, qualname: str):

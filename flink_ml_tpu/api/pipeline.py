@@ -47,6 +47,23 @@ class Pipeline(Estimator):
             if isinstance(stage, Estimator):
                 last_estimator_idx = i
 
+        if (
+            len(inputs) == 1
+            and getattr(inputs[0], "is_chunked", False)
+            and getattr(inputs[0], "spill", False)
+            and sum(isinstance(s, Estimator) for s in self.stages) > 1
+        ):
+            # multi-stage chunked fit: each estimator's fit is a full
+            # stream pass over the same source — share ONE binary replay
+            # cache across the whole chain so the text parse runs once
+            # (out-of-core rule: never pay a read twice)
+            from flink_ml_tpu.table.sources import chunk_cache
+
+            with chunk_cache(inputs[0]) as cached:
+                return self._fit_stages((cached,), last_estimator_idx)
+        return self._fit_stages(inputs, last_estimator_idx)
+
+    def _fit_stages(self, inputs, last_estimator_idx: int) -> "PipelineModel":
         model_stages: List[AlgoOperator] = []
         last_inputs = inputs
         for i, stage in enumerate(self.stages):

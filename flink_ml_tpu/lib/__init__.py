@@ -1,5 +1,12 @@
 from flink_ml_tpu.lib.classification import LogisticRegression, LogisticRegressionModel
 from flink_ml_tpu.lib.clustering import KMeans, KMeansModel
+from flink_ml_tpu.lib.encoding import (
+    BinaryClassificationEvaluator,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    StringIndexer,
+    StringIndexerModel,
+)
 from flink_ml_tpu.lib.feature import (
     MinMaxScaler,
     MinMaxScalerModel,
@@ -20,6 +27,11 @@ __all__ = [
     "KMeansModel",
     "Knn",
     "KnnModel",
+    "BinaryClassificationEvaluator",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "StringIndexer",
+    "StringIndexerModel",
     "MinMaxScaler",
     "MinMaxScalerModel",
     "OnlineLogisticRegression",

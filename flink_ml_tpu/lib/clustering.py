@@ -412,12 +412,12 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         grouped files must not bias the seeding); under the cap the sample
         is the whole dataset, matching the in-memory path.
         """
-        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.table.sources import chunk_cache
 
         # the reservoir init is a full stream pass: record binary chunks
         # there so the first training epoch replays pages instead of
         # re-parsing text — one text read total (VERDICT r4 #3)
-        with oc.chunk_cache(table) as table:
+        with chunk_cache(table) as table:
             return self._fit_out_of_core_impl(table)
 
     def _fit_out_of_core_impl(self, table) -> KMeansModel:

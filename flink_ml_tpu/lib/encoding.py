@@ -234,15 +234,34 @@ class StringIndexer(Estimator, StringIndexerParams):
     fit is deterministic.
     """
 
-    def fit(self, *inputs: Table) -> StringIndexerModel:
+    def fit(self, *inputs) -> StringIndexerModel:
         (table,) = inputs
         order = self.get_string_order_type()
+        cols = list(self.get_selected_cols())
         rows = []
-        for c in self.get_selected_cols():
-            vals = _stringify(table.col(c))
-            uniq, counts = np.unique(vals, return_counts=True)
-            for i, j in enumerate(_vocab_order(uniq, counts, order)):
-                rows.append((c, str(uniq[j]), float(i)))
+        if getattr(table, "is_chunked", False):
+            # out-of-core fit: one streaming pass, per-column value counts
+            # merged across chunks — the ordering is a pure function of the
+            # total counts, so the result matches the in-memory fit exactly
+            tallies: list = [{} for _ in cols]
+            for t in table.chunks():
+                for tally, c in zip(tallies, cols):
+                    uniq, counts = np.unique(
+                        _stringify(t.col(c)), return_counts=True
+                    )
+                    for v, n in zip(uniq, counts):
+                        tally[str(v)] = tally.get(str(v), 0) + int(n)
+            for tally, c in zip(tallies, cols):
+                uniq = np.asarray(sorted(tally), dtype=str)
+                counts = np.asarray([tally[v] for v in uniq])
+                for i, j in enumerate(_vocab_order(uniq, counts, order)):
+                    rows.append((c, str(uniq[j]), float(i)))
+        else:
+            for c in cols:
+                vals = _stringify(table.col(c))
+                uniq, counts = np.unique(vals, return_counts=True)
+                for i, j in enumerate(_vocab_order(uniq, counts, order)):
+                    rows.append((c, str(uniq[j]), float(i)))
         model = StringIndexerModel()
         model.get_params().merge(self.get_params())
         model.set_model_data(Table.from_rows(rows, INDEXER_MODEL_SCHEMA))
@@ -347,18 +366,37 @@ class OneHotEncoderModel(TableModelBase, OneHotEncoderParams):
 class OneHotEncoder(Estimator, OneHotEncoderParams):
     """Estimator: per-column slot count = max observed index + 1."""
 
-    def fit(self, *inputs: Table) -> OneHotEncoderModel:
+    @staticmethod
+    def _check_indices(c: str, v: np.ndarray) -> None:
+        if len(v) and (np.any(v < 0) or np.any(v != v.astype(np.int64))):
+            raise ValueError(
+                f"column {c!r} must hold non-negative integer indices "
+                "(use StringIndexer upstream)"
+            )
+
+    def fit(self, *inputs) -> OneHotEncoderModel:
         (table,) = inputs
-        rows = []
-        for c in self.get_selected_cols():
-            v = np.asarray(table.col(c), dtype=np.float64)
-            if len(v) and (np.any(v < 0) or np.any(v != v.astype(np.int64))):
-                raise ValueError(
-                    f"column {c!r} must hold non-negative integer indices "
-                    "(use StringIndexer upstream)"
-                )
-            size = int(v.max()) + 1 if len(v) else 1
-            rows.append((c, float(size)))
+        cols = list(self.get_selected_cols())
+        if getattr(table, "is_chunked", False):
+            # out-of-core fit: slot count = running max over the stream
+            maxes = np.full(len(cols), -1.0)
+            for t in table.chunks():
+                for j, c in enumerate(cols):
+                    v = np.asarray(t.col(c), dtype=np.float64)
+                    self._check_indices(c, v)
+                    if len(v):
+                        maxes[j] = max(maxes[j], float(v.max()))
+            rows = [
+                (c, float(int(m) + 1 if m >= 0 else 1))
+                for c, m in zip(cols, maxes)
+            ]
+        else:
+            rows = []
+            for c in cols:
+                v = np.asarray(table.col(c), dtype=np.float64)
+                self._check_indices(c, v)
+                size = int(v.max()) + 1 if len(v) else 1
+                rows.append((c, float(size)))
         model = OneHotEncoderModel()
         model.get_params().merge(self.get_params())
         model.set_model_data(Table.from_rows(rows, ENCODER_MODEL_SCHEMA))

@@ -525,14 +525,14 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
         Configurations with a full layout pre-pass (hot/cold frequency
         scan, multi-process shape/count scans) run under a
-        :func:`~flink_ml_tpu.lib.out_of_core.chunk_cache`: the scan's text
+        :func:`~flink_ml_tpu.table.sources.chunk_cache`: the scan's text
         parse records binary chunks, the pack pass replays them — ONE text
         read of the source total (VERDICT r4 #3).
         """
-        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.table.sources import chunk_cache
 
         hot_k = int(self.get_num_hot_features() or 0)
-        with oc.chunk_cache(
+        with chunk_cache(
             table, enabled=jax.process_count() > 1 or hot_k > 0
         ) as table:
             return self._fit_out_of_core_impl(table)
@@ -582,9 +582,27 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 )
             dim = self.get_num_features()
             if dim is None:
+                # a CSR-backed column carries the global width (the
+                # categorical pipeline's encoder stamps it per chunk) —
+                # peek one chunk before demanding the param
+                from flink_ml_tpu.ops.batch import CsrRows
+
+                chunks = table.chunks()
+                try:
+                    first = next(chunks, None)
+                finally:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
+                if first is not None:
+                    col = first.col(vector_col)
+                    if isinstance(col, CsrRows):
+                        dim = int(col.dim)
+            if dim is None:
                 raise ValueError(
                     "out-of-core sparse training requires numFeatures (the "
-                    "global dimension cannot be inferred from a stream)"
+                    "global dimension cannot be inferred from a stream of "
+                    "per-row sparse vectors)"
                 )
             pad_to_blocks = None
             counts = None

@@ -59,6 +59,7 @@ from flink_ml_tpu.lib.common import (
 )
 from flink_ml_tpu.ops.batch import CsrRows
 from flink_ml_tpu.parallel.collectives import psum
+from flink_ml_tpu.table.sources import _atomic_np_save
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
 
@@ -718,15 +719,6 @@ def kmeans_finalize(carry, epoch_start):
     return new_c, cost, jnp.ones((), dtype=jnp.float32), delta
 
 
-def _atomic_np_save(path: str, arr) -> None:
-    """Raw .npy write with tmp-file + rename atomicity (shared by the
-    packed BlockSpill and the parsed ChunkSpillCache)."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:  # file handle: np.save can't rename it
-        np.save(f, arr)
-    os.replace(tmp, path)
-
-
 @contextlib.contextmanager
 def maybe_spill(blocks_factory, enabled: bool):
     """Wrap a block factory in a :class:`BlockSpill` with a per-fit
@@ -857,149 +849,6 @@ class BlockSpill:
         import shutil
 
         shutil.rmtree(self.directory, ignore_errors=True)
-
-
-class ChunkSpillCache:
-    """Binary replay cache of PARSED source chunks — one text parse total.
-
-    Fit paths with a layout pre-pass (the hot/cold frequency scan, the
-    multi-process shape/count scans, the KMeans reservoir init) used to
-    read the text source twice before the packed :class:`BlockSpill` took
-    over: once to scan, once to pack.  Out-of-core means every pass is a
-    full disk/network read — never pay two.  Wrapping the chunked table in
-    this cache records each parsed chunk's columns as raw ``.npy`` during
-    the FIRST full iteration (the scan), then replays memory-mapped binary
-    for every later iteration — the pack pass reads pages, not text.
-
-    Cacheable columns: numeric/bool/string ndarrays, matrix-backed
-    dense-vector columns, and CSR-backed sparse columns (``CsrRows``).  A
-    chunk with any other column shape (per-row ``SparseVector`` objects,
-    ragged widths) disables the cache for the whole stream — consumers
-    just re-parse, correctness unaffected.  A partial iteration (sampled
-    ``estimate_nnz_pad``, schema peeks) leaves the cache incomplete and is
-    re-recorded by the next full pass.
-
-    Disk transiently holds this raw copy alongside the packed BlockSpill;
-    both live in per-fit temporary directories (:func:`chunk_cache`).
-    """
-
-    is_chunked = True
-
-    def __init__(self, base, directory: str):
-        import os
-
-        self.base = base
-        self.chunk_rows = base.chunk_rows
-        self.spill = getattr(base, "spill", False)
-        self.directory = directory
-        os.makedirs(directory, exist_ok=True)
-        self._complete = False
-        self._disabled = False
-        self._chunks: list = []  # per chunk: (schema, [(name, descriptor)])
-
-    @property
-    def schema(self):
-        return self.base.schema
-
-    def materialize(self):
-        return self.base.materialize()
-
-    def chunks(self):
-        if self._complete:
-            return self._replay()
-        if self._disabled:
-            return self.base.chunks()
-        return self._record()
-
-    def _path(self, i: int, j: int) -> str:
-        import os
-
-        return os.path.join(self.directory, f"chunk-{i:06d}-{j:02d}.npy")
-
-    def _record(self):
-        self._chunks = []
-        base_iter = self.base.chunks()
-        i = 0
-        for t in base_iter:
-            descs = self._try_save(t, i)
-            if descs is None:
-                # uncacheable column shape: disable, discard partial
-                # recordings, and keep serving the rest of this pass
-                # straight from the same base iterator (chunks already
-                # consumed cannot be re-read mid-pass)
-                self._disabled = True
-                self._chunks = []
-                yield t
-                yield from base_iter
-                return
-            self._chunks.append((t.schema, descs))
-            i += 1
-            yield t
-        self._complete = True
-
-    def _try_save(self, t: Table, i: int):
-        """Per-chunk column descriptors, or None when any column shape is
-        uncacheable."""
-        from flink_ml_tpu.ops.batch import CsrRows
-
-        descs = []
-        j = 0
-        for name in t.schema.field_names:
-            col = t.col(name)
-            if isinstance(col, CsrRows):
-                paths = []
-                for arr in (col.indptr, col.indices, col.values):
-                    p = self._path(i, j)
-                    _atomic_np_save(p, np.ascontiguousarray(arr))
-                    paths.append(p)
-                    j += 1
-                descs.append((name, ("csr", col.dim, paths)))
-            elif isinstance(col, np.ndarray) and col.dtype != object:
-                p = self._path(i, j)
-                _atomic_np_save(p, np.ascontiguousarray(col))
-                j += 1
-                descs.append((name, ("arr", p)))
-            else:
-                return None
-        return descs
-
-    def _replay(self):
-        from flink_ml_tpu.ops.batch import CsrRows
-
-        for schema, descs in self._chunks:
-            cols = {}
-            for name, d in descs:
-                if d[0] == "csr":
-                    _, dim, paths = d
-                    indptr, indices, values = (
-                        np.load(p, mmap_mode="r") for p in paths
-                    )
-                    cols[name] = CsrRows(dim, indptr, indices, values)
-                else:
-                    cols[name] = np.load(d[1], mmap_mode="r")
-            yield Table.from_columns(schema, cols)
-
-
-@contextlib.contextmanager
-def chunk_cache(table, enabled: bool = True):
-    """Scope a :class:`ChunkSpillCache` over a chunked table for one fit;
-    a no-op when ``enabled`` is false or the table is not chunked (or not
-    spill-enabled — single-pass fits have nothing to amortize)."""
-    import shutil
-    import tempfile
-
-    if (
-        not enabled
-        or not getattr(table, "is_chunked", False)
-        or not getattr(table, "spill", False)
-    ):
-        yield table
-        return
-    directory = tempfile.mkdtemp(prefix="fmt_chunkcache_")
-    try:
-        yield ChunkSpillCache(table, directory)
-    finally:
-        shutil.rmtree(directory, ignore_errors=True)
 
 
 def scan_sparse_stream(chunked_table, vector_col: str, mb: int,

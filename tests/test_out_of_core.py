@@ -704,12 +704,12 @@ class TestChunkSpillCache:
         return LibSvmSource(str(path), n_features=dim), dim
 
     def test_replay_matches_recorded_chunks(self, tmp_path):
-        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.table.sources import chunk_cache
 
         source, dim = self._libsvm(tmp_path)
         counting = _ParseCountingSource(source)
         chunked = ChunkedTable(counting, chunk_rows=300, spill=True)
-        with oc.chunk_cache(chunked) as cached:
+        with chunk_cache(chunked) as cached:
             first = [
                 (np.asarray(t.col("label")).copy(), t.col("features"))
                 for t in cached.chunks()
@@ -733,12 +733,12 @@ class TestChunkSpillCache:
             )
 
     def test_partial_pass_leaves_cache_incomplete(self, tmp_path):
-        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.table.sources import chunk_cache
 
         source, dim = self._libsvm(tmp_path)
         counting = _ParseCountingSource(source)
         chunked = ChunkedTable(counting, chunk_rows=300, spill=True)
-        with oc.chunk_cache(chunked) as cached:
+        with chunk_cache(chunked) as cached:
             it = cached.chunks()
             next(it)  # schema/width peek shape: consume one chunk, stop
             close = getattr(it, "close", None)
@@ -750,7 +750,7 @@ class TestChunkSpillCache:
         assert len(full) == len(again)
 
     def test_uncacheable_column_falls_back_to_reparsing(self, tmp_path):
-        from flink_ml_tpu.lib import out_of_core as oc
+        from flink_ml_tpu.table.sources import chunk_cache
 
         table, vectors, labels, dim = sparse_data(n=400)
         # CollectionSource chunks carry per-row SparseVector objects (an
@@ -759,7 +759,7 @@ class TestChunkSpillCache:
             CollectionSource(table.to_rows(), table.schema)
         )
         chunked = ChunkedTable(source, chunk_rows=150, spill=True)
-        with oc.chunk_cache(chunked) as cached:
+        with chunk_cache(chunked) as cached:
             a = sum(t.num_rows() for t in cached.chunks())
             b = sum(t.num_rows() for t in cached.chunks())
         assert a == b == 400
