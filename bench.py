@@ -16,6 +16,10 @@ from bench_all import bench_logreg
 
 
 def main():
+    from flink_ml_tpu import obs
+
+    obs.enable()
+    obs.reset()
     bench_logreg()
 
 

@@ -58,6 +58,13 @@ def _sigmoid(z):
 
 def _emit(record: dict) -> dict:
     print(json.dumps(record))
+    # durable telemetry (ISSUE 1): every bench record also lands in
+    # reports/runs.jsonl as a RunReport (git SHA, device topology, the
+    # registry snapshot with compile/steady splits) — a no-op when obs is
+    # off, so importing bench_all for its helpers stays side-effect-free
+    from flink_ml_tpu import obs
+
+    obs.bench_report(record)
     return record
 
 
@@ -1206,9 +1213,15 @@ WORKLOADS = {
 
 
 def main(argv):
+    from flink_ml_tpu import obs
+
+    obs.enable()
     names = argv or list(WORKLOADS)
     results = {}
     for name in names:
+        # fresh registry per workload: each bench RunReport's metrics
+        # snapshot describes that workload's fits alone
+        obs.reset()
         results[name] = WORKLOADS[name]()
     return results
 

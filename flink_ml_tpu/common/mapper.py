@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.params.params import Params
 from flink_ml_tpu.table.output_cols import OutputColsHelper
 from flink_ml_tpu.table.schema import Schema
@@ -67,12 +68,17 @@ class Mapper:
 
     def apply(self, table: Table, batch_size: Optional[int] = None) -> Table:
         """Map a whole table, batch by batch, and merge columns."""
+        obs.counter_add("inference.rows", table.num_rows())
         if batch_size is None or table.num_rows() <= batch_size:
-            out = self.map_batch(table)
+            with obs.phase("inference.map_batch"):
+                out = self.map_batch(table)
+            obs.counter_add("inference.batches")
             return self._helper.get_result_table(table, out)
         parts = []
         for batch in table.iter_batches(batch_size):
-            out = self.map_batch(batch)
+            with obs.phase("inference.map_batch"):
+                out = self.map_batch(batch)
+            obs.counter_add("inference.batches")
             parts.append(self._helper.get_result_table(batch, out))
         return Table.concat(parts)
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.iteration.config import IterationConfig, OperatorLifeCycle
 from flink_ml_tpu.iteration.listener import IterationListener, ListenerContext
 from flink_ml_tpu.table.table import Table
@@ -140,6 +141,7 @@ def iterate_bounded(
         if not isinstance(result, IterationBodyResult):
             raise TypeError("iteration body must return IterationBodyResult")
         outputs_per_epoch.append(result.outputs or {})
+        obs.counter_add("iteration.bounded.epochs")
 
         # the epoch watermark for this round: all work of `epoch` is complete
         for listener in listeners:

@@ -75,6 +75,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.iteration.listener import IterationListener, ListenerContext
 from flink_ml_tpu.ops.vector import DenseVector
 from flink_ml_tpu.table.schema import Schema
@@ -689,6 +690,14 @@ class StreamingDriver:
             table = buf.take()
             state = update(state, table, epoch)
             metrics.end_step(samples=n_rows, window_end=end_ts)
+            obs.counter_add("iteration.unbounded.windows")
+            obs.counter_add("iteration.unbounded.rows", n_rows)
+            # feedback-queue depth: windows still buffering + predictions
+            # awaiting a final model — the driver's backlog at this fire
+            obs.gauge_set("iteration.unbounded.open_windows",
+                          len(open_windows))
+            obs.gauge_set("iteration.unbounded.pending_predictions",
+                          len(pending_ts))
             if self.keep_model_history:
                 model_updates.append((end_ts, state))
             for listener in listeners:
@@ -937,6 +946,13 @@ class StreamingDriver:
             }
             state = update(state, Table.from_columns(train_schema, cols), epoch)
             metrics.end_step(samples=n_rows, window_end=end)
+            obs.counter_add("iteration.unbounded.windows")
+            obs.counter_add("iteration.unbounded.rows", n_rows)
+            obs.gauge_set("iteration.unbounded.open_windows", len(win_bufs))
+            obs.gauge_set(
+                "iteration.unbounded.pending_predictions",
+                pend.count if pend is not None else 0,
+            )
             if self.keep_model_history:
                 model_updates.append((end, state))
             for listener in listeners:

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import apply_sharded, resolve_features
@@ -396,6 +397,12 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         model.train_epochs_ = result.epochs
         model.train_cost_ = float(result.losses[-1]) if result.losses else 0.0
         model.train_metrics_ = result.metrics
+        obs.fit_report(
+            type(self).__name__,
+            step_metrics=result.metrics,
+            extra={"epochs": result.epochs, "cost": model.train_cost_,
+                   "k": int(k)},
+        )
         return model
 
     def _fit_out_of_core(self, table) -> KMeansModel:

@@ -28,13 +28,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.iteration.bounded import (
     IterationBodyResult,
     ReplayableInputs,
     iterate_bounded,
 )
 from flink_ml_tpu.iteration.config import IterationConfig
-from flink_ml_tpu.parallel.collectives import make_data_parallel_step, psum
+from flink_ml_tpu.parallel.collectives import (
+    make_data_parallel_step,
+    psum,
+    shard_map,
+)
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
 
@@ -77,6 +82,7 @@ class MinibatchStack:
     n_rows: int = 0  # true (un-padded) row count, for throughput metrics
 
 
+@obs.phased("pack_dense")
 def pack_minibatches(
     X: np.ndarray,
     y: np.ndarray,
@@ -170,6 +176,7 @@ class SparseMinibatchStack:
     n_rows: int = 0  # true (un-padded) row count, for throughput metrics
 
 
+@obs.phased("pack_sparse")
 def pack_sparse_minibatches(
     vectors: Sequence,
     y: np.ndarray,
@@ -303,6 +310,7 @@ def sparse_layout_floors(counts: np.ndarray, n_dev: int,
     return -(-nnz_max // pad_multiple) * pad_multiple, steps
 
 
+@obs.phased("pack_csr")
 def _pack_sparse_minibatches_csr(
     rows, y, n_dev: int, global_batch_size: int, dim, pad_multiple: int,
     min_nnz_pad: int, min_steps: int,
@@ -327,7 +335,12 @@ def _pack_sparse_minibatches_csr(
         # only runs on violation)
         adjacent_same_row = np.ones(nnz_total - 1, dtype=bool)
         row_ends = indptr[1:-1] - 1  # pair (i, i+1) crosses a row boundary
-        adjacent_same_row[row_ends[row_ends >= 0]] = False
+        # empty leading rows repeat indptr[i]=0 (row_ends -1) and empty
+        # trailing rows repeat indptr[i]=nnz_total (row_ends nnz_total-1,
+        # past the last PAIR) — both carry no adjacent pair to mask
+        adjacent_same_row[
+            row_ends[(row_ends >= 0) & (row_ends < nnz_total - 1)]
+        ] = False
         if np.any((np.diff(indices.astype(np.int64)) <= 0)
                   & adjacent_same_row):
             order = np.argsort(
@@ -395,6 +408,9 @@ from collections import OrderedDict
 _EPOCH_STEP_CACHE: OrderedDict = OrderedDict()
 _EPOCH_STEP_CACHE_CAPACITY = 32
 
+#: builds consumed by the most recent fused run (compile-run attribution)
+_RUN_BUILDS_SEEN = 0
+
 
 def _cache_get(key):
     fn = _EPOCH_STEP_CACHE.get(key)
@@ -403,10 +419,26 @@ def _cache_get(key):
     return fn
 
 
-def _cache_put(key, fn):
+#: monotonic count of FUSED-train program builds this process (independent
+#: of the obs registry so it survives ``obs.reset()`` and runs with obs
+#: off).  Only programs consumed by :func:`_run_fused_train` count — chunk
+#: programs (out_of_core) share the cache but have their own driver, and
+#: attributing their builds here would mark a cache-warm fused fit as
+#: compile-bearing whenever the paths interleave.
+_FUSED_PROGRAM_BUILDS = 0
+
+
+def _cache_put(key, fn, fused: bool = False):
+    global _FUSED_PROGRAM_BUILDS
     _EPOCH_STEP_CACHE[key] = fn
     while len(_EPOCH_STEP_CACHE) > _EPOCH_STEP_CACHE_CAPACITY:
         _EPOCH_STEP_CACHE.popitem(last=False)
+    # a build here means the next dispatch pays an XLA compile — the
+    # counter lets a RunReport distinguish compile-bearing fits from
+    # cache-warm ones
+    if fused:
+        _FUSED_PROGRAM_BUILDS += 1
+    obs.counter_add("train.program_builds")
     return fn
 
 
@@ -574,7 +606,7 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
 
     from jax.sharding import PartitionSpec as P
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_train,
         mesh=mesh,
         in_specs=in_specs if in_specs is not None else (P(), P("data")),
@@ -585,7 +617,8 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
         # see make_pallas_grad_fn) — every other path stays strict
         check_vma=check_vma,
     )
-    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)))
+    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)),
+                      fused=True)
 
 
 def _run_fused_train(train_fn, init_params, batch, mesh,
@@ -619,6 +652,8 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     )
     import time as _time
 
+    global _RUN_BUILDS_SEEN
+
     device_batch = batch if batch_preplaced else shard_batch(mesh, batch)
     t_run = _time.perf_counter()
     params, loss_hist, epochs, delta = train_fn(placed, device_batch)
@@ -638,6 +673,21 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
         loss=losses[-1] if losses else 0.0,
         dispatch_seconds=dispatch_s, sync_seconds=sync_s,
     )
+    # the compile/steady split: dispatch absorbs trace+compile (cold
+    # program) or just the enqueue (warm); sync is device execution +
+    # readback.  A run whose program was built since the previous fused
+    # run (the factory runs strictly before this driver) pays the XLA
+    # compile — count it so reports separate compile-bearing fits from
+    # cache-warm ones.
+    obs.observe("train.dispatch", dispatch_s)
+    obs.observe("train.sync", sync_s)
+    obs.counter_add("train.fused_runs")
+    obs.counter_add("train.epochs", n_epochs)
+    obs.counter_add("train.rows", n_rows * n_epochs)
+    if _FUSED_PROGRAM_BUILDS > _RUN_BUILDS_SEEN:
+        obs.counter_add("train.compile_runs")
+    _RUN_BUILDS_SEEN = _FUSED_PROGRAM_BUILDS
+    obs.record_hbm_gauges()
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
     return TrainResult(
         params=host_params,
@@ -1058,6 +1108,7 @@ def hotcold_layout_floors(sstack: SparseMinibatchStack, hot_k: int,
     return (plan["hot_pad"], plan["cold_pad"]), plan
 
 
+@obs.phased("split_hot_cold")
 def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
                    pad_multiple: int = 512,
                    slab_dtype=jnp.bfloat16,
@@ -1131,6 +1182,7 @@ def split_hot_cold(sstack: SparseMinibatchStack, hot_k: int,
     )
 
 
+@obs.phased("densify_hot_slabs")
 def densify_hot_slabs(mesh, hstack: HotColdStack):
     """Build the per-minibatch hot slabs ON DEVICE, sharded over 'data'
     (and over 'model' on slab columns when the layout is feature-sharded).
@@ -1171,7 +1223,7 @@ def densify_hot_slabs(mesh, hstack: HotColdStack):
 
             return jax.lax.map(one, (hot_ints, hot_vals))
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local_sharded, mesh=mesh, in_specs=(P("data"), P("data")),
             out_specs=P("data", None, "model"), check_vma=True,
         ))
@@ -1187,7 +1239,7 @@ def densify_hot_slabs(mesh, hstack: HotColdStack):
         return jax.lax.map(one, (hot_ints, hot_vals))
 
     if dict(mesh.shape).get("data", 1) > 1:
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local, mesh=mesh, in_specs=(P("data"), P("data")),
             out_specs=P("data"), check_vma=True,
         ))
@@ -2057,7 +2109,17 @@ def fetch_flat(*arrays):
     dtype follows the backend: f64 only when x64 is enabled (CPU test mesh) —
     requesting f64 on TPU would just truncate to f32 with a warning per call.
     """
+    from flink_ml_tpu.parallel.collectives import HAS_NATIVE_SHARD_MAP
+
     fetch_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    if not HAS_NATIVE_SHARD_MAP:
+        # legacy JAX (pre-jax.shard_map): concatenating arrays with MIXED
+        # shardings — a 'model'-sharded weight vector next to a replicated
+        # loss history — miscompiles, returning values multiplied by the
+        # unmentioned mesh axis size (observed on 0.4.x, eager AND jitted).
+        # Per-array fetches are correct there; the bundled single-transfer
+        # fast path stays on for current JAX.
+        return [np.asarray(a).astype(fetch_dtype) for a in arrays]
     shapes = [a.shape for a in arrays]
     sizes = [int(np.prod(s)) for s in shapes]
     flat = jnp.concatenate(

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
@@ -482,8 +483,22 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 hotcold_hot_k_eff(sstack.dim, hot_k, model_size),
             )
             resident = slab_bytes <= budget
+            if jax.process_count() > 1:
+                # local budget env vars / near-boundary slab sizes can
+                # disagree across processes; divergent resident-vs-stream
+                # booleans build fused programs with different collective
+                # schedules — a hang.  Stream wins ties: any process voting
+                # stream (its slabs don't fit) forces stream everywhere.
+                from flink_ml_tpu.parallel.mesh import agree_max
+
+                (want_stream,) = agree_max(int(not resident))
+                resident = not want_stream
+            obs.gauge_set("train.hot_slab_bytes", float(slab_bytes))
         else:
             resident = mode == "resident"
+        # the agreed decision, visible in every RunReport: 1.0 = resident
+        # slabs, 0.0 = in-program densify (stream)
+        obs.gauge_set("train.hot_slab_resident", float(resident))
         if resident:
             device_batch = lambda: table.cached_pack(  # noqa: E731
                 layout_key + ("hotdev", hot_k, mesh),
@@ -909,4 +924,12 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         model.train_epochs_ = result.epochs
         model.train_losses_ = result.losses
         model.train_metrics_ = result.metrics
+        obs.fit_report(
+            type(self).__name__,
+            step_metrics=result.metrics,
+            extra={
+                "epochs": result.epochs,
+                "loss": result.losses[-1] if result.losses else None,
+            },
+        )
         return model

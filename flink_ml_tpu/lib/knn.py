@@ -20,9 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import apply_sharded, resolve_features
+from flink_ml_tpu.parallel.collectives import pvary, shard_map
 from flink_ml_tpu.lib.model_base import TableModelBase
 from flink_ml_tpu.lib.params import (
     HasBf16Distances,
@@ -137,13 +139,13 @@ def _knn_apply_model_sharded(mesh, k, chunk, n_classes, bf16=False):
     def local_candidates(xq, xt_local, yt_local):
         # queries are replicated (unvarying) but meet the varying reference
         # shard inside the top-k scan carry: mark them varying up front
-        xq = jax.lax.pcast(xq, ("data",), to="varying")
+        xq = pvary(xq, ("data",))
         labels, dists = _knn_chunked(xq, xt_local, yt_local, k, chunk, bf16)
         # leading size-1 axis: the shard_map output gather stacks shards
         # there, giving (n_dev, n, k, 2) without any in-program collective
         return jnp.stack([labels, dists], axis=2)[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_candidates,
         mesh=mesh,
         in_specs=(P(), P("data"), P("data")),
@@ -320,4 +322,8 @@ class Knn(Estimator, KnnParams, HasLabelCol):
         model.set_model_data(Table.from_columns(
             KNN_MODEL_SCHEMA, {"features": np.asarray(X), "label": y}
         ))
+        obs.fit_report(
+            type(self).__name__,
+            extra={"n_train": int(len(y)), "dim": int(dim)},
+        )
         return model

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.iteration.unbounded import StreamingDriver, StreamingResult
 from flink_ml_tpu.lib.classification import LogisticRegressionModel, _log_loss_grads
@@ -246,6 +247,11 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
         model.set_model_data(make_model_table(w, float(b)))
         model.windows_fired_ = result.windows_fired
         model.train_metrics_ = result.metrics
+        obs.fit_report(
+            type(self).__name__,
+            step_metrics=result.metrics,
+            extra={"windows_fired": result.windows_fired},
+        )
         return model, result
 
     # -- bounded convenience (replay a table as a stream) --------------------

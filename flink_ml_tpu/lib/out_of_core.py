@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.lib.common import (
     TrainResult,
     _cache_get,
@@ -58,7 +59,7 @@ from flink_ml_tpu.lib.common import (
     pack_sparse_minibatches,
 )
 from flink_ml_tpu.ops.batch import CsrRows
-from flink_ml_tpu.parallel.collectives import psum
+from flink_ml_tpu.parallel.collectives import psum, shard_map
 from flink_ml_tpu.table.sources import _atomic_np_save
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
@@ -114,7 +115,7 @@ def make_chunk_step_fn(key, mb_grad_step, mesh, learning_rate: float, reg: float
     from jax.sharding import PartitionSpec as P
 
     carry_spec = (param_spec if param_spec is not None else P(), P(), P())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_chunk,
         mesh=mesh,
         in_specs=(carry_spec, P("data")),
@@ -386,6 +387,8 @@ def train_out_of_core(
         pending.append((loss_sum, w_sum))
         total_rows += n_rows
         epoch += 1
+        obs.counter_add("train.ooc_epochs")
+        obs.counter_add("train.ooc_rows", n_rows)
         if tol > 0.0:
             final_delta = float(last_delta_dev)  # the per-epoch sync tol demands
             converged = final_delta <= tol
@@ -683,7 +686,7 @@ def make_kmeans_chunk_fn(key, k: int, mesh):
 
     from jax.sharding import PartitionSpec as P
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_chunk,
         mesh=mesh,
         in_specs=(P(), P("data")),
@@ -828,10 +831,16 @@ class BlockSpill:
 
         i = 0
         for batch, n_rows in items:
-            leaves, treedef = jax.tree_util.tree_flatten(batch)
-            self._treedef = treedef
-            for j, x in enumerate(leaves):
-                _atomic_np_save(self._path(i, j), np.asarray(x))
+            with obs.phase("spill.write_block"):
+                leaves, treedef = jax.tree_util.tree_flatten(batch)
+                self._treedef = treedef
+                nbytes = 0
+                for j, x in enumerate(leaves):
+                    arr = np.asarray(x)
+                    _atomic_np_save(self._path(i, j), arr)
+                    nbytes += arr.nbytes
+            obs.counter_add("spill.blocks_written")
+            obs.counter_add("spill.bytes_written", nbytes)
             self._meta.append((int(n_rows), len(leaves)))
             i += 1
             yield batch, n_rows
@@ -843,6 +852,7 @@ class BlockSpill:
                 np.load(self._path(i, j), mmap_mode="r")
                 for j in range(n_leaves)
             ]
+            obs.counter_add("spill.blocks_replayed")
             yield jax.tree_util.tree_unflatten(self._treedef, leaves), n_rows
 
     def close(self):

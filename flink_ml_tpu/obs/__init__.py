@@ -1,0 +1,70 @@
+"""Unified run telemetry (ISSUE 1): metrics registry, phase timers, reports.
+
+The reference has no in-library observability at all — Flink's web UI was
+the only hook (see ``utils/metrics.py``).  This package is the repo's one
+measurement layer:
+
+  * :mod:`flink_ml_tpu.obs.registry` — a process-wide registry of counters,
+    gauges, and timing histograms, plus nested ``phase("pack_csr")`` timers
+    that separate host-side packing, compile/dispatch, device step time,
+    and spill I/O.  **Off by default** and near-zero-cost when off: every
+    hook degrades to one module-level boolean check.  Enable with
+    ``obs.enable()`` or ``FMT_OBS=1``.
+  * :mod:`flink_ml_tpu.obs.report` — structured JSONL :class:`RunReport`
+    records (git SHA, device topology, registry snapshot, StepMetrics
+    summary) written by every ``fit``/bench invocation while obs is on,
+    and the ``python -m flink_ml_tpu.obs`` CLI that diffs the
+    latest bench reports against ``BASELINE.json`` and flags throughput
+    regressions.
+
+``StepMetrics`` (per-step wall/loss/throughput) and ``utils.tracing``
+(jax.profiler hooks) remain the per-run primitives; this package is where
+their outputs — and everything else worth keeping — get aggregated and
+persisted per run instead of dying in stdout.
+"""
+
+from flink_ml_tpu.obs.registry import (
+    MetricsRegistry,
+    counter_add,
+    disable,
+    enable,
+    enabled,
+    gauge_set,
+    observe,
+    phase,
+    phased,
+    record_hbm_gauges,
+    registry,
+    reset,
+)
+from flink_ml_tpu.obs.report import (
+    RunReport,
+    bench_report,
+    fit_report,
+    git_sha,
+    load_reports,
+    reports_dir,
+    write_run_report,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "RunReport",
+    "bench_report",
+    "counter_add",
+    "disable",
+    "enable",
+    "enabled",
+    "fit_report",
+    "gauge_set",
+    "git_sha",
+    "load_reports",
+    "observe",
+    "phase",
+    "phased",
+    "record_hbm_gauges",
+    "registry",
+    "reports_dir",
+    "reset",
+    "write_run_report",
+]

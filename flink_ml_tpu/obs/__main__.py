@@ -1,0 +1,14 @@
+"""``python -m flink_ml_tpu.obs`` — the report diff CLI.
+
+The package ``__init__`` imports :mod:`flink_ml_tpu.obs.report`, so running
+``python -m flink_ml_tpu.obs.report`` makes runpy re-execute an
+already-imported module (a RuntimeWarning plus a duplicate copy of its
+globals).  This entry point runs the SAME ``main`` without re-execution;
+the longer spelling keeps working for compatibility.
+"""
+
+import sys
+
+from flink_ml_tpu.obs.report import main
+
+sys.exit(main())

@@ -1,0 +1,379 @@
+"""Structured JSONL run reports + the BASELINE.json diff CLI.
+
+Every ``fit``/bench invocation with obs enabled appends one
+:class:`RunReport` line to ``<reports dir>/runs.jsonl``: git SHA, device
+topology, the metrics-registry snapshot, the driver's StepMetrics summary,
+and free-form extras.  Round 5's VERDICT found the repo's headline numbers
+"live in commit messages and stray /tmp logs" — this file is where they
+live instead, durable and diffable.
+
+The CLI::
+
+    python -m flink_ml_tpu.obs [--check] [--reports DIR]
+                               [--baseline BASELINE.json]
+
+(``python -m flink_ml_tpu.obs.report`` also works, at the cost of a runpy
+re-execution warning — the package __init__ already imports this module).
+
+diffs the LATEST bench report per metric against the ``measured`` section
+of ``BASELINE.json`` and prints per-metric status; throughput metrics
+(unit contains ``/sec``) that dropped >= ``--threshold`` (default 10%)
+are flagged as regressions, and ``--check`` exits non-zero on any.
+Comparisons are backend-scoped: a CPU-backend run is never diffed against
+a TPU-measured baseline (that delta is the hardware, not the code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+# bind the functions, not the submodule: the package __init__ re-exports
+# a *function* named ``registry`` that shadows the submodule attribute, so
+# both ``from flink_ml_tpu.obs import registry`` and ``import
+# flink_ml_tpu.obs.registry as x`` resolve to the wrong object once the
+# package is initialized
+from flink_ml_tpu.obs.registry import enabled as _obs_enabled
+from flink_ml_tpu.obs.registry import registry as _obs_registry
+from flink_ml_tpu.obs.registry import reset_generation as _obs_reset_gen
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_GIT_SHA: Optional[str] = None
+
+
+def git_sha() -> str:
+    """The repo HEAD SHA (cached; ``unknown`` outside a git checkout)."""
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        sha = os.environ.get("FMT_GIT_SHA")
+        if not sha:
+            try:
+                sha = subprocess.run(
+                    ["git", "rev-parse", "HEAD"],
+                    cwd=_REPO_ROOT, capture_output=True, text=True, timeout=10,
+                ).stdout.strip() or "unknown"
+            except Exception:  # noqa: BLE001 - telemetry must never break fit
+                sha = "unknown"
+        _GIT_SHA = sha
+    return _GIT_SHA
+
+
+def device_topology() -> dict:
+    """Backend / device-count / process-count / device-kind of this run."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+            "device_kind": devices[0].device_kind if devices else None,
+        }
+    except Exception:  # noqa: BLE001 - report even when jax is unhappy
+        return {"backend": "unknown", "device_count": 0,
+                "process_count": 0, "device_kind": None}
+
+
+@dataclasses.dataclass
+class RunReport:
+    """One telemetry record: everything a run measured, self-describing."""
+
+    kind: str                      # "fit" | "bench" | "import"
+    name: str                      # estimator class or bench metric name
+    ts: float                      # unix seconds at write time
+    git_sha: str
+    device: dict                   # device_topology()
+    shape: Optional[str] = None    # workload shape, free-form
+    metrics: Optional[dict] = None  # registry snapshot (counters/gauges/timings)
+    step_summary: Optional[dict] = None  # StepMetrics.summary()
+    extra: Optional[dict] = None   # per-kind payload (bench record, epochs, ...)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def reports_dir() -> str:
+    """``FMT_OBS_REPORTS`` if set, else ``<repo>/reports``."""
+    return os.environ.get("FMT_OBS_REPORTS") or os.path.join(
+        _REPO_ROOT, "reports"
+    )
+
+
+def _runs_path(directory: Optional[str] = None) -> str:
+    return os.path.join(directory or reports_dir(), "runs.jsonl")
+
+
+def write_run_report(report: RunReport, directory: Optional[str] = None) -> str:
+    """Append one JSONL line; returns the file path."""
+    path = _runs_path(directory)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(report.to_dict(), sort_keys=True) + "\n")
+    return path
+
+
+#: registry state already attributed to an earlier fit RunReport — fit
+#: reports carry the DELTA since the previous fit, so a process running
+#: several fits (every bench workload does) never misattributes earlier
+#: fits' counters to a later one
+_PREV_FIT_SNAPSHOT: dict = {"counters": {}, "timings": {}}
+_PREV_FIT_RESET_GEN = 0
+
+
+def _fit_delta_snapshot() -> dict:
+    """Registry snapshot scoped to work since the last fit report.
+
+    Counters subtract the previously-attributed totals; timings subtract
+    count/total (mean derived), dropping stats with no new observations.
+    An ``obs.reset()`` in between invalidates the previous totals — the
+    reset generation detects that even when post-reset totals happen to
+    equal pre-reset ones (a shrunken-total guard alone misses equality).
+    Gauges are last-value by nature and pass through."""
+    global _PREV_FIT_SNAPSHOT, _PREV_FIT_RESET_GEN
+    snap = _obs_registry().snapshot()
+    gen = _obs_reset_gen()
+    if gen != _PREV_FIT_RESET_GEN:
+        _PREV_FIT_SNAPSHOT = {"counters": {}, "timings": {}}
+        _PREV_FIT_RESET_GEN = gen
+    prev = _PREV_FIT_SNAPSHOT
+    counters = {}
+    for k, v in snap["counters"].items():
+        d = v - prev["counters"].get(k, 0)
+        if d < 0:
+            d = v
+        if d:
+            counters[k] = d
+    timings = {}
+    for k, t in snap["timings"].items():
+        p = prev["timings"].get(k)
+        dc = t["count"] - (p["count"] if p else 0)
+        dt = t["total_s"] - (p["total_s"] if p else 0.0)
+        if dc < 0:
+            dc, dt = t["count"], t["total_s"]
+        if dc > 0:
+            timings[k] = {
+                "count": dc,
+                "total_s": dt,
+                "mean_s": dt / dc,
+            }
+    _PREV_FIT_SNAPSHOT = {
+        "counters": dict(snap["counters"]),
+        "timings": {k: dict(v) for k, v in snap["timings"].items()},
+    }
+    return {"counters": counters, "gauges": snap["gauges"],
+            "timings": timings}
+
+
+def _build_report(kind: str, name: str, shape=None, step_metrics=None,
+                  extra=None) -> RunReport:
+    summary = None
+    if step_metrics is not None:
+        try:
+            summary = step_metrics.summary()
+            # the compile-vs-steady split: fused drivers stamp per-step
+            # dispatch (trace+compile+enqueue) and sync (device execution)
+            # seconds into their StepMetrics records — surface the last
+            # step's split at the top level so reports are greppable
+            last = step_metrics.steps[-1] if step_metrics.steps else {}
+            for k in ("dispatch_seconds", "sync_seconds"):
+                if k in last:
+                    summary[k] = last[k]
+        except Exception:  # noqa: BLE001 - never fail a fit over telemetry
+            summary = None
+    # fit reports scope metrics to the fit itself; bench reports keep the
+    # whole workload's since-reset snapshot (bench_all resets per workload)
+    metrics = (
+        _fit_delta_snapshot() if kind == "fit"
+        else _obs_registry().snapshot()
+    )
+    return RunReport(
+        kind=kind,
+        name=name,
+        ts=time.time(),
+        git_sha=git_sha(),
+        device=device_topology(),
+        shape=shape,
+        metrics=metrics,
+        step_summary=summary,
+        extra=extra,
+    )
+
+
+def fit_report(name: str, shape=None, step_metrics=None, extra=None,
+               directory: Optional[str] = None) -> Optional[str]:
+    """Write a ``fit`` RunReport (no-op when obs is disabled).
+
+    Called by training drivers at the end of every successful fit; errors
+    (read-only FS, missing git) are swallowed — telemetry must never turn
+    a trained model into an exception."""
+    if not _obs_enabled():
+        return None
+    try:
+        return write_run_report(
+            _build_report("fit", name, shape, step_metrics, extra), directory
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def bench_report(record: dict, directory: Optional[str] = None) -> Optional[str]:
+    """Write a ``bench`` RunReport from one bench_all result record."""
+    if not _obs_enabled():
+        return None
+    try:
+        return write_run_report(
+            _build_report(
+                "bench", str(record.get("metric", "unknown")),
+                shape=record.get("shape"), extra=record,
+            ),
+            directory,
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def load_reports(directory: Optional[str] = None) -> List[dict]:
+    """All RunReport dicts from ``runs.jsonl`` (empty list when absent)."""
+    path = _runs_path(directory)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def latest_bench_by_name(reports: List[dict]) -> Dict[str, dict]:
+    """Last bench-kind report per metric name (file order == time order)."""
+    latest: Dict[str, dict] = {}
+    for r in reports:
+        if r.get("kind") == "bench":
+            latest[r.get("name", "")] = r
+    return latest
+
+
+def _bench_value(report: dict):
+    extra = report.get("extra") or {}
+    return extra.get("value"), extra.get("unit", "")
+
+
+def diff_against_baseline(reports: List[dict], baseline: dict,
+                          threshold: float = 0.10) -> List[dict]:
+    """Compare latest bench reports to ``baseline["measured"]``.
+
+    Returns one row per baseline metric: ``status`` is ``regression`` when
+    a throughput metric (unit contains ``/sec``) dropped more than
+    ``threshold`` relative to baseline, ``improved`` when it rose that
+    much, ``ok`` within the band, ``no-report`` / ``backend-mismatch``
+    when not comparable."""
+    measured = baseline.get("measured", {})
+    latest = latest_bench_by_name(reports)
+    rows = []
+    for name, base in sorted(measured.items()):
+        row = {
+            "metric": name,
+            "baseline": base.get("value"),
+            "unit": base.get("unit", ""),
+            "backend": base.get("backend", ""),
+        }
+        rep = latest.get(name)
+        if rep is None:
+            row.update(status="no-report", latest=None, ratio=None)
+            rows.append(row)
+            continue
+        rep_backend = (rep.get("device") or {}).get("backend")
+        if base.get("backend") and rep_backend != base.get("backend"):
+            row.update(status="backend-mismatch", latest=None, ratio=None,
+                       report_backend=rep_backend)
+            rows.append(row)
+            continue
+        value, unit = _bench_value(rep)
+        base_value = base.get("value")
+        # only a missing latest value or an unusable (zero/absent) baseline
+        # denominator skips the comparison — a latest value of 0.0 against
+        # a nonzero baseline is the WORST regression, not "no value"
+        if value is None or not base_value:
+            row.update(status="no-value", latest=value, ratio=None)
+            rows.append(row)
+            continue
+        ratio = float(value) / float(base_value)
+        throughput = "/sec" in (unit or base.get("unit", ""))
+        if throughput and ratio < 1.0 - threshold:
+            status = "regression"
+        elif throughput and ratio > 1.0 + threshold:
+            status = "improved"
+        else:
+            status = "ok"
+        row.update(status=status, latest=value, ratio=round(ratio, 3),
+                   git_sha=rep.get("git_sha"))
+        rows.append(row)
+    return rows
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flink_ml_tpu.obs",
+        description="Diff the latest committed bench reports against "
+                    "BASELINE.json and flag throughput regressions.",
+    )
+    parser.add_argument("--reports", default=None,
+                        help="reports directory (default: repo reports/)")
+    parser.add_argument("--baseline",
+                        default=os.path.join(_REPO_ROOT, "BASELINE.json"))
+    parser.add_argument("--threshold", type=float, default=0.10,
+                        help="relative drop that counts as a regression")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 when any regression is flagged")
+    args = parser.parse_args(argv)
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    reports = load_reports(args.reports)
+    rows = diff_against_baseline(reports, baseline, args.threshold)
+    if not rows:
+        print("no measured baselines in"
+              f" {args.baseline} — nothing to diff (record bench runs via"
+              " bench_all.py, then add them to BASELINE.json 'measured')")
+        return 0
+    width = max(len(r["metric"]) for r in rows)
+    regressions = 0
+    for r in rows:
+        ratio = f"{r['ratio']:.3f}x" if r.get("ratio") is not None else "-"
+        latest = (f"{r['latest']:.6g}" if r.get("latest") is not None
+                  else "-")
+        base = (f"{r['baseline']:.6g}" if r.get("baseline") is not None
+                else "-")
+        print(f"{r['metric']:<{width}}  base={base:<12} latest={latest:<12} "
+              f"{ratio:<8} [{r['backend'] or 'any'}] {r['status']}")
+        if r["status"] == "regression":
+            regressions += 1
+    n_cmp = sum(r["status"] in ("ok", "improved", "regression") for r in rows)
+    print(f"\n{len(rows)} baselined metric(s), {n_cmp} comparable, "
+          f"{regressions} regression(s) at >{args.threshold:.0%} drop")
+    if args.check and regressions:
+        return 1
+    if args.check and rows and n_cmp == 0:
+        # baselines exist but NOTHING was diffed (renamed metrics, missing
+        # reports, backend drift): a gate that silently compares nothing
+        # stays green forever — fail loudly instead
+        print("check FAILED: baselined metrics exist but none were "
+              "comparable — metric names, reports/, or backend drifted")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_ml_tpu import obs
 from flink_ml_tpu.ops.codec import parse_vector
 from flink_ml_tpu.ops.vector import SparseVector
 from flink_ml_tpu.table.schema import DataTypes, Schema
@@ -295,7 +296,15 @@ class ChunkedTable:
         return self.source.schema()
 
     def chunks(self) -> Iterator[Table]:
-        return self.source.read_chunks(self.chunk_rows)
+        if not obs.enabled():
+            return self.source.read_chunks(self.chunk_rows)
+        return self._counted_chunks()
+
+    def _counted_chunks(self) -> Iterator[Table]:
+        for t in self.source.read_chunks(self.chunk_rows):
+            obs.counter_add("source.chunks_parsed")
+            obs.counter_add("source.rows_parsed", t.num_rows())
+            yield t
 
     def materialize(self) -> Table:
         return self.source.read()
@@ -686,24 +695,32 @@ class ChunkSpillCache:
         return os.path.join(self.directory, f"chunk-{i:06d}-{j:02d}.npy")
 
     def _record(self):
-        self._chunks = []
+        # descriptors accumulate LOCALLY and publish to self._chunks only
+        # when the base iterator is exhausted: an abandoned partial
+        # recording generator (sampled pre-scans, schema peeks) that is
+        # later resumed — or a second interleaved chunks() iteration —
+        # must never splice its pass's metadata into another pass's replay
+        # sequence
+        chunks: list = []
         base_iter = self.base.chunks()
         i = 0
         for t in base_iter:
-            descs = self._try_save(t, i)
+            with obs.phase("spill.record_chunk"):
+                descs = self._try_save(t, i)
             if descs is None:
-                # uncacheable column shape: disable, discard partial
-                # recordings, and keep serving the rest of this pass
-                # straight from the same base iterator (chunks already
-                # consumed cannot be re-read mid-pass)
+                # uncacheable column shape: disable and keep serving the
+                # rest of this pass straight from the same base iterator
+                # (chunks already consumed cannot be re-read mid-pass)
                 self._disabled = True
-                self._chunks = []
+                obs.counter_add("spill.cache_disabled")
                 yield t
                 yield from base_iter
                 return
-            self._chunks.append((t.schema, descs))
+            chunks.append((t.schema, descs))
+            obs.counter_add("spill.chunks_recorded")
             i += 1
             yield t
+        self._chunks = chunks
         self._complete = True
 
     def _try_save(self, t: Table, i: int):
@@ -747,17 +764,20 @@ class ChunkSpillCache:
         from flink_ml_tpu.ops.batch import CsrRows
 
         for schema, descs in self._chunks:
-            cols = {}
-            for name, d in descs:
-                if d[0] == "csr":
-                    _, dim, paths = d
-                    indptr, indices, values = (
-                        np.load(p, mmap_mode="r") for p in paths
-                    )
-                    cols[name] = CsrRows(dim, indptr, indices, values)
-                else:
-                    cols[name] = np.load(d[1], mmap_mode="r")
-            yield Table.from_columns(schema, cols)
+            with obs.phase("spill.replay_chunk"):
+                cols = {}
+                for name, d in descs:
+                    if d[0] == "csr":
+                        _, dim, paths = d
+                        indptr, indices, values = (
+                            np.load(p, mmap_mode="r") for p in paths
+                        )
+                        cols[name] = CsrRows(dim, indptr, indices, values)
+                    else:
+                        cols[name] = np.load(d[1], mmap_mode="r")
+                table = Table.from_columns(schema, cols)
+            obs.counter_add("spill.chunks_replayed")
+            yield table
 
 
 def _has_cache_below(table) -> bool:

@@ -848,3 +848,81 @@ def test_unsorted_csr_rows_pack_sorted():
     got = sorted(zip(idx[valid].tolist(), s.floats[0, : s.nnz_pad][valid].tolist()))
     want = sorted(zip(indices.tolist(), values.tolist()))
     assert got == want
+
+
+class TestCsrEmptyRowPack:
+    """ADVICE r5 high (the tier-1 red test): CSR packing raised IndexError
+    whenever the column carried empty trailing rows — interior indptr
+    entries equal to nnz_total put nnz_total-1 into the length-(nnz_total-1)
+    adjacent-pair mask.  Any libsvm file ending in a featureless row
+    crashed the vectorized ingestion path."""
+
+    def _pack_both(self, indptr, indices, values, dim):
+        from flink_ml_tpu.lib.common import pack_sparse_minibatches
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        indptr = np.asarray(indptr, dtype=np.int64)
+        indices = np.asarray(indices, dtype=np.int64)
+        values = np.asarray(values, dtype=np.float64)
+        n = len(indptr) - 1
+        y = np.arange(n, dtype=np.float64)
+        csr_stack = pack_sparse_minibatches(
+            CsrRows(dim, indptr, indices, values), y, 1, n, dim=dim
+        )
+        vecs = [
+            SparseVector(dim, indices[indptr[i]:indptr[i + 1]],
+                         values[indptr[i]:indptr[i + 1]])
+            for i in range(n)
+        ]
+        row_stack = pack_sparse_minibatches(vecs, y, 1, n, dim=dim)
+        return csr_stack, row_stack
+
+    def test_trailing_empty_row(self):
+        # the ADVICE repro: indptr=[0,2,3,3], fully sorted indices
+        s_csr, s_row = self._pack_both(
+            [0, 2, 3, 3], [1, 4, 2], [1.0, 2.0, 3.0], dim=8
+        )
+        np.testing.assert_array_equal(s_csr.ints, s_row.ints)
+        np.testing.assert_array_equal(s_csr.floats, s_row.floats)
+        assert s_csr.n_rows == 3
+
+    def test_leading_and_interior_empty_rows(self):
+        s_csr, s_row = self._pack_both(
+            [0, 0, 2, 2, 3], [3, 5, 0], [1.0, 2.0, 3.0], dim=8
+        )
+        np.testing.assert_array_equal(s_csr.ints, s_row.ints)
+        np.testing.assert_array_equal(s_csr.floats, s_row.floats)
+
+    def test_trailing_empty_row_with_unsorted_indices(self):
+        # the sort path must also survive empty-row indptr repeats
+        s_csr, s_row = self._pack_both(
+            [0, 2, 4, 4], [4, 1, 9, 2], [1.0, 2.0, 3.0, 4.0], dim=16
+        )
+        np.testing.assert_array_equal(s_csr.ints, s_row.ints)
+        np.testing.assert_array_equal(s_csr.floats, s_row.floats)
+
+    def test_trailing_empty_rows_train_end_to_end(self):
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        rng = np.random.RandomState(3)
+        n, dim, nnz = 60, 12, 3
+        indptr = [0]
+        idx_all, val_all = [], []
+        for i in range(n):
+            k = 0 if i in (0, n - 1, n - 2) else nnz  # empty head + tail
+            idx = np.sort(rng.choice(dim, k, replace=False))
+            idx_all.append(idx)
+            val_all.append(rng.randn(k))
+            indptr.append(indptr[-1] + k)
+        rows = CsrRows(
+            dim,
+            np.asarray(indptr, dtype=np.int64),
+            np.concatenate(idx_all).astype(np.int64),
+            np.concatenate(val_all),
+        )
+        y = (rng.randn(n) > 0).astype(np.float64)
+        t = Table.from_columns(SCHEMA, {"features": rows, "label": y})
+        model = (LogisticRegression().set_vector_col("features")
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_num_features(dim).set_max_iter(3).fit(t))
+        assert model.train_epochs_ >= 1

@@ -1,0 +1,362 @@
+"""Unified run-telemetry subsystem tests (ISSUE 1): registry semantics,
+off-by-default zero-cost hooks, RunReport JSONL persistence, and the
+BASELINE.json diff CLI — plus the hot-path wiring (a tiny fit with obs on
+must leave a parseable report with the compile/steady split recorded)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.obs.report import diff_against_baseline, main as report_main
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolated():
+    """Every test starts disabled with a clean registry and leaves no
+    global state behind (obs is process-wide by design)."""
+    import flink_ml_tpu.obs.report as _report_mod
+
+    obs.disable()
+    obs.reset()
+    _report_mod._PREV_FIT_SNAPSHOT = {"counters": {}, "timings": {}}
+    yield
+    obs.disable()
+    obs.reset()
+    _report_mod._PREV_FIT_SNAPSHOT = {"counters": {}, "timings": {}}
+
+
+class TestRegistry:
+    def test_counters_gauges_timings_roundtrip(self):
+        obs.enable()
+        obs.counter_add("c.a")
+        obs.counter_add("c.a", 4)
+        obs.gauge_set("g.x", 7.5)
+        obs.observe("t.step", 0.25)
+        obs.observe("t.step", 0.75)
+        snap = obs.registry().snapshot()
+        assert snap["counters"]["c.a"] == 5
+        assert snap["gauges"]["g.x"] == 7.5
+        t = snap["timings"]["t.step"]
+        assert t["count"] == 2
+        assert t["total_s"] == pytest.approx(1.0)
+        assert t["min_s"] == pytest.approx(0.25)
+        assert t["max_s"] == pytest.approx(0.75)
+        assert t["mean_s"] == pytest.approx(0.5)
+        obs.reset()
+        assert obs.registry().snapshot() == {
+            "counters": {}, "gauges": {}, "timings": {}
+        }
+
+    def test_disabled_hooks_record_nothing(self):
+        assert not obs.enabled()
+        obs.counter_add("c.off")
+        obs.gauge_set("g.off", 1.0)
+        obs.observe("t.off", 1.0)
+        with obs.phase("p.off"):
+            pass
+        snap = obs.registry().snapshot()
+        assert snap == {"counters": {}, "gauges": {}, "timings": {}}
+
+    def test_phase_nesting_builds_paths(self):
+        obs.enable()
+        with obs.phase("fit"):
+            with obs.phase("pack_csr"):
+                pass
+            with obs.phase("pack_csr"):
+                pass
+        snap = obs.registry().snapshot()
+        assert snap["timings"]["phase.fit"]["count"] == 1
+        assert snap["timings"]["phase.fit/pack_csr"]["count"] == 2
+
+    def test_phased_decorator(self):
+        calls = []
+
+        @obs.phased("work")
+        def work(x):
+            calls.append(x)
+            return x * 2
+
+        assert work(3) == 6  # disabled: plain passthrough
+        obs.enable()
+        assert work(4) == 8
+        snap = obs.registry().snapshot()
+        assert snap["timings"]["phase.work"]["count"] == 1
+        assert calls == [3, 4]
+
+    def test_snapshot_is_json_serializable(self):
+        obs.enable()
+        obs.counter_add("c", 2)
+        obs.observe("t", 0.1)
+        obs.gauge_set("g", 3.0)
+        json.dumps(obs.registry().snapshot())
+
+
+class TestRunReports:
+    def test_write_and_load_roundtrip(self, tmp_path):
+        obs.enable()
+        obs.counter_add("train.epochs", 3)
+        path = obs.fit_report(
+            "UnitTestEstimator", shape="8x2", extra={"epochs": 3},
+            directory=str(tmp_path),
+        )
+        assert path and os.path.exists(path)
+        reports = obs.load_reports(str(tmp_path))
+        assert len(reports) == 1
+        r = reports[0]
+        assert r["kind"] == "fit"
+        assert r["name"] == "UnitTestEstimator"
+        assert r["git_sha"]
+        assert r["device"]["backend"]
+        assert r["metrics"]["counters"]["train.epochs"] == 3
+        assert r["extra"] == {"epochs": 3}
+
+    def test_fit_reports_carry_per_fit_deltas(self, tmp_path):
+        """A process running several fits must not attribute fit 1's
+        counters to fit 2's report (the registry is cumulative; the
+        reports are scoped)."""
+        obs.enable()
+        obs.counter_add("train.epochs", 5)
+        obs.observe("train.dispatch", 1.0)
+        obs.fit_report("FitA", directory=str(tmp_path))
+        obs.counter_add("train.epochs", 2)
+        obs.observe("train.dispatch", 0.25)
+        obs.fit_report("FitB", directory=str(tmp_path))
+        obs.fit_report("FitC", directory=str(tmp_path))  # nothing new
+        a, b, c = obs.load_reports(str(tmp_path))
+        assert a["metrics"]["counters"]["train.epochs"] == 5
+        assert b["metrics"]["counters"]["train.epochs"] == 2
+        assert b["metrics"]["timings"]["train.dispatch"] == {
+            "count": 1, "total_s": 0.25, "mean_s": 0.25,
+        }
+        assert c["metrics"]["counters"] == {}
+        assert c["metrics"]["timings"] == {}
+
+    def test_fit_delta_survives_registry_reset(self, tmp_path):
+        obs.enable()
+        obs.counter_add("c", 10)
+        obs.fit_report("A", directory=str(tmp_path))
+        obs.reset()  # a new workload scope
+        obs.counter_add("c", 3)
+        obs.fit_report("B", directory=str(tmp_path))
+        _, b = obs.load_reports(str(tmp_path))
+        # a reset invalidates the previous totals: report the new value,
+        # never a negative delta
+        assert b["metrics"]["counters"]["c"] == 3
+
+    def test_fit_delta_detects_reset_even_at_equal_totals(self, tmp_path):
+        """bench_all's per-workload obs.reset() must not make a later
+        workload's fit report drop counters whose post-reset totals land
+        exactly on the pre-reset ones (one fused fit per workload is the
+        COMMON case)."""
+        obs.enable()
+        obs.counter_add("train.fused_runs")
+        obs.fit_report("A", directory=str(tmp_path))
+        obs.reset()
+        obs.counter_add("train.fused_runs")  # same total as before: 1
+        obs.fit_report("B", directory=str(tmp_path))
+        _, b = obs.load_reports(str(tmp_path))
+        assert b["metrics"]["counters"]["train.fused_runs"] == 1
+
+    def test_fit_report_noop_when_disabled(self, tmp_path):
+        assert obs.fit_report("X", directory=str(tmp_path)) is None
+        assert obs.load_reports(str(tmp_path)) == []
+
+    def test_bench_report_records_the_record(self, tmp_path):
+        obs.enable()
+        obs.bench_report(
+            {"metric": "m1", "value": 10.0, "unit": "rows/sec",
+             "shape": "tiny"},
+            directory=str(tmp_path),
+        )
+        (r,) = obs.load_reports(str(tmp_path))
+        assert r["kind"] == "bench"
+        assert r["name"] == "m1"
+        assert r["extra"]["value"] == 10.0
+
+    def test_tiny_fit_emits_parseable_report(self, tmp_path, monkeypatch):
+        """The CI smoke contract: a fit with obs enabled writes one JSONL
+        line carrying the registry snapshot with the dispatch/sync split
+        and the program-build counter."""
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.table.schema import DataTypes, Schema
+        from flink_ml_tpu.table.table import Table
+
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        obs.enable()
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        t = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR),
+                      ("label", "double")),
+            {"features": X, "label": y},
+        )
+        model = (LogisticRegression().set_vector_col("features")
+                 .set_label_col("label").set_prediction_col("p")
+                 .set_max_iter(3).fit(t))
+        assert model.train_epochs_ >= 1
+        reports = obs.load_reports()
+        fits = [r for r in reports if r["kind"] == "fit"]
+        assert fits, "fit wrote no RunReport"
+        r = fits[-1]
+        assert r["name"] == "LogisticRegression"
+        counters = r["metrics"]["counters"]
+        assert counters.get("train.fused_runs", 0) >= 1
+        assert counters.get("train.epochs", 0) >= 1
+        timings = r["metrics"]["timings"]
+        assert "train.dispatch" in timings and "train.sync" in timings
+        assert r["step_summary"] is not None
+
+
+def _baseline(tmp_path, measured):
+    p = tmp_path / "BASELINE.json"
+    p.write_text(json.dumps({"measured": measured}))
+    return str(p)
+
+
+def _reports(tmp_path, records):
+    obs.enable()
+    d = tmp_path / "reports"
+    for rec in records:
+        obs.bench_report(rec, directory=str(d))
+    return str(d)
+
+
+class TestBaselineDiff:
+    def test_regression_improved_ok_and_missing(self, tmp_path):
+        import jax
+
+        backend = jax.default_backend()
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 80.0, "unit": "rows/sec"},
+            {"metric": "b", "value": 100.0, "unit": "rows/sec"},
+            {"metric": "c", "value": 130.0, "unit": "rows/sec"},
+        ])
+        rows = diff_against_baseline(
+            obs.load_reports(d),
+            {"measured": {
+                "a": {"value": 100.0, "unit": "rows/sec", "backend": backend},
+                "b": {"value": 100.0, "unit": "rows/sec", "backend": backend},
+                "c": {"value": 100.0, "unit": "rows/sec", "backend": backend},
+                "d": {"value": 1.0, "unit": "rows/sec", "backend": backend},
+            }},
+        )
+        status = {r["metric"]: r["status"] for r in rows}
+        assert status == {"a": "regression", "b": "ok", "c": "improved",
+                          "d": "no-report"}
+
+    def test_zero_throughput_is_a_regression_not_no_value(self, tmp_path):
+        import jax
+
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 0.0, "unit": "rows/sec"},
+        ])
+        (row,) = diff_against_baseline(
+            obs.load_reports(d),
+            {"measured": {"a": {"value": 100.0, "unit": "rows/sec",
+                                "backend": jax.default_backend()}}},
+        )
+        # a collapse to zero is the worst regression; it must not slip
+        # through the --check gate as "no-value"
+        assert row["status"] == "regression" and row["ratio"] == 0.0
+
+    def test_backend_scoping_skips_foreign_measurements(self, tmp_path):
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 1.0, "unit": "rows/sec"},
+        ])
+        (row,) = diff_against_baseline(
+            obs.load_reports(d),
+            {"measured": {"a": {"value": 1e9, "unit": "rows/sec",
+                                "backend": "tpu"}}},
+        )
+        # a CPU-backend run never diffs against a TPU baseline
+        assert row["status"] == "backend-mismatch"
+
+    def test_latest_report_wins(self, tmp_path):
+        import jax
+
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 10.0, "unit": "rows/sec"},
+            {"metric": "a", "value": 100.0, "unit": "rows/sec"},
+        ])
+        (row,) = diff_against_baseline(
+            obs.load_reports(d),
+            {"measured": {"a": {"value": 100.0, "unit": "rows/sec",
+                                "backend": jax.default_backend()}}},
+        )
+        assert row["status"] == "ok" and row["latest"] == 100.0
+
+    def test_cli_check_exit_codes(self, tmp_path, capsys):
+        import jax
+
+        backend = jax.default_backend()
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 50.0, "unit": "rows/sec"},
+        ])
+        base_bad = _baseline(
+            tmp_path, {"a": {"value": 100.0, "unit": "rows/sec",
+                             "backend": backend}}
+        )
+        assert report_main(["--reports", d, "--baseline", base_bad,
+                            "--check"]) == 1
+        assert "regression" in capsys.readouterr().out
+        # within the band -> exit 0
+        base_ok = str(tmp_path / "ok.json")
+        with open(base_ok, "w") as f:
+            json.dump({"measured": {"a": {"value": 52.0, "unit": "rows/sec",
+                                          "backend": backend}}}, f)
+        assert report_main(["--reports", d, "--baseline", base_ok,
+                            "--check"]) == 0
+
+    def test_cli_check_fails_when_nothing_comparable(self, tmp_path, capsys):
+        # baselines exist but no report matches (renamed metric / backend
+        # drift): the gate must fail loudly, not stay green on nothing
+        d = _reports(tmp_path, [
+            {"metric": "renamed", "value": 5.0, "unit": "rows/sec"},
+        ])
+        base = _baseline(
+            tmp_path, {"old-name": {"value": 5.0, "unit": "rows/sec",
+                                    "backend": "cpu"}}
+        )
+        assert report_main(["--reports", d, "--baseline", base,
+                            "--check"]) == 1
+        assert "none were comparable" in capsys.readouterr().out
+        # without --check it stays informational
+        assert report_main(["--reports", d, "--baseline", base]) == 0
+
+    def test_cli_empty_baseline_is_not_an_error(self, tmp_path, capsys):
+        base = _baseline(tmp_path, {})
+        assert report_main(["--reports", str(tmp_path), "--baseline",
+                            base, "--check"]) == 0
+        assert "nothing to diff" in capsys.readouterr().out
+
+
+class TestHotPathWiring:
+    def test_chunked_table_counts_parsed_chunks(self, tmp_path):
+        from flink_ml_tpu.table.schema import DataTypes, Schema
+        from flink_ml_tpu.table.sources import ChunkedTable, CsvSource
+
+        p = tmp_path / "t.csv"
+        p.write_text("".join(f"{i},{i % 2}\n" for i in range(10)))
+        schema = Schema.of(("x", DataTypes.DOUBLE), ("label", "double"))
+        chunked = ChunkedTable(CsvSource(str(p), schema), chunk_rows=4)
+        list(chunked.chunks())  # disabled: no counts
+        assert obs.registry().counter("source.chunks_parsed") == 0
+        obs.enable()
+        n = sum(t.num_rows() for t in chunked.chunks())
+        assert n == 10
+        assert obs.registry().counter("source.chunks_parsed") == 3
+        assert obs.registry().counter("source.rows_parsed") == 10
+
+    def test_pack_phase_recorded(self):
+        from flink_ml_tpu.lib.common import pack_minibatches
+
+        obs.enable()
+        X = np.zeros((16, 3), dtype=np.float32)
+        y = np.zeros((16,), dtype=np.float64)
+        pack_minibatches(X, y, 1, 8)
+        snap = obs.registry().snapshot()
+        assert snap["timings"]["phase.pack_dense"]["count"] == 1

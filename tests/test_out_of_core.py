@@ -819,3 +819,59 @@ class TestChunkSpillCache:
         # init reservoir pass records; first Lloyd epoch replays binary;
         # steady epochs read the packed spill
         assert source.chunk_reads == 1
+
+
+class TestChunkSpillCacheInterleaving:
+    """ADVICE r5 low: an abandoned partial recording generator resumed
+    after (or interleaved with) a second chunks() pass must never splice
+    its descriptors into the other pass's replay sequence — descriptors
+    publish atomically on exhaustion."""
+
+    def _cached(self, tmp_path, n=900):
+        from flink_ml_tpu.table.sources import ChunkSpillCache
+
+        table, vectors, labels, dim = sparse_data(n=n, dim=120, nnz=4)
+        path = tmp_path / "i.svm"
+        with open(path, "w") as f:
+            for label, v in zip(labels, vectors):
+                feats = " ".join(
+                    f"{int(i) + 1}:{val:.17g}"
+                    for i, val in zip(v.indices, v.vals)
+                )
+                f.write(f"{label:g} {feats}\n")
+        source = _ParseCountingSource(LibSvmSource(str(path), n_features=dim))
+        chunked = ChunkedTable(source, chunk_rows=300, spill=True)
+        return ChunkSpillCache(chunked, str(tmp_path / "cache")), source
+
+    def test_interleaved_passes_replay_coherently(self, tmp_path):
+        cached, source = self._cached(tmp_path)
+        it1 = cached.chunks()  # recording pass 1 ...
+        first1 = next(it1)
+        it2 = cached.chunks()  # ... interleaved with recording pass 2
+        chunks2 = [np.asarray(t.col("label")).copy() for t in it2]
+        rest1 = [np.asarray(t.col("label")).copy() for t in it1]
+        assert len(chunks2) == 3
+        assert 1 + len(rest1) == 3
+        # both passes parsed text (neither replay); the cache holds ONE
+        # coherent pass, never a splice of the two
+        replay = [np.asarray(t.col("label")) for t in cached.chunks()]
+        assert len(replay) == 3
+        for got, want in zip(replay, chunks2):
+            np.testing.assert_array_equal(got, want)
+        assert source.chunk_reads == 2  # the replay pass read no text
+
+    def test_abandoned_partial_pass_does_not_publish(self, tmp_path):
+        cached, source = self._cached(tmp_path)
+        it = cached.chunks()
+        next(it)  # partial: one chunk consumed, generator dropped
+        close = getattr(it, "close", None)
+        if close:
+            close()
+        assert not cached._complete
+        assert cached._chunks == []  # nothing published by the partial pass
+        full = [np.asarray(t.col("label")).copy() for t in cached.chunks()]
+        assert cached._complete
+        replay = [np.asarray(t.col("label")) for t in cached.chunks()]
+        for got, want in zip(replay, full):
+            np.testing.assert_array_equal(got, want)
+        assert source.chunk_reads == 2
