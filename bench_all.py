@@ -1179,6 +1179,97 @@ def bench_sparse_ooc(n_rows=100_000, dim=1_000_000, nnz=39, epochs=10,
     })
 
 
+def bench_warm_fit(n_rows=200_000, n_features=28, epochs=5, batch=16384):
+    """Repeated-fit sweep over ONE table (ISSUE 2): cold vs warm call
+    latency and slab-pool hit counts.
+
+    Three fits of the same table — fit 1 cold (pack + place + compile),
+    fit 2 warm at the same learning rate (slab pool + program cache hits),
+    fit 3 at a VARIED learning rate (new compiled program, but the placed
+    batch still comes from the pool — the hyperparameter-sweep shape the
+    pool exists for).  An uncached fit (``FMT_SLAB_POOL=0`` semantics via a
+    cleared pool + fresh table) provides the AUC-parity reference.
+
+    The emitted ``warm_over_cold`` ratio (fit 2 wall / fit 1 wall, lower is
+    better) is the machine-robust number BASELINE.json gates: a broken pool
+    drags it toward 1.0 regardless of host speed.
+    """
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.table import slab_pool
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(n_rows, n_features).astype(np.float32)
+    true_w = (rng.randn(n_features) / np.sqrt(n_features)).astype(np.float32)
+    y = ((X @ true_w + 0.17 * rng.randn(n_rows).astype(np.float32)) > 0
+         ).astype(np.float32)
+    n_train = int(0.8 * n_rows)
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                       ("label", "double"))
+    t = Table.from_columns(
+        schema, {"features": X[:n_train], "label": y[:n_train]}
+    )
+
+    def fit(table, lr):
+        t0 = time.perf_counter()
+        model = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(lr).set_global_batch_size(batch)
+            .set_max_iter(epochs).fit(table)
+        )
+        return model, time.perf_counter() - t0
+
+    # a genuinely cold first fit: empty pool, and an lr no earlier workload
+    # in this process has compiled (the epoch-step cache keys on lr)
+    slab_pool.reset_pool()
+    pool = slab_pool.pool()
+    lrs = [0.517, 0.517, 0.2585]  # fit 3 varies the rate (sweep shape)
+    walls, models, fit_hits = [], [], []
+    for lr in lrs:
+        h0 = pool.hits
+        model, wall = fit(t, lr)
+        walls.append(wall)
+        models.append(model)
+        fit_hits.append(pool.hits - h0)
+    cold_ms, warm_ms, sweep_ms = (w * 1e3 for w in walls)
+
+    # uncached reference: fresh pool AND fresh (content-distinct) table —
+    # the full pack+place path, for AUC parity vs the pooled fits
+    slab_pool.reset_pool()
+    t_fresh = Table.from_columns(
+        schema, {"features": X[:n_train].copy(), "label": y[:n_train].copy()}
+    )
+    uncached_model, uncached_wall = fit(t_fresh, lrs[1])
+    slab_pool.reset_pool()
+
+    Xq, yq = X[n_train:], y[n_train:]
+    qt = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR)), {"features": Xq}
+    )
+    auc_warm = _auc(yq, models[1].predict_proba(qt))
+    auc_uncached = _auc(yq, uncached_model.predict_proba(qt))
+    return _emit({
+        "metric": "LogisticRegression.repeated_fit warm_over_cold",
+        "value": round(walls[1] / walls[0], 4),
+        "unit": "ratio (lower is better)",
+        "cold_fit_ms": round(cold_ms, 1),
+        "warm_fit_ms": round(warm_ms, 1),
+        "sweep_fit_ms": round(sweep_ms, 1),  # varied lr: pool hit, recompile
+        "uncached_fit_ms": round(uncached_wall * 1e3, 1),
+        "pool_hits_per_fit": fit_hits,
+        "pool_hits": pool.hits, "pool_misses": pool.misses,
+        "pool_evictions": pool.evictions,
+        "warm_hits_pool": bool(fit_hits[1] > 0 and fit_hits[2] > 0),
+        "auc_warm": round(auc_warm, 4),
+        "auc_uncached": round(auc_uncached, 4),
+        "auc_parity": bool(abs(auc_warm - auc_uncached) < 1e-6),
+        "shape": f"{n_train}x{n_features} f32 batch={batch} epochs={epochs} "
+                 f"x3 fits (lr varied on fit 3)",
+    })
+
+
 def bench_sparse_file(n_rows, dim, nnz):
     """Create (once) the synthetic Criteo-shaped LibSVM file."""
     rng = np.random.RandomState(5)
@@ -1209,6 +1300,7 @@ WORKLOADS = {
     "sparse_scale": bench_sparse_scale,
     "sparse_ooc": bench_sparse_ooc,
     "pipeline": bench_pipeline,
+    "warmfit": bench_warm_fit,
 }
 
 

@@ -68,6 +68,12 @@ class Mapper:
 
     def apply(self, table: Table, batch_size: Optional[int] = None) -> Table:
         """Map a whole table, batch by batch, and merge columns."""
+        from flink_ml_tpu.table import slab_pool
+
+        # reap GC-queued dead slab-pool entries (O(queued), usually a
+        # no-op): a serve-only process whose training tables were dropped
+        # must not pin their device slabs until the next fit
+        slab_pool.pool().reap()
         obs.counter_add("inference.rows", table.num_rows())
         if batch_size is None or table.num_rows() <= batch_size:
             with obs.phase("inference.map_batch"):

@@ -297,6 +297,13 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         )
 
     def fit(self, *inputs) -> KMeansModel:
+        import time as _time
+
+        from flink_ml_tpu.table import slab_pool
+
+        self._fit_pool_stats0 = (
+            *slab_pool.pool().counters(), _time.perf_counter()
+        )
         (table,) = inputs
         if getattr(table, "is_chunked", False):
             return self._fit_out_of_core(table)
@@ -313,7 +320,6 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             agree_max,
             agree_sum,
             local_data_parallel_size,
-            shard_batch,
         )
 
         n_global = int(agree_sum(np.asarray([n]))[0]) if n_proc > 1 else n
@@ -371,10 +377,21 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
                       rows_per_shard)
         Xp, wp = table.cached_pack(layout_key, build)
         # a thunk: a no-op resume (finished snapshot) must not pay the
-        # host->device transfer, so placement resolves lazily downstream
-        device_batch = lambda: table.cached_pack(  # noqa: E731
-            layout_key + ("dev", mesh),
-            lambda: shard_batch(mesh, (Xp, wp)),
+        # host->device transfer, so placement resolves lazily downstream;
+        # the placement itself rides the cross-fit slab pool (re-fitting
+        # the same table content skips the transfer) and double-buffers
+        # the H2D hop
+        from flink_ml_tpu.parallel.mesh import shard_batch_prefetched
+        from flink_ml_tpu.table import slab_pool
+
+        kmeans_cols = (
+            [self.get_vector_col()] if self.get_vector_col() is not None
+            else list(self.get_feature_cols() or ())
+        )
+        device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
+            table, layout_key + ("dev",), mesh,
+            lambda: shard_batch_prefetched(mesh, (Xp, wp)),
+            cols=kmeans_cols or None,
         )
 
         result = train_kmeans(
@@ -386,6 +403,8 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         return self._finish(result, k)
 
     def _finish(self, result, k: int) -> KMeansModel:
+        from flink_ml_tpu.lib.common import fit_pool_extra
+
         centroids = np.asarray(result.params, dtype=np.float64)
         model_table = Table.from_rows(
             [(int(i), DenseVector(centroids[i])) for i in range(k)],
@@ -401,7 +420,7 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             type(self).__name__,
             step_metrics=result.metrics,
             extra={"epochs": result.epochs, "cost": model.train_cost_,
-                   "k": int(k)},
+                   "k": int(k), **fit_pool_extra(self, result)},
         )
         return model
 

@@ -26,6 +26,7 @@ from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
     apply_sharded,
+    fit_pool_extra,
     pack_minibatches,
     pack_sparse_minibatches,
     resolve_features,
@@ -181,7 +182,24 @@ class LinearScoreMapper(ModelMapper):
             )
             return np.asarray(_sparse_score_fn(padded, self._w, self._b))[:n]
         X, _ = resolve_features(batch, model, dim=int(self._w.shape[0]))
-        return apply_sharded(_score_apply, X.astype(np.float32), self._w, self._b)
+        # asarray, not astype: a matrix-backed f32 column passes through
+        # zero-copy, so the slab pool sees a STABLE buffer and re-scoring
+        # the same table reuses the placed padded batch.  Pool ONLY that
+        # case — a freshly materialized buffer (f64 column, object rows,
+        # featureCols matrix) gets a new identity every batch, so pooling
+        # it would be pure tokenize+insert overhead with zero possible hits
+        X = np.asarray(X, dtype=np.float32)
+        col = (
+            batch.col(vector_col) if vector_col is not None
+            and batch.schema.contains(vector_col) else None
+        )
+        pool_key = (
+            ("linear_scores", vector_col, int(self._w.shape[0]))
+            if X is col else None
+        )
+        return apply_sharded(
+            _score_apply, X, self._w, self._b, pool_key=pool_key
+        )
 
 
 class GlmEstimatorBase(Estimator, GlmTrainParams):
@@ -211,6 +229,17 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
     LOSS_KIND: str = ""
 
     def fit(self, *inputs) -> GlmModelBase:
+        # scope the slab-pool stats + wall clock to THIS fit: _finish
+        # stamps the delta (hits/misses/hit rate/fit_wall_ms) into the
+        # RunReport so warm fits are self-identifying (the CI warm-path
+        # gate reads exactly this)
+        import time as _time
+
+        from flink_ml_tpu.table import slab_pool
+
+        self._fit_pool_stats0 = (
+            *slab_pool.pool().counters(), _time.perf_counter()
+        )
         (table,) = inputs
         if getattr(table, "is_chunked", False):
             return self._fit_out_of_core(table)
@@ -245,6 +274,13 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         X, dim = resolve_features(table, self)
         layout_key = ("dense", vector_col, tuple(self.get_feature_cols() or ()),
                       self.get_label_col(), n_dev, batch_share)
+        # the columns this layout READS — pool tokens scope to them, so a
+        # select()/with_column() re-wrap sharing these buffers still hits
+        layout_cols = (
+            [vector_col] if vector_col is not None
+            else list(self.get_feature_cols() or ())
+        ) + [self.get_label_col()]
+        self._layout_cols = layout_cols
         stack = table.cached_pack(
             layout_key,
             lambda: pack_minibatches(X, y, n_dev, batch_share),
@@ -253,21 +289,25 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             # wide-dense story: weight vector + feature columns shard over
             # the 'model' axis (train_glm_dense_2d) instead of replicating
             return self._fit_dense_2d(stack, mesh, layout_key, dim, table)
-        # device residency cache: re-fits of the same table (sweeps, benches)
-        # skip the host->device hop — the analog of the CPU path's data
-        # already sitting in RAM.  Keyed by mesh: a different mesh is a
-        # different placement.  Only the fused path consumes this layout;
-        # the checkpointed path shards (x, y, w) itself, so placing the
-        # combined view there would transfer the dataset twice.
+        # device residency: re-fits of the same table CONTENT (sweeps,
+        # benches, a re-wrapped Table over the same buffers) skip the
+        # host->device hop via the process-wide slab pool — the analog of
+        # the CPU path's data already sitting in RAM.  Keyed by mesh: a
+        # different mesh is a different placement.  Only the fused path
+        # consumes this layout; the checkpointed path shards (x, y, w)
+        # itself, so placing the combined view there would transfer the
+        # dataset twice.
         checkpoint = self._checkpoint_config()
         device_batch = None
         if checkpoint is None:
             from flink_ml_tpu.lib.common import _combined_view
-            from flink_ml_tpu.parallel.mesh import shard_batch
+            from flink_ml_tpu.parallel.mesh import shard_batch_prefetched
+            from flink_ml_tpu.table import slab_pool
 
-            device_batch = table.cached_pack(
-                layout_key + ("dev", mesh),
-                lambda: shard_batch(mesh, _combined_view(stack)),
+            device_batch = slab_pool.get_or_place(
+                table, layout_key + ("dev",), mesh,
+                lambda: shard_batch_prefetched(mesh, _combined_view(stack)),
+                cols=layout_cols,
             )
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
@@ -298,12 +338,15 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             place_dense_2d_batch,
             train_glm_dense_2d,
         )
+        from flink_ml_tpu.table import slab_pool
+
         model_size = dict(mesh.shape)["model"]
         _, _, dim_pad = make_feature_shard_placer(mesh, dim, model_size)
         # thunk: resolved lazily so a no-op checkpoint resume skips the hop
-        device_batch = lambda: table.cached_pack(  # noqa: E731
-            layout_key + ("dev2d", mesh),
+        device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
+            table, layout_key + ("dev2d",), mesh,
             lambda: place_dense_2d_batch(mesh, stack, dim_pad),
+            cols=getattr(self, "_layout_cols", None),
         )
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -387,16 +430,21 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
                 f"steps={steps}) but the pack chose "
                 f"({sstack.nnz_pad}, {sstack.steps})"
             )
-        from flink_ml_tpu.parallel.mesh import shard_batch
+        from flink_ml_tpu.parallel.mesh import shard_batch_prefetched
+        from flink_ml_tpu.table import slab_pool
 
         hot_k = int(self.get_num_hot_features() or 0)
         if hot_k > 0:
             return self._fit_sparse_hotcold(table, mesh, layout_key, sstack,
                                             hot_k)
         # thunk: resolved lazily so a no-op checkpoint resume skips the hop
-        device_batch = lambda: table.cached_pack(  # noqa: E731
-            layout_key + ("dev", mesh),
-            lambda: shard_batch(mesh, (sstack.ints, sstack.floats)),
+        sparse_cols = [self.get_vector_col(), self.get_label_col()]
+        device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
+            table, layout_key + ("dev",), mesh,
+            lambda: shard_batch_prefetched(
+                mesh, (sstack.ints, sstack.floats)
+            ),
+            cols=sparse_cols,
         )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -499,17 +547,25 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         # the agreed decision, visible in every RunReport: 1.0 = resident
         # slabs, 0.0 = in-program densify (stream)
         obs.gauge_set("train.hot_slab_resident", float(resident))
+        from flink_ml_tpu.table import slab_pool
+
+        hot_cols = [self.get_vector_col(), self.get_label_col()]
         if resident:
-            device_batch = lambda: table.cached_pack(  # noqa: E731
-                layout_key + ("hotdev", hot_k, mesh),
+            # the pool's multi-process hit agreement matters HERE: the
+            # resident builder dispatches the densify device program, which
+            # every process must enter together
+            device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
+                table, layout_key + ("hotdev", hot_k), mesh,
                 lambda: hotcold_device_batch(mesh, hstack()),
+                cols=hot_cols,
             )
         else:
             from flink_ml_tpu.lib.common import hotcold_entries_device_batch
 
-            device_batch = lambda: table.cached_pack(  # noqa: E731
-                layout_key + ("hotdev-stream", hot_k, mesh),
+            device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
+                table, layout_key + ("hotdev-stream", hot_k), mesh,
                 lambda: hotcold_entries_device_batch(mesh, hstack()),
+                cols=hot_cols,
             )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
@@ -930,6 +986,7 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             extra={
                 "epochs": result.epochs,
                 "loss": result.losses[-1] if result.losses else None,
+                **fit_pool_extra(self, result),
             },
         )
         return model

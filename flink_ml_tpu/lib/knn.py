@@ -257,22 +257,46 @@ class KnnModelMapper(ModelMapper):
         local = -(-max(X.shape[0], 1) // shards)
         chunk = min(8192, max(256, 1 << int(np.ceil(np.log2(local)))))
         n_pad = shards * (-(-local // chunk) * chunk)
-        Xp = np.zeros((n_pad, X.shape[1]), dtype=np.float32)
-        Xp[: X.shape[0]] = X
-        # inf marks padding (never wins top-k); f32 holds class ids exactly
-        yp = np.full((n_pad,), np.inf, dtype=np.float32)
-        yp[: y.shape[0]] = y_ids
-        if self._sharded:
-            # direct local placement (not shard_batch, whose multi-process
-            # branch assembles GLOBAL batches): the inference mesh is fully
-            # addressable by this process in every configuration
-            from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self._xt = jax.device_put(Xp, NamedSharding(mesh, P("data")))
-            self._yt = jax.device_put(yp, NamedSharding(mesh, P("data")))
+        def place_model():
+            Xp = np.zeros((n_pad, X.shape[1]), dtype=np.float32)
+            Xp[: X.shape[0]] = X
+            # inf marks padding (never wins top-k); f32 holds class ids
+            # exactly
+            yp = np.full((n_pad,), np.inf, dtype=np.float32)
+            yp[: y.shape[0]] = y_ids
+            if self._sharded:
+                # direct local placement (not shard_batch, whose
+                # multi-process branch assembles GLOBAL batches): the
+                # inference mesh is fully addressable by this process in
+                # every configuration
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                return (
+                    jax.device_put(Xp, NamedSharding(mesh, P("data"))),
+                    jax.device_put(yp, NamedSharding(mesh, P("data"))),
+                )
+            return jnp.asarray(Xp), jnp.asarray(yp)
+
+        # the placed reference set IS the model; for Knn that is the whole
+        # training table, so re-loading the same model content (a fresh
+        # mapper over the same model table) must hit the slab pool instead
+        # of re-transferring the training set
+        from flink_ml_tpu.table import slab_pool
+
+        if slab_pool.enabled():
+            refs: list = []
+            token = (slab_pool.array_token(X, refs),
+                     slab_pool.array_token(y, refs))
+            # agreed=False: model load happens on the process-LOCAL
+            # inference mesh with no cross-process collectives — the pool
+            # must not add one
+            self._xt, self._yt = slab_pool.pool().get_or_build(
+                ("knn-model", mesh, self._sharded, chunk, n_pad, token),
+                place_model, refs=refs, agreed=False,
+            )
         else:
-            self._xt = jnp.asarray(Xp)
-            self._yt = jnp.asarray(yp)
+            self._xt, self._yt = place_model()
         self._chunk = chunk
 
     def map_batch(self, batch: Table):

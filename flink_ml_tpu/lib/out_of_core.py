@@ -36,8 +36,6 @@ from __future__ import annotations
 
 import contextlib
 import os
-import queue
-import threading
 import warnings
 from collections import deque
 from typing import Callable, Iterator, Optional, Tuple
@@ -185,39 +183,15 @@ def _prefetch(items: Iterator, depth: int = 2) -> Iterator:
 
     The producer packs a block and places it on the mesh (an async DMA), so
     host parse + pack + transfer of block N+1 overlap device compute of
-    block N.  Exceptions re-raise at the consumer."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    done = object()
-    failure: list = []
+    block N.  Exceptions re-raise at the consumer; when the consumer
+    abandons the stream early, the producer thread is joined and a recorded
+    producer exception surfaces as a RuntimeWarning instead of being
+    silently dropped with the queue (the ONE shared implementation lives in
+    :func:`flink_ml_tpu.utils.prefetch.prefetch_iter` — the slab pool's
+    double-buffered placement uses the same idiom)."""
+    from flink_ml_tpu.utils.prefetch import prefetch_iter
 
-    def work():
-        try:
-            for item in items:
-                q.put(item)
-        except BaseException as exc:  # noqa: BLE001 - re-raised at consumer
-            failure.append(exc)
-        finally:
-            q.put(done)
-
-    thread = threading.Thread(target=work, daemon=True, name="oo-prefetch")
-    thread.start()
-    try:
-        while True:
-            item = q.get()
-            if item is done:
-                if failure:
-                    raise failure[0]
-                return
-            yield item
-    finally:
-        # consumer abandoned mid-stream (error/converged): drain so the
-        # producer's blocked put() releases and the thread exits
-        while thread.is_alive():
-            try:
-                if q.get(timeout=0.1) is done:
-                    break
-            except queue.Empty:
-                pass
+    return prefetch_iter(items, depth=depth, name="oo-prefetch")
 
 
 _serialized_chunks_warned = False

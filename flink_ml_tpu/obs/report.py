@@ -158,7 +158,11 @@ def _fit_delta_snapshot() -> dict:
         p = prev["timings"].get(k)
         dc = t["count"] - (p["count"] if p else 0)
         dt = t["total_s"] - (p["total_s"] if p else 0.0)
-        if dc < 0:
+        if dc < 0 or dt < 0:
+            # stale previous totals (an undetected reset/misattribution):
+            # fall back to raw totals — a physically impossible NEGATIVE
+            # duration must never reach a report (dc > 0 with dt < 0 slips
+            # the count guard alone; see the r5 line 23 artifact)
             dc, dt = t["count"], t["total_s"]
         if dc > 0:
             timings[k] = {
@@ -185,7 +189,8 @@ def _build_report(kind: str, name: str, shape=None, step_metrics=None,
             # seconds into their StepMetrics records — surface the last
             # step's split at the top level so reports are greppable
             last = step_metrics.steps[-1] if step_metrics.steps else {}
-            for k in ("dispatch_seconds", "sync_seconds"):
+            for k in ("dispatch_seconds", "sync_seconds", "place_seconds",
+                      "call_latency_ms"):
                 if k in last:
                     summary[k] = last[k]
         except Exception:  # noqa: BLE001 - never fail a fit over telemetry
@@ -278,7 +283,12 @@ def diff_against_baseline(reports: List[dict], baseline: dict,
     a throughput metric (unit contains ``/sec``) dropped more than
     ``threshold`` relative to baseline, ``improved`` when it rose that
     much, ``ok`` within the band, ``no-report`` / ``backend-mismatch``
-    when not comparable."""
+    when not comparable.
+
+    A baseline entry may carry ``"direction": "lower"`` for
+    lower-is-better metrics (latencies, the warm-fit ``warm_over_cold``
+    ratio): there a RISE beyond ``threshold`` is the regression and a drop
+    the improvement — the warm-fit CI gate (ISSUE 2) rides this."""
     measured = baseline.get("measured", {})
     latest = latest_bench_by_name(reports)
     rows = []
@@ -310,8 +320,13 @@ def diff_against_baseline(reports: List[dict], baseline: dict,
             rows.append(row)
             continue
         ratio = float(value) / float(base_value)
+        lower_better = base.get("direction") == "lower"
         throughput = "/sec" in (unit or base.get("unit", ""))
-        if throughput and ratio < 1.0 - threshold:
+        if lower_better and ratio > 1.0 + threshold:
+            status = "regression"
+        elif lower_better and ratio < 1.0 - threshold:
+            status = "improved"
+        elif throughput and ratio < 1.0 - threshold:
             status = "regression"
         elif throughput and ratio > 1.0 + threshold:
             status = "improved"

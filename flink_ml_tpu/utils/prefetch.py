@@ -1,0 +1,81 @@
+"""Background-thread iterator prefetch — the ONE copy of the overlap idiom.
+
+Two consumers share it: the out-of-core chunk engine (host parse/pack/place
+of block N+1 overlapping device compute of block N,
+``lib/out_of_core.py``) and the slab pool's double-buffered placement
+(host slice prep of chunk N+1 overlapping the async H2D DMA of chunk N,
+``parallel/mesh.shard_batch_prefetched``).
+
+Contract:
+
+  * items flow through a bounded queue ``depth`` deep — host residency is
+    capped at ``depth`` in-flight items;
+  * a producer exception re-raises at the consumer, at the point in the
+    stream where it occurred;
+  * when the consumer ABANDONS the stream early (error, convergence, GC of
+    the generator), the drain releases any blocked ``put()``, the thread
+    is joined, and a producer exception recorded during the abandoned tail
+    is surfaced as a :class:`RuntimeWarning` — never silently discarded
+    (raising from a ``finally`` during ``GeneratorExit`` would mask the
+    consumer's own exception, so a warning is the loudest safe channel).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import warnings
+from typing import Iterator
+
+__all__ = ["prefetch_iter"]
+
+
+def prefetch_iter(items: Iterator, depth: int = 2,
+                  name: str = "prefetch") -> Iterator:
+    """Run an iterator on a background thread, ``depth`` items ahead."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    done = object()
+    failure: list = []
+
+    def work():
+        try:
+            for item in items:
+                q.put(item)
+        except BaseException as exc:  # noqa: BLE001 - re-raised at consumer
+            failure.append(exc)
+        finally:
+            q.put(done)
+
+    thread = threading.Thread(target=work, daemon=True, name=name)
+    thread.start()
+    surfaced = False
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                if failure:
+                    surfaced = True
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        # consumer abandoned mid-stream (error/converged/GC): drain so the
+        # producer's blocked put() releases and the thread can exit ...
+        while thread.is_alive():
+            try:
+                if q.get(timeout=0.1) is done:
+                    break
+            except queue.Empty:
+                pass
+        # ... then JOIN it (the drain loop can exit via ``done`` while the
+        # thread is still inside its finally) and surface any recorded
+        # producer exception instead of discarding it with the queue
+        thread.join(timeout=10.0)
+        if failure and not surfaced:
+            warnings.warn(
+                f"{name}: producer raised {failure[0]!r} after the "
+                "consumer abandoned the stream; the exception did not "
+                "reach any caller",
+                RuntimeWarning,
+                stacklevel=2,
+            )

@@ -248,6 +248,32 @@ class TestBaselineDiff:
         assert status == {"a": "regression", "b": "ok", "c": "improved",
                           "d": "no-report"}
 
+    def test_lower_is_better_direction_gates_latency_metrics(self, tmp_path):
+        """The warm-fit gate (ISSUE 2): a baseline entry with
+        direction='lower' flags a RISE as the regression — warm_over_cold
+        drifting toward 1.0 must fail --check even though no '/sec' unit
+        is involved."""
+        import jax
+
+        backend = jax.default_backend()
+        d = _reports(tmp_path, [
+            {"metric": "warm", "value": 0.7, "unit": "ratio"},
+            {"metric": "fast", "value": 0.2, "unit": "ratio"},
+            {"metric": "steady", "value": 0.52, "unit": "ratio"},
+        ])
+        base = {
+            "value": 0.5, "unit": "ratio", "direction": "lower",
+            "backend": backend,
+        }
+        rows = diff_against_baseline(
+            obs.load_reports(d),
+            {"measured": {"warm": dict(base), "fast": dict(base),
+                          "steady": dict(base)}},
+        )
+        status = {r["metric"]: r["status"] for r in rows}
+        assert status == {"warm": "regression", "fast": "improved",
+                          "steady": "ok"}
+
     def test_zero_throughput_is_a_regression_not_no_value(self, tmp_path):
         import jax
 

@@ -1,0 +1,462 @@
+"""Cross-fit device slab pool (ISSUE 2): content-identity keying, budgeted
+LRU eviction, pin-during-dispatch refcounting, double-buffered placement,
+and the warm-fit behavior of the estimator + inference paths."""
+
+import gc
+import warnings
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.parallel.mesh import (
+    default_mesh,
+    shard_batch,
+    shard_batch_prefetched,
+)
+from flink_ml_tpu.table import slab_pool
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    slab_pool.reset_pool()
+    yield
+    slab_pool.reset_pool()
+
+
+def _dense_table(X, y):
+    schema = Schema.of(
+        ("features", DataTypes.DENSE_VECTOR), ("label", "double")
+    )
+    return Table.from_columns(schema, {"features": X, "label": y})
+
+
+def _logreg(lr=0.5, epochs=5):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    return (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(lr).set_max_iter(epochs)
+    )
+
+
+class TestContentTokens:
+    def test_shared_buffers_share_tokens(self):
+        X = np.random.RandomState(0).randn(16, 3)
+        y = np.arange(16.0)
+        t1 = _dense_table(X, y)
+        t2 = _dense_table(X, y)  # new Table, SAME column buffers
+        tok1, _ = slab_pool.table_token(t1)
+        tok2, _ = slab_pool.table_token(t2)
+        assert tok1 == tok2
+
+    def test_in_place_mutation_changes_token(self):
+        """Tables are immutable by contract, but a zero-copy column shares
+        the caller's buffer: normalizing it in place and re-wrapping a
+        fresh Table must MISS (content canary), never serve the
+        pre-mutation slab."""
+        X = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+        y = np.arange(64.0)
+        tok1, _ = slab_pool.table_token(_dense_table(X, y))
+        X -= X.mean(axis=0)  # in-place: same buffer, new content
+        tok2, _ = slab_pool.table_token(_dense_table(X, y))
+        assert tok1 != tok2
+
+    def test_mutated_buffer_refits_correctly(self):
+        X = np.random.RandomState(1).randn(256, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        m1 = _logreg().fit(_dense_table(X, y))
+        X *= 3.0  # contract violation the canary must absorb
+        m2 = _logreg().fit(_dense_table(X, y))
+        m2_fresh = _logreg().fit(_dense_table(X.copy(), y))
+        np.testing.assert_array_equal(
+            m2.coefficients(), m2_fresh.coefficients()
+        )
+        assert not np.array_equal(m1.coefficients(), m2.coefficients())
+
+    def test_distinct_buffers_distinct_tokens(self):
+        X = np.random.RandomState(0).randn(16, 3)
+        y = np.arange(16.0)
+        tok1, _ = slab_pool.table_token(_dense_table(X, y))
+        tok2, _ = slab_pool.table_token(_dense_table(X.copy(), y))
+        assert tok1 != tok2
+
+    def test_dead_source_buffer_invalidates_entry(self):
+        pool = slab_pool.pool()
+        X = np.random.RandomState(0).randn(8, 2)
+        refs: list = []
+        key = ("t", slab_pool.array_token(X, refs))
+        built = []
+        pool.get_or_build(key, lambda: built.append(1) or "v", refs=refs)
+        assert pool.get_or_build(key, lambda: built.append(2) or "v2",
+                                 refs=refs) == "v"
+        del X
+        gc.collect()
+        # the guard died with the buffer: same key must rebuild, never
+        # resurrect a slab whose source identity was recycled
+        assert pool.get_or_build(key, lambda: built.append(3) or "v3",
+                                 refs=[]) == "v3"
+        assert built == [1, 3]
+
+
+class TestPoolMechanics:
+    def test_lru_eviction_under_budget(self):
+        pool = slab_pool.SlabPool(budget_bytes=100)
+        a = pool.get_or_build("a", lambda: np.zeros(10, np.float32))  # 40 B
+        pool.get_or_build("b", lambda: np.zeros(10, np.float32))
+        pool.get_or_build("a", lambda: np.zeros(10, np.float32))  # refresh a
+        pool.get_or_build("c", lambda: np.zeros(10, np.float32))  # evicts b
+        assert pool.evictions == 1
+        assert pool.get_or_build("a", lambda: "rebuilt") is a  # still hot
+        rebuilt = pool.get_or_build("b", lambda: np.ones(10, np.float32))
+        assert rebuilt[0] == 1.0  # b was the LRU victim
+
+    def test_pinned_entries_survive_eviction(self):
+        pool = slab_pool.SlabPool(budget_bytes=50)
+        v = pool.get_or_build("hot", lambda: np.zeros(10, np.float32))
+        with pool.pinned(v):
+            # both newcomers exceed the budget; the pinned slab must stay
+            pool.get_or_build("x", lambda: np.zeros(10, np.float32))
+            pool.get_or_build("y", lambda: np.zeros(10, np.float32))
+            assert pool.get_or_build("hot", lambda: "rebuilt") is v
+        assert pool.hits >= 1
+
+    def test_dead_entries_swept_on_next_put(self):
+        pool = slab_pool.SlabPool(budget_bytes=1 << 20)
+        X = np.zeros(100, np.float32)
+        refs: list = []
+        key = ("k1", slab_pool.array_token(X, refs))
+        pool.get_or_build(key, lambda: np.zeros(100, np.float32), refs=refs)
+        assert pool.bytes == 400
+        del X
+        gc.collect()
+        # a transient-source entry gets a unique key no lookup revisits;
+        # the next put's dead sweep must reclaim it anyway
+        pool.get_or_build("k2", lambda: np.zeros(10, np.float32))
+        assert pool.bytes == 40
+
+    def test_dead_entries_reaped_on_lookup_without_insert(self):
+        """A dropped table's slab must not wait for the NEXT INSERT to be
+        reclaimed: the weakref death callback queues the key, and any pool
+        access (a pure hit included) drains the queue."""
+        pool = slab_pool.SlabPool(budget_bytes=1 << 20)
+        keeper = pool.get_or_build("keeper", lambda: np.zeros(2, np.float32))
+        X = np.zeros(100, np.float32)
+        refs: list = []
+        key = ("k1", slab_pool.array_token(X, refs))
+        pool.get_or_build(key, lambda: np.zeros(100, np.float32), refs=refs)
+        assert pool.bytes == 408
+        del X
+        gc.collect()
+        assert pool.get_or_build("keeper", lambda: "rebuilt") is keeper
+        assert pool.bytes == 8  # dead slab reclaimed by the hit's drain
+
+    def test_disabled_pool_always_builds(self, monkeypatch):
+        monkeypatch.setenv("FMT_SLAB_POOL", "0")
+        pool = slab_pool.pool()
+        builds = []
+        pool.get_or_build("k", lambda: builds.append(1) or 1)
+        pool.get_or_build("k", lambda: builds.append(2) or 2)
+        assert builds == [1, 2]
+
+    def test_counters_land_in_obs_registry(self):
+        obs.enable()
+        obs.reset()
+        try:
+            pool = slab_pool.pool()
+            pool.get_or_build("k", lambda: np.zeros(4, np.float32))
+            pool.get_or_build("k", lambda: np.zeros(4, np.float32))
+            c = obs.registry().snapshot()["counters"]
+            assert c["slab_pool.misses"] == 1
+            assert c["slab_pool.hits"] == 1
+            assert c["slab_pool.bytes_placed"] == 16
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+class TestChunkedPlacement:
+    def test_matches_shard_batch(self):
+        mesh = default_mesh()
+        n_dev = mesh.shape["data"]
+        x = np.arange(n_dev * 24 * 5, dtype=np.float32).reshape(n_dev * 24, 5)
+        y = np.arange(n_dev * 24, dtype=np.float64)
+        ref = shard_batch(mesh, (x, y, np.float32(3.0)))
+        # chunk_bytes tiny + min_bytes 0 forces the double-buffered path
+        out = shard_batch_prefetched(
+            mesh, (x, y, np.float32(3.0)), chunk_bytes=256, min_bytes=0
+        )
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(o))
+            assert o.sharding == r.sharding
+
+    def test_small_leaves_take_direct_path(self):
+        mesh = default_mesh()
+        n_dev = mesh.shape["data"]
+        x = np.zeros((n_dev * 2, 3), np.float32)
+        out = shard_batch_prefetched(mesh, (x,))
+        np.testing.assert_array_equal(np.asarray(out[0]), x)
+
+
+class TestWarmFit:
+    def _data(self, n=512, d=6, seed=3):
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, d).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        return X, y
+
+    def test_second_fit_hits_pool_and_matches(self):
+        X, y = self._data()
+        t = _dense_table(X, y)
+        m1 = _logreg().fit(t)
+        pool = slab_pool.pool()
+        misses0 = pool.misses
+        m2 = _logreg().fit(t)
+        assert pool.hits >= 1 and pool.misses == misses0
+        np.testing.assert_array_equal(m1.coefficients(), m2.coefficients())
+        assert m1.intercept() == m2.intercept()
+
+    def test_content_identity_crosses_table_instances(self):
+        X, y = self._data()
+        m1 = _logreg().fit(_dense_table(X, y))
+        pool = slab_pool.pool()
+        misses0 = pool.misses
+        m2 = _logreg().fit(_dense_table(X, y))  # fresh Table, same buffers
+        assert pool.hits >= 1 and pool.misses == misses0
+        np.testing.assert_array_equal(m1.coefficients(), m2.coefficients())
+
+    def test_rewrapped_table_with_extra_column_still_hits(self):
+        """Pool tokens scope to the columns the layout reads: adding an
+        unrelated column (or selecting a subset) while sharing the
+        feature/label buffers must still hit."""
+        X, y = self._data()
+        t = _dense_table(X, y)
+        m1 = _logreg().fit(t)
+        pool = slab_pool.pool()
+        misses0 = pool.misses
+        t2 = t.with_column("weight", "double", np.ones(len(t)))
+        m2 = _logreg().fit(t2)
+        assert pool.hits >= 1 and pool.misses == misses0
+        np.testing.assert_array_equal(m1.coefficients(), m2.coefficients())
+
+    def test_varied_learning_rate_still_hits_slab(self):
+        X, y = self._data()
+        t = _dense_table(X, y)
+        _logreg(lr=0.5).fit(t)
+        pool = slab_pool.pool()
+        misses0 = pool.misses
+        _logreg(lr=0.25).fit(t)  # new program, SAME placed batch
+        assert pool.hits >= 1 and pool.misses == misses0
+
+    def test_uncached_path_parity(self, monkeypatch):
+        X, y = self._data()
+        t = _dense_table(X, y)
+        warm1 = _logreg().fit(t)
+        warm2 = _logreg().fit(t)  # pool-hit fit
+        monkeypatch.setenv("FMT_SLAB_POOL", "0")
+        cold = _logreg().fit(_dense_table(X.copy(), y.copy()))
+        np.testing.assert_array_equal(
+            warm2.coefficients(), cold.coefficients()
+        )
+        np.testing.assert_array_equal(
+            warm1.coefficients(), warm2.coefficients()
+        )
+
+    def test_sparse_fit_hits_pool(self):
+        from flink_ml_tpu.ops.vector import SparseVector
+
+        rng = np.random.RandomState(7)
+        rows = [
+            SparseVector(32, np.sort(rng.choice(32, 3, replace=False)),
+                         rng.randn(3))
+            for _ in range(256)
+        ]
+        y = rng.randint(0, 2, 256).astype(np.float64)
+        schema = Schema.of(
+            ("features", DataTypes.SPARSE_VECTOR), ("label", "double")
+        )
+        t = Table.from_columns(schema, {"features": rows, "label": y})
+        m1 = _logreg().set_num_features(32).fit(t)
+        pool = slab_pool.pool()
+        misses0 = pool.misses
+        m2 = _logreg().set_num_features(32).fit(t)
+        assert pool.hits >= 1 and pool.misses == misses0
+        np.testing.assert_array_equal(m1.coefficients(), m2.coefficients())
+
+    def test_fit_report_carries_pool_delta_and_latency(self, tmp_path,
+                                                       monkeypatch):
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        obs.enable()
+        obs.reset()
+        try:
+            X, y = self._data()
+            t = _dense_table(X, y)
+            _logreg().fit(t)
+            _logreg().fit(t)
+            fits = [r for r in obs.load_reports() if r["kind"] == "fit"]
+            cold, warm = fits[-2]["extra"], fits[-1]["extra"]
+            assert cold["slab_pool_misses"] >= 1
+            assert warm["slab_pool_hits"] >= 1
+            assert warm["slab_pool_misses"] == 0
+            assert warm["slab_pool_hit_rate"] == 1.0
+            assert warm["call_latency_ms"] > 0
+            assert "call_latency_ms" in fits[-1]["step_summary"]
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+class TestDonationAliasing:
+    """Satellite: lock in the jnp.copy guard (lib/common.py) — donated
+    params must never free a caller's pre-placed arrays or a pooled slab."""
+
+    def _stack_and_grads(self):
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.lib.classification import _log_loss_grads
+        from flink_ml_tpu.lib.common import pack_minibatches
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        mesh = MLEnvironmentFactory.get_default().get_mesh()
+        rng = np.random.RandomState(5)
+        X = rng.randn(256, 4).astype(np.float32)
+        y = (X[:, 1] > 0).astype(np.float32)
+        stack = pack_minibatches(X, y, data_parallel_size(mesh))
+        w0 = jnp.zeros((4,), jnp.float32)
+        b0 = jnp.zeros((), jnp.float32)
+        return mesh, stack, _log_loss_grads(True), (w0, b0)
+
+    def test_two_fits_from_same_preplaced_params(self):
+        from flink_ml_tpu.lib.common import train_glm
+        from flink_ml_tpu.parallel.mesh import replicate
+
+        mesh, stack, grad_fn, params = self._stack_and_grads()
+        placed = replicate(mesh, params)
+        r1 = train_glm(placed, stack, grad_fn, mesh,
+                       learning_rate=0.5, max_iter=4)
+        # the donated program must have trained on COPIES: the caller's
+        # placed arrays are still alive and still zero
+        np.testing.assert_array_equal(np.asarray(placed[0]), np.zeros(4))
+        r2 = train_glm(placed, stack, grad_fn, mesh,
+                       learning_rate=0.5, max_iter=4)
+        np.testing.assert_array_equal(r1.params[0], r2.params[0])
+        np.testing.assert_array_equal(
+            np.asarray(r1.params[1]), np.asarray(r2.params[1])
+        )
+
+    def test_two_fits_from_same_pooled_slab(self):
+        """The new hazard class: with the slab pool, fit 2 receives the
+        SAME device batch object fit 1 trained on — it must neither crash
+        (deleted buffers) nor drift (corrupted buffers)."""
+        from flink_ml_tpu.lib.common import train_glm
+        from flink_ml_tpu.parallel.mesh import replicate
+
+        mesh, stack, grad_fn, params = self._stack_and_grads()
+        placed = replicate(mesh, params)
+        r1 = train_glm(placed, stack, grad_fn, mesh,
+                       learning_rate=0.5, max_iter=4)
+        pool = slab_pool.pool()
+        assert pool.misses >= 1
+        misses0 = pool.misses
+        r2 = train_glm(placed, stack, grad_fn, mesh,
+                       learning_rate=0.5, max_iter=4)
+        assert pool.hits >= 1 and pool.misses == misses0  # same pooled slab
+        np.testing.assert_array_equal(r1.params[0], r2.params[0])
+
+
+class TestPooledInference:
+    def test_repeated_transform_reuses_placed_batch(self):
+        rng = np.random.RandomState(9)
+        X = rng.randn(200, 5).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        model = _logreg().fit(_dense_table(X, y))
+        q = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR)),
+            {"features": X},
+        )
+        pool = slab_pool.pool()
+        s1 = np.asarray(model.transform(q)[0].col("p"))
+        misses0 = pool.misses
+        s2 = np.asarray(model.transform(q)[0].col("p"))
+        assert pool.misses == misses0 and pool.hits >= 1
+        np.testing.assert_array_equal(s1, s2)
+
+    def test_knn_model_reload_reuses_placement(self):
+        from flink_ml_tpu.lib.knn import Knn
+
+        rng = np.random.RandomState(4)
+        X = rng.randn(64, 3).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        schema = Schema.of(
+            ("features", DataTypes.DENSE_VECTOR), ("label", "double")
+        )
+        t = Table.from_columns(schema, {"features": X, "label": y})
+        model = Knn().set_vector_col("features").set_label_col("label") \
+            .set_k(3).set_prediction_col("p").fit(t)
+        q = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR)), {"features": X}
+        )
+        r1 = np.asarray(model.transform(q)[0].col("p"))
+        pool = slab_pool.pool()
+        # a FRESH mapper over the same model table must hit the pooled
+        # reference-set placement instead of re-transferring the train set
+        model._mapper_cache = None
+        knn_misses0 = pool.misses
+        r2 = np.asarray(model.transform(q)[0].col("p"))
+        assert pool.hits >= 1 and pool.misses == knn_misses0
+        np.testing.assert_array_equal(r1, r2)
+
+
+class TestPrefetchAbandonment:
+    """Satellite: a producer exception recorded after the consumer
+    abandoned the stream must surface (warning) and the thread must be
+    joined — never silently dropped with the queue."""
+
+    def test_abandoned_stream_surfaces_producer_error(self):
+        import threading
+
+        from flink_ml_tpu.utils.prefetch import prefetch_iter
+
+        def items():
+            yield 1
+            yield 2
+            raise ValueError("producer exploded")
+
+        it = prefetch_iter(items(), depth=1, name="t-prefetch")
+        assert next(it) == 1
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            it.close()  # consumer abandons mid-stream
+        msgs = [str(w.message) for w in caught
+                if issubclass(w.category, RuntimeWarning)]
+        assert any("producer exploded" in m for m in msgs), msgs
+        assert not any(
+            th.name == "t-prefetch" and th.is_alive()
+            for th in threading.enumerate()
+        )
+
+    def test_consumed_stream_raises_at_consumer(self):
+        from flink_ml_tpu.utils.prefetch import prefetch_iter
+
+        def items():
+            yield 1
+            raise ValueError("boom")
+
+        it = prefetch_iter(items(), depth=1)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="boom"), \
+                warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            list(it)
+        # surfaced by RAISING — no duplicate warning
+        assert not [w for w in caught
+                    if issubclass(w.category, RuntimeWarning)]
+
+    def test_clean_stream_passes_through(self):
+        from flink_ml_tpu.utils.prefetch import prefetch_iter
+
+        assert list(prefetch_iter(iter(range(5)), depth=2)) == list(range(5))
