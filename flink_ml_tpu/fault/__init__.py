@@ -1,0 +1,67 @@
+"""Fault-tolerance layer: injection, retry, guarded fits, watchdogs.
+
+The reference delegates failure recovery entirely to Flink's runtime
+checkpoint machinery (SURVEY §5.3 — no ml-module code participates); this
+reproduction owns the capability itself.  Four pieces, wired through every
+train path:
+
+* :mod:`~flink_ml_tpu.fault.injection` — deterministic, seeded fault
+  injection (``FMT_FAULT_INJECT``), off by default;
+* :mod:`~flink_ml_tpu.fault.retry` — jittered exponential backoff for the
+  transient surfaces (spill I/O, checkpoint writes, cold placement);
+* :mod:`~flink_ml_tpu.fault.guard` — numeric-health sentinel with
+  rollback/retry at a backed-off learning rate, and the SIGTERM
+  emergency-checkpoint path;
+* :mod:`~flink_ml_tpu.fault.watchdog` — ``FMT_AGREE_TIMEOUT_S`` watchdog
+  so a dead peer fails collectives loudly instead of hanging the fleet.
+
+Chaos entry point: ``python scripts/chaos_smoke.py`` (also the CI
+``chaos-smoke`` job) runs the fast fit matrix under seeded injection and
+asserts convergence parity plus nonzero retry accounting.
+"""
+
+from flink_ml_tpu.fault.guard import (  # noqa: F401
+    NumericHealthError,
+    Preempted,
+    check_health,
+    emergency_save,
+    preempted,
+    preemption_scope,
+    reset_preempted,
+    run_guarded,
+)
+from flink_ml_tpu.fault.injection import (  # noqa: F401
+    InjectedFault,
+    configure,
+    configure_from_env,
+    maybe_fail,
+)
+from flink_ml_tpu.fault.retry import (  # noqa: F401
+    RetryPolicy,
+    is_transient,
+    with_retry,
+)
+from flink_ml_tpu.fault.watchdog import (  # noqa: F401
+    CollectiveTimeoutError,
+    with_timeout,
+)
+
+__all__ = [
+    "CollectiveTimeoutError",
+    "InjectedFault",
+    "NumericHealthError",
+    "Preempted",
+    "RetryPolicy",
+    "check_health",
+    "configure",
+    "configure_from_env",
+    "emergency_save",
+    "is_transient",
+    "maybe_fail",
+    "preempted",
+    "preemption_scope",
+    "reset_preempted",
+    "run_guarded",
+    "with_retry",
+    "with_timeout",
+]

@@ -1,0 +1,265 @@
+"""Guarded training: numeric-health sentinel, rollback/retry, preemption.
+
+Three failure classes the train drivers must survive (ROADMAP: a system
+serving heavy traffic degrades gracefully, it does not crash mid-fit):
+
+* **Numeric divergence** — a too-hot learning rate (or a poisoned batch)
+  drives the loss or the parameters to NaN/Inf.  Every driver calls
+  :func:`check_health` on the host-side values it is about to return or
+  snapshot; the raised :class:`NumericHealthError` propagates to the
+  estimator-level :func:`run_guarded` wrapper, which retries the fit with
+  a backed-off learning rate.  Checkpointed paths resume from the latest
+  snapshot — and because health is checked BEFORE every save, the latest
+  snapshot is by construction the last GOOD state, so the retry is a
+  rollback, not a replay of the divergence.
+
+* **Preemption** — a SIGTERM (spot/preemptible VMs, cluster drains)
+  arrives mid-fit.  Drivers with a checkpoint config run inside
+  :func:`preemption_scope`, which installs a flag-setting SIGTERM handler
+  for exactly the duration of the run (the process's normal SIGTERM
+  disposition is restored on exit).  The drivers poll the flag at epoch /
+  chunk boundaries — the only points where a snapshot is bit-identical to
+  an uninterrupted run's state — write an emergency checkpoint, and raise
+  :class:`Preempted` (a ``SystemExit`` with code 0) so the process exits
+  cleanly and the EXISTING resume path continues the run bit-identically.
+
+* **Divergence under retry** — ``FMT_GUARD_MAX_RETRIES`` bounds the
+  rollback loop; the final :class:`NumericHealthError` re-raises with the
+  full learning-rate history in its message, which beats returning a
+  silently-NaN model in every deployment we can imagine.
+
+``FMT_GUARD=0`` disables the sentinel (checks become no-ops and
+:func:`run_guarded` runs its attempt exactly once).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import warnings
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from flink_ml_tpu import obs
+
+__all__ = [
+    "NumericHealthError",
+    "Preempted",
+    "check_health",
+    "emergency_save",
+    "enabled",
+    "preempted",
+    "preemption_scope",
+    "reset_preempted",
+    "run_guarded",
+]
+
+
+def enabled() -> bool:
+    """Is the numeric-health sentinel on?  (``FMT_GUARD=0`` disables.)"""
+    return os.environ.get("FMT_GUARD", "1").lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+class NumericHealthError(RuntimeError):
+    """Non-finite loss or parameters — the fit diverged."""
+
+
+class Preempted(SystemExit):
+    """Raised after the emergency checkpoint commits; a ``SystemExit``
+    subclass with code 0, so an unhandled one IS the clean exit the
+    preemption contract promises (and ``except Exception`` blocks in
+    library code cannot swallow it)."""
+
+    def __init__(self):
+        super().__init__(0)
+
+
+def check_health(losses: Optional[Iterable] = None, leaves: Iterable = (),
+                 delta: Optional[float] = None, where: str = "train") -> None:
+    """Raise :class:`NumericHealthError` if the CURRENT training state is
+    non-finite.  ``leaves`` are host parameter arrays; ``losses`` the float
+    history, of which only the LAST value is judged — a transient early
+    overflow a run recovered from (saturated logistic loss at epoch 1,
+    finite ever after) is healthy, and failing it would silently re-train
+    a succeeding fit at a learning rate the user never asked for; a truly
+    diverged run shows in its latest loss or its params.  ``delta`` is the
+    final update norm (NaN delta with finite params still marks a diverged
+    epoch).  A no-op when the guard is disabled; cost is one ``isfinite``
+    reduction over values already fetched."""
+    if not enabled():
+        return
+    bad = None
+    if losses is not None:
+        try:  # sequences (every call site) read [-1]; O(1), not O(epochs)
+            last = losses[-1] if len(losses) else None
+        except TypeError:
+            last = None
+            for last in losses:  # noqa: B007 - want the final element
+                pass
+        if last is not None and not np.isfinite(float(last)):
+            bad = f"latest epoch loss is {float(last)!r}"
+    if bad is None:
+        for leaf in leaves:
+            a = np.asarray(leaf)
+            if a.dtype.kind == "f" and not np.all(np.isfinite(a)):
+                bad = f"a parameter leaf of shape {a.shape} went non-finite"
+                break
+    if bad is None and delta is not None and not np.isfinite(delta):
+        bad = f"final update norm is {delta!r}"
+    if bad is not None:
+        obs.counter_add("fault.numeric_errors")
+        raise NumericHealthError(f"{where}: {bad}")
+
+
+def run_guarded(attempt: Callable[[float], object], what: str = "fit",
+                max_retries: Optional[int] = None):
+    """Run ``attempt(lr_scale)``; on :class:`NumericHealthError`, retry
+    with an exponentially backed-off learning-rate scale.
+
+    The scale starts at 1.0 and multiplies by ``FMT_GUARD_LR_BACKOFF``
+    (default 0.5) per rollback, up to ``FMT_GUARD_MAX_RETRIES`` (default
+    2) retries.  Checkpointed attempts resume from the last good snapshot
+    (the drivers never snapshot unhealthy state), so a rollback re-trains
+    only the diverged tail; uncheckpointed attempts restart from the
+    initial parameters — with a colder step either way.  ``max_retries``
+    overrides the env budget: algorithms with NO learning rate to back
+    off (KMeans) pass 0, because replaying a deterministic attempt with
+    nothing varied would re-diverge identically — fail fast beats a
+    bit-identical rerun."""
+    if not enabled():
+        return attempt(1.0)
+    if max_retries is None:
+        max_retries = int(os.environ.get("FMT_GUARD_MAX_RETRIES", "2") or 2)
+    backoff = float(os.environ.get("FMT_GUARD_LR_BACKOFF", "0.5") or 0.5)
+    scale = 1.0
+    tried = []
+    for k in range(max_retries + 1):
+        try:
+            return attempt(scale)
+        except NumericHealthError as exc:
+            tried.append(scale)
+            if k >= max_retries:
+                raise NumericHealthError(
+                    f"{what} diverged after {len(tried)} attempt(s) at "
+                    f"learning-rate scales {tried}: {exc}"
+                ) from exc
+            obs.counter_add("fault.rollbacks")
+            scale *= backoff
+            warnings.warn(
+                f"{what}: non-finite training state ({exc}); rolling back "
+                f"to the last good checkpoint and retrying at learning-"
+                f"rate scale {scale:g}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+
+# -- preemption ---------------------------------------------------------------
+
+_PREEMPTED = threading.Event()
+_SCOPE_LOCK = threading.Lock()
+_SCOPE_DEPTH = 0
+_PREV_HANDLER = None
+
+
+def _on_sigterm(signum, frame):  # noqa: ARG001 - signal handler signature
+    _PREEMPTED.set()
+
+
+def preempted() -> bool:
+    """Has a SIGTERM arrived since the current scope was entered?"""
+    return _PREEMPTED.is_set()
+
+
+def reset_preempted() -> None:
+    _PREEMPTED.clear()
+
+
+@contextlib.contextmanager
+def preemption_scope():
+    """Install the flag-setting SIGTERM handler for the duration of a
+    checkpointed run; restore the previous disposition on exit.
+
+    Nested scopes share one installation (drivers compose: an estimator
+    fit wraps a chunked-checkpoint driver which wraps the fused runner).
+    Worker threads get a complete no-op scope (``signal`` forbids both
+    installing AND restoring handlers off the main thread, so they can
+    never participate in the depth accounting): such callers keep the
+    process default disposition and lose only the emergency-checkpoint
+    nicety, never correctness — and a concurrent main-thread scope's flag
+    remains visible to their boundary polls."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    global _SCOPE_DEPTH, _PREV_HANDLER
+    installed = False
+    with _SCOPE_LOCK:
+        if _SCOPE_DEPTH == 0:
+            # clear BEFORE attempting the install: a stale flag from an
+            # earlier scope (e.g. a SIGTERM suppressed because the run had
+            # already converged) must not truncate this run — including on
+            # worker threads, where the install itself is refused
+            _PREEMPTED.clear()
+            try:
+                _PREV_HANDLER = signal.signal(signal.SIGTERM, _on_sigterm)
+                installed = True
+            except ValueError:
+                _PREV_HANDLER = None  # not the main thread
+        else:
+            installed = True  # the outermost scope owns the handler
+        if installed:
+            _SCOPE_DEPTH += 1
+    try:
+        yield
+    finally:
+        if installed:
+            redeliver = False
+            with _SCOPE_LOCK:
+                _SCOPE_DEPTH -= 1
+                if _SCOPE_DEPTH == 0:
+                    signal.signal(
+                        signal.SIGTERM,
+                        _PREV_HANDLER if _PREV_HANDLER is not None
+                        else signal.SIG_DFL,
+                    )
+                    _PREV_HANDLER = None
+                    # a SIGTERM nobody consumed (the run FINISHED at the
+                    # same boundary it landed on, so the suppressed
+                    # emergency exit was correct) must not be silently
+                    # dropped: the OS asked this process to terminate, and
+                    # swallowing that leaves a multi-fit driver running
+                    # until the orchestrator's grace period expires in
+                    # SIGKILL mid-way through a later fit.  The final
+                    # state is committed, so re-deliver to the restored
+                    # disposition.  (emergency_save consumes the flag
+                    # before raising, so the clean-exit path never
+                    # double-delivers.)
+                    redeliver = _PREEMPTED.is_set()
+                    _PREEMPTED.clear()
+            if redeliver:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+
+def emergency_save(save_fn: Callable[[], object]) -> None:
+    """The preemption epilogue drivers call at a safe boundary: commit the
+    caller's snapshot, count it, exit cleanly via :class:`Preempted`.
+
+    Everything before the raise is ordinary (non-signal-context) code —
+    the SIGTERM handler only ever sets a flag; the actual checkpoint write
+    happens here, at an epoch boundary, where the snapshot is by
+    construction bit-identical to an uninterrupted run's state."""
+    save_fn()
+    _PREEMPTED.clear()  # consumed: the scope exit must not re-deliver
+    obs.counter_add("fault.emergency_checkpoints")
+    warnings.warn(
+        "preemption signal received: emergency checkpoint committed, "
+        "exiting cleanly (resume continues the run bit-identically)",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+    raise Preempted()

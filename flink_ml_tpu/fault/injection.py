@@ -1,0 +1,206 @@
+"""Deterministic fault injection — the registry chaos tests drive.
+
+The reference inherits failure testing from Flink's runtime (its test
+harness randomizes checkpoint intervals and kills TaskManagers —
+`/root/reference/pom.xml:396-401`); this reproduction owns its own fault
+machinery, so it needs its own way to PROVE the machinery works.  This
+module is that proof's lever: named injection points planted in the hot
+paths we must trust (H2D placement, slab-pool build, spill I/O, prefetch
+producers, checkpoint saves, collective agreement) raise a synthetic
+:class:`InjectedFault` on a schedule fixed by ``FMT_FAULT_INJECT`` — the
+SAME schedule every run, so a chaos test's pass/fail is reproducible and a
+parity assertion (faulted run == fault-free run) is meaningful.
+
+**Off by default, one-bool overhead.**  Every planted hook is
+``maybe_fail("point")``, which returns immediately on a module-level flag
+when no spec is configured — the obs-registry discipline (instrumented
+code pays nothing measurable when disabled).
+
+Spec grammar (comma-separated terms, configured via the environment or
+:func:`configure`)::
+
+    point@N      fail exactly the N-th call to ``point`` (1-based), once
+    point@N+     fail the N-th and every later call
+    point~P      fail each call with probability P, from a per-point RNG
+                 seeded by ``FMT_FAULT_SEED`` (default 0) — deterministic
+                 for a fixed seed and call sequence
+
+e.g. ``FMT_FAULT_INJECT="place.h2d@1,spill.read@2,ckpt.save~0.2"``.
+
+Planted points (grep ``maybe_fail`` for the live set):
+
+==================  =========================================================
+``place.h2d``       :func:`~flink_ml_tpu.parallel.mesh.shard_batch` /
+                    ``shard_batch_prefetched`` — host->device placement
+``slab.lookup``     :meth:`~flink_ml_tpu.table.slab_pool.SlabPool.get_or_build`
+``spill.write``     :class:`~flink_ml_tpu.lib.out_of_core.BlockSpill` block save
+``spill.read``      BlockSpill replay validation (treated as corruption)
+``prefetch.produce``:func:`~flink_ml_tpu.utils.prefetch.prefetch_iter` producer
+``ckpt.save``       :func:`~flink_ml_tpu.iteration.checkpoint.save_checkpoint`
+``agree``           :func:`~flink_ml_tpu.parallel.mesh.agree_max`/``agree_sum``
+==================  =========================================================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from flink_ml_tpu import obs
+
+__all__ = [
+    "InjectedFault",
+    "active",
+    "configure",
+    "configure_from_env",
+    "fire_count",
+    "maybe_fail",
+    "reset",
+]
+
+
+class InjectedFault(RuntimeError):
+    """The synthetic transient failure every injection point raises.
+
+    A distinct type so retry policies can treat it as retryable and real
+    bugs surfacing during a chaos run are never mistaken for the chaos."""
+
+    def __init__(self, point: str, call_no: int):
+        super().__init__(
+            f"injected fault at '{point}' (call #{call_no}; "
+            f"FMT_FAULT_INJECT={os.environ.get('FMT_FAULT_INJECT', '')!r})"
+        )
+        self.point = point
+        self.call_no = call_no
+
+
+class _Rule:
+    """One parsed spec term: when does ``point`` fail?"""
+
+    __slots__ = ("point", "nth", "sticky", "prob", "rng")
+
+    def __init__(self, point: str, nth: Optional[int], sticky: bool,
+                 prob: Optional[float], seed: int):
+        self.point = point
+        self.nth = nth
+        self.sticky = sticky
+        self.prob = prob
+        if prob is not None:
+            import zlib
+
+            import numpy as np
+
+            # per-point stream: the same seed must not make every point
+            # fire in lockstep
+            self.rng = np.random.RandomState(
+                (seed ^ zlib.crc32(point.encode())) & 0x7FFFFFFF
+            )
+        else:
+            self.rng = None
+
+    def fires(self, call_no: int) -> bool:
+        if self.prob is not None:
+            return bool(self.rng.random_sample() < self.prob)
+        if self.sticky:
+            return call_no >= self.nth
+        return call_no == self.nth
+
+
+#: the one-bool gate every planted hook checks first
+_ACTIVE = False
+_LOCK = threading.Lock()
+_RULES: Dict[str, _Rule] = {}
+_CALLS: Dict[str, int] = {}
+_FIRES: Dict[str, int] = {}
+
+
+def _parse(spec: str, seed: int) -> Dict[str, _Rule]:
+    rules: Dict[str, _Rule] = {}
+    for term in spec.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "~" in term:
+            point, prob = term.split("~", 1)
+            rules[point] = _Rule(point, None, False, float(prob), seed)
+        elif "@" in term:
+            point, nth = term.split("@", 1)
+            sticky = nth.endswith("+")
+            n = int(nth[:-1] if sticky else nth)
+            if n < 1:
+                raise ValueError(
+                    f"fault spec {term!r}: call numbers are 1-based"
+                )
+            rules[point] = _Rule(point, n, sticky, None, seed)
+        else:
+            raise ValueError(
+                f"fault spec term {term!r}: expected point@N, point@N+ "
+                "or point~P"
+            )
+    return rules
+
+
+def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
+    """Install an injection schedule (``None``/empty spec turns it off).
+
+    Resets all per-point call counters — a test's schedule always starts
+    from call 1."""
+    global _ACTIVE
+    if seed is None:
+        seed = int(os.environ.get("FMT_FAULT_SEED", "0") or 0)
+    with _LOCK:
+        _RULES.clear()
+        _CALLS.clear()
+        _FIRES.clear()
+        if spec:
+            _RULES.update(_parse(spec, seed))
+        _ACTIVE = bool(_RULES)
+
+
+def configure_from_env() -> None:
+    """(Re)load the schedule from ``FMT_FAULT_INJECT``/``FMT_FAULT_SEED``."""
+    configure(os.environ.get("FMT_FAULT_INJECT", ""))
+
+
+def reset() -> None:
+    """Turn injection off and clear all counters."""
+    configure(None)
+
+
+def active() -> bool:
+    """Is any injection schedule installed?"""
+    return _ACTIVE
+
+
+def maybe_fail(point: str) -> None:
+    """The planted hook: raise :class:`InjectedFault` when ``point``'s
+    schedule says this call fails.  One module-bool check when inactive."""
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        rule = _RULES.get(point)
+        if rule is None:
+            return
+        call_no = _CALLS.get(point, 0) + 1
+        _CALLS[point] = call_no
+        fires = rule.fires(call_no)
+        if fires:
+            _FIRES[point] = _FIRES.get(point, 0) + 1
+    if fires:
+        obs.counter_add("fault.injected")
+        obs.counter_add(f"fault.injected.{point}")
+        raise InjectedFault(point, call_no)
+
+
+def fire_count(point: Optional[str] = None) -> int:
+    """Faults fired so far — for one point, or in total."""
+    with _LOCK:
+        if point is not None:
+            return _FIRES.get(point, 0)
+        return sum(_FIRES.values())
+
+
+# honor an injection schedule already present in the environment at import
+# (the chaos entry point and CI set it before any flink_ml_tpu import)
+configure_from_env()

@@ -1,0 +1,80 @@
+"""Collective watchdog — a dead peer must fail loudly, not hang forever.
+
+Cross-process agreement (``agree_max``/``agree_sum``, the slab pool's
+hit/miss vote, the coordinated resume point) blocks every healthy process
+until ALL processes arrive.  When a peer died — preempted VM, OOM-killed
+worker — the allgather never completes and the healthy fleet wedges
+silently, which in a production queue looks exactly like a slow job.  The
+reference never sees this: Flink's JobManager heartbeats TaskManagers and
+fails the job on a miss.  This module is the heartbeat's poor-but-honest
+cousin: run the collective on a worker thread, wait ``FMT_AGREE_TIMEOUT_S``
+seconds, and raise a diagnostic NAMING the stalled collective so the
+operator (or the retry layer above) knows which rendezvous died.
+
+Off by default (timeout 0 = wait forever, the pre-watchdog behavior):
+collectives legitimately wait minutes while a peer compiles.  Deployments
+set the env to their preemption SLO.
+
+The abandoned worker thread cannot be cancelled (the gather is blocked in
+native code) — it is daemonized and leaked.  That is acceptable: the
+diagnostic's purpose is to get the process to a clean exit/restart, not to
+resume using a mesh with a dead peer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+__all__ = ["CollectiveTimeoutError", "agree_timeout_s", "with_timeout"]
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A cross-process collective did not complete within the watchdog
+    window — almost always a dead or wedged peer."""
+
+    def __init__(self, name: str, timeout_s: float):
+        super().__init__(
+            f"collective '{name}' did not complete within {timeout_s:g}s "
+            f"(FMT_AGREE_TIMEOUT_S): a peer process is likely dead or "
+            "wedged; check every worker's liveness and resume from the "
+            "latest checkpoint"
+        )
+        self.collective = name
+        self.timeout_s = timeout_s
+
+
+def agree_timeout_s() -> float:
+    """The configured watchdog window; 0 disables (wait forever)."""
+    return float(os.environ.get("FMT_AGREE_TIMEOUT_S", "0") or 0.0)
+
+
+def with_timeout(fn: Callable, name: str, timeout_s: float = None):
+    """Run ``fn()`` under the watchdog; identity when the window is 0.
+
+    The result (or the collective's own exception) passes through
+    unchanged when ``fn`` finishes in time."""
+    if timeout_s is None:
+        timeout_s = agree_timeout_s()
+    if timeout_s <= 0:
+        return fn()
+    box: list = []
+    err: list = []
+
+    def work():
+        try:
+            box.append(fn())
+        except BaseException as exc:  # noqa: BLE001 - re-raised at caller
+            err.append(exc)
+
+    t = threading.Thread(
+        target=work, daemon=True, name=f"watchdog-{name}"
+    )
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        raise CollectiveTimeoutError(name, timeout_s)
+    if err:
+        raise err[0]
+    return box[0]

@@ -21,6 +21,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from flink_ml_tpu.fault.injection import maybe_fail
+from flink_ml_tpu.fault.retry import with_retry
+
 _META_SUFFIX = ".meta.json"
 _DATA_SUFFIX = ".npz"
 _NAME_RE = re.compile(r"^epoch_(\d+)\.npz$")
@@ -51,39 +54,71 @@ def save_checkpoint(directory: str, epoch: int, params, meta: Optional[Dict] = N
                     aux: Optional[Dict[str, np.ndarray]] = None) -> str:
     """Snapshot a parameter pytree after ``epoch`` completed.
 
-    Writes are atomic (temp file + rename), data before the npz that
-    ``latest_checkpoint`` keys on — a crash mid-save leaves the previous
-    snapshot intact and never a half-written latest.  ``aux`` arrays are
+    Writes are atomic (temp file + rename) and ordered DATA FIRST, meta
+    last as the commit record: a crash mid-save leaves the previous
+    snapshot intact and never a half-written latest, and a crash between
+    the two renames leaves an npz whose sidecar is missing — still a
+    complete, loadable snapshot (``load_checkpoint`` derives the epoch
+    from the filename; only the loss-history nicety is lost).  The old
+    meta-first order instead left an orphan SIDECAR describing data that
+    never existed, which nothing ever cleaned up
+    (:func:`latest_checkpoint` now sweeps those).  Both writes ride the
+    transient-failure retry policy (``fault.retry``): checkpoint I/O on
+    network filesystems blips, and losing a snapshot to one EIO turns a
+    recoverable preemption into a from-scratch rerun.  ``aux`` arrays are
     stored in the same npz under a reserved prefix (one atomic commit for
     params + buffers) and read back with :func:`load_aux`.
     """
     os.makedirs(directory, exist_ok=True)
     leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
     path = os.path.join(directory, f"epoch_{epoch}{_DATA_SUFFIX}")
-    meta_tmp = path + _META_SUFFIX + ".tmp"
-    with open(meta_tmp, "w") as f:
-        json.dump({"epoch": epoch, **(meta or {})}, f)
-    os.replace(meta_tmp, path + _META_SUFFIX)
-    data_tmp = path + ".tmp"
-    with open(data_tmp, "wb") as f:
-        np.savez(f, *leaves,
-                 **{_AUX_PREFIX + k: np.asarray(v) for k, v in (aux or {}).items()})
-    os.replace(data_tmp, path)
+
+    def write_data():
+        maybe_fail("ckpt.save")
+        data_tmp = path + ".tmp"
+        with open(data_tmp, "wb") as f:
+            np.savez(f, *leaves,
+                     **{_AUX_PREFIX + k: np.asarray(v)
+                        for k, v in (aux or {}).items()})
+        os.replace(data_tmp, path)
+
+    def write_meta():
+        meta_tmp = path + _META_SUFFIX + ".tmp"
+        with open(meta_tmp, "w") as f:
+            json.dump({"epoch": epoch, **(meta or {})}, f)
+        os.replace(meta_tmp, path + _META_SUFFIX)
+
+    with_retry(write_data, "ckpt.save")
+    with_retry(write_meta, "ckpt.save")
     return path
 
 
 def load_checkpoint(path: str, like) -> Tuple[Any, Dict]:
-    """Load a snapshot back into the structure of ``like``."""
+    """Load a snapshot back into the structure AND leaf dtypes of ``like``.
+
+    The dtype restore is what makes resume BIT-identical: under x64 the
+    save path fetches f32 training params as f64 (exact), and resuming
+    with f64 leaves would re-run the remaining epochs in double precision
+    — a run that never crashed computed them in f32.  Casting back to the
+    dtype training uses (f64 -> f32 of an exactly-held f32 value is
+    lossless) makes the resumed tail reproduce the uninterrupted run's
+    arithmetic exactly."""
     with np.load(path) as data:
         leaves = [
             data[k] for k in data.files if not k.startswith(_AUX_PREFIX)
         ]
-    treedef = jax.tree_util.tree_structure(like)
-    if treedef.num_leaves != len(leaves):
+    like_leaves = jax.tree_util.tree_leaves(like)
+    if len(like_leaves) != len(leaves):
         raise ValueError(
             f"checkpoint {path} has {len(leaves)} leaves, expected "
-            f"{treedef.num_leaves}"
+            f"{len(like_leaves)}"
         )
+    leaves = [
+        np.asarray(leaf, dtype=ref.dtype)
+        if getattr(ref, "dtype", None) is not None else leaf
+        for leaf, ref in zip(leaves, like_leaves)
+    ]
+    treedef = jax.tree_util.tree_structure(like)
     params = jax.tree_util.tree_unflatten(treedef, leaves)
     meta_path = path + _META_SUFFIX
     meta: Dict = {}
@@ -108,16 +143,40 @@ def load_aux(path: str) -> Dict[str, np.ndarray]:
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
-    """Path of the highest-epoch snapshot, or None."""
+    """Path of the highest-epoch snapshot, or None.
+
+    The scan also sweeps crash leftovers: ``.tmp`` staging files and
+    orphan ``.meta.json`` sidecars whose npz never committed (the
+    meta-first write order of earlier versions could strand those; with
+    the current data-first order they cannot recur, but directories
+    written by older code — or crashed mid-save — still carry them).  An
+    npz WITHOUT a sidecar is a valid committed snapshot and is kept."""
     if not os.path.isdir(directory):
         return None
+    names = os.listdir(directory)
+    present = set(names)
+    for name in names:
+        if name.endswith(".tmp"):
+            _remove_quiet(os.path.join(directory, name))
+        elif name.endswith(_META_SUFFIX):
+            data_name = name[: -len(_META_SUFFIX)]
+            if _NAME_RE.match(data_name) and data_name not in present:
+                # orphan sidecar: meta committed but its data never did
+                _remove_quiet(os.path.join(directory, name))
     best_epoch, best = -1, None
-    for name in os.listdir(directory):
+    for name in names:
         m = _NAME_RE.match(name)
         if m and int(m.group(1)) > best_epoch:
             best_epoch = int(m.group(1))
             best = os.path.join(directory, name)
     return best
+
+
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass  # concurrent sweep/prune; the file being gone is the goal
 
 
 def checkpoint_path_for_epoch(directory: str, epoch: int) -> str:

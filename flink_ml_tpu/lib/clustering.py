@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu import obs
+from flink_ml_tpu import fault, obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import apply_sharded, resolve_features
@@ -394,11 +394,18 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
             cols=kmeans_cols or None,
         )
 
-        result = train_kmeans(
-            init, k, Xp, wp, mesh,
-            max_iter=self.get_max_iter(), tol=self.get_tol(),
-            n_rows=n_global,
-            checkpoint=checkpoint, device_batch=device_batch,
+        # guarded for the health sentinel's diagnostics, but with NO retry
+        # budget: KMeans has no learning rate to back off, so a replay
+        # would re-diverge bit-identically — fail fast with the guard's
+        # framing instead of multiplying time-to-error
+        result = fault.run_guarded(
+            lambda _lr_scale: train_kmeans(
+                init, k, Xp, wp, mesh,
+                max_iter=self.get_max_iter(), tol=self.get_tol(),
+                n_rows=n_global,
+                checkpoint=checkpoint, device_batch=device_batch,
+            ),
+            what=type(self).__name__, max_retries=0,
         )
         return self._finish(result, k)
 
@@ -531,15 +538,18 @@ class KMeans(Estimator, KMeansParams, HasMaxIter, HasTol, HasSeed, HasCheckpoint
         key = ("chunk-kmeans", mesh, int(k), rows_per_block, dim)
         use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
         with oc.maybe_spill(blocks, use_spill) as blocks:
-            result = oc.train_out_of_core(
-                jnp.asarray(cents0, dtype=jnp.float32),
-                blocks,
-                lambda: oc.make_kmeans_chunk_fn(key, k, mesh),
-                mesh,
-                max_iter=self.get_max_iter(),
-                tol=self.get_tol(),
-                checkpoint=checkpoint,
-                make_carry=oc.kmeans_make_carry,
-                finalize=oc.kmeans_finalize,
+            result = fault.run_guarded(
+                lambda _lr_scale: oc.train_out_of_core(
+                    jnp.asarray(cents0, dtype=jnp.float32),
+                    blocks,
+                    lambda: oc.make_kmeans_chunk_fn(key, k, mesh),
+                    mesh,
+                    max_iter=self.get_max_iter(),
+                    tol=self.get_tol(),
+                    checkpoint=checkpoint,
+                    make_carry=oc.kmeans_make_carry,
+                    finalize=oc.kmeans_finalize,
+                ),
+                what=type(self).__name__, max_retries=0,  # no lr to back off
             )
         return self._finish(result, k)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu import obs
+from flink_ml_tpu import fault, obs
 from flink_ml_tpu.iteration.bounded import (
     IterationBodyResult,
     ReplayableInputs,
@@ -728,6 +728,14 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     _RUN_BUILDS_SEEN = _FUSED_PROGRAM_BUILDS
     obs.record_hbm_gauges()
     host_params = jax.tree_util.tree_unflatten(treedef, fetched[: len(leaves)])
+    # numeric-health sentinel on the values just fetched (free: no extra
+    # sync): a diverged fit raises here and the estimator-level guard
+    # rolls back / retries with a backed-off learning rate
+    fault.check_health(
+        losses, fetched[: len(leaves)],
+        float(fetched[-1]) if n_epochs else None,  # 0-epoch delta is inf
+        where="fused_train",
+    )
     return TrainResult(
         params=host_params,
         epochs=n_epochs,
@@ -2097,32 +2105,71 @@ def run_chunked_checkpoint(
             )
 
     chunk_metrics = StepMetrics("fused_train")
+    # pin the training dtype across chunk boundaries: under x64 the fetch
+    # returns f64 copies of f32 device params, and re-placing those would
+    # silently promote every chunk after the first to double precision —
+    # a continuous checkpointed run would then drift from both the
+    # unchunked fused run and a kill-and-resumed one (load_checkpoint
+    # casts back to the template dtype for the same reason).  The f64
+    # copies hold the f32 values exactly, so the cast is lossless.
+    _chunk_dtypes = [
+        getattr(x, "dtype", None)
+        for x in jax.tree_util.tree_leaves(params)
+    ]
+
+    def _pin_dtypes(pytree):
+        leaves, treedef = jax.tree_util.tree_flatten(pytree)
+        leaves = [
+            np.asarray(x, dtype=dt) if dt is not None else x
+            for x, dt in zip(leaves, _chunk_dtypes)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     # placement happens AFTER the no-op-resume early return above: a finished
     # run must not pay the host->device transfer just to return the snapshot.
     # ``device_batch`` may be a thunk (lazy placement) for the same reason.
     device_batch = _resolve_thunk(device_batch)
     if device_batch is None:
-        device_batch = shard_batch(mesh, batch)  # place ONCE across all chunks
+        from flink_ml_tpu.fault.retry import with_retry
+
+        # place ONCE across all chunks; cold H2D is a transient surface
+        device_batch = with_retry(
+            lambda: shard_batch(mesh, batch), "place"
+        )
     last_delta = None
-    while start_epoch < max_iter:
-        chunk = min(checkpoint.every_n_epochs, max_iter - start_epoch)
-        r = run(chunk, params, device_batch)
-        params = r.params
-        losses.extend(r.losses)
-        start_epoch += r.epochs
-        last_delta = r.final_delta
-        chunk_metrics.extend(r.metrics)
-        converged = r.epochs < chunk or (  # mid-chunk, or exactly at boundary
-            tol > 0.0 and r.final_delta is not None and r.final_delta <= tol
-        )
-        save_checkpoint(
-            checkpoint.directory, start_epoch - 1, params,
-            meta={"losses": losses, "converged": converged, "tol": tol,
-                  "final_delta": r.final_delta},
-        )
-        prune_checkpoints(checkpoint.directory, checkpoint.keep)
-        if converged:
-            break
+    with fault.preemption_scope():
+        while start_epoch < max_iter:
+            chunk = min(checkpoint.every_n_epochs, max_iter - start_epoch)
+            r = run(chunk, params, device_batch)
+            params = _pin_dtypes(r.params)
+            losses.extend(r.losses)
+            start_epoch += r.epochs
+            last_delta = r.final_delta
+            chunk_metrics.extend(r.metrics)
+            converged = r.epochs < chunk or (  # mid-chunk or at boundary
+                tol > 0.0 and r.final_delta is not None
+                and r.final_delta <= tol
+            )
+            # health precedes the snapshot: the latest checkpoint is by
+            # construction the last GOOD state, so a guard rollback never
+            # resumes into the divergence (the fused runner checked the
+            # same values already; this guards custom `run` callables too)
+            fault.check_health(
+                r.losses, jax.tree_util.tree_leaves(params),
+                where="chunked_train",
+            )
+            save_checkpoint(
+                checkpoint.directory, start_epoch - 1, params,
+                meta={"losses": losses, "converged": converged, "tol": tol,
+                      "final_delta": r.final_delta},
+            )
+            prune_checkpoints(checkpoint.directory, checkpoint.keep)
+            if fault.preempted() and not converged and start_epoch < max_iter:
+                # the boundary snapshot just committed IS the emergency
+                # checkpoint; exit cleanly for the resume path
+                fault.emergency_save(lambda: None)
+            if converged:
+                break
     return TrainResult(params=params, epochs=start_epoch, losses=losses,
                        final_delta=last_delta, metrics=chunk_metrics)
 
@@ -2269,8 +2316,14 @@ def train_glm(
                     losses=[float(x) for x in losses],
                 )
 
+    from flink_ml_tpu.fault.retry import with_retry
+
     epoch_step = make_glm_epoch_step(grad_fn, mesh, learning_rate, reg)
-    batch = shard_batch(mesh, (stack.x, stack.y, stack.w))
+    # cold H2D placement is a transient surface on this path too (the
+    # pooled and streamed paths already retry theirs)
+    batch = with_retry(
+        lambda: shard_batch(mesh, (stack.x, stack.y, stack.w)), "place"
+    )
     params0 = replicate(mesh, init_params)
     converted: list = list(losses)  # float prefix (resumed history)
     metrics = StepMetrics("epoch_train")
@@ -2293,7 +2346,8 @@ def train_glm(
         losses.append(loss)
         if checkpoint is not None:
             true_epoch = start_epoch + epoch
-            if (true_epoch + 1) % checkpoint.every_n_epochs == 0:
+            at_interval = (true_epoch + 1) % checkpoint.every_n_epochs == 0
+            if at_interval or fault.preempted():
                 from flink_ml_tpu.iteration.checkpoint import (
                     prune_checkpoints,
                     save_checkpoint,
@@ -2303,13 +2357,30 @@ def train_glm(
                 # syncs anyway; re-converting the whole history each time
                 # would be O(E^2) blocking float() calls)
                 converted.extend(float(x) for x in losses[len(converted):])
-                save_checkpoint(
-                    checkpoint.directory,
-                    true_epoch,
-                    jax.tree_util.tree_map(np.asarray, new_params),
-                    meta={"losses": list(converted)},
+                host = jax.tree_util.tree_map(np.asarray, new_params)
+                # health precedes the snapshot (last checkpoint = last
+                # good state); the guard's rollback relies on it
+                fault.check_health(
+                    converted, jax.tree_util.tree_leaves(host),
+                    where="epoch_train",
                 )
-                prune_checkpoints(checkpoint.directory, checkpoint.keep)
+
+                def _snapshot():
+                    save_checkpoint(
+                        checkpoint.directory, true_epoch, host,
+                        meta={"losses": list(converted)},
+                    )
+                    prune_checkpoints(checkpoint.directory, checkpoint.keep)
+
+                # a run that just FINISHED (tol converged this epoch, or
+                # this was the final epoch) returns its result instead of
+                # exiting for resume — the same rule as the other drivers;
+                # exiting here would also skip the converged stamp below
+                if fault.preempted() and not tol_converged[0] \
+                        and true_epoch + 1 < max_iter:
+                    metrics.end_step(samples=stack.n_rows)
+                    fault.emergency_save(_snapshot)  # raises Preempted
+                _snapshot()
         metrics.end_step(samples=stack.n_rows)
         return IterationBodyResult(
             feedback=new_params,
@@ -2317,16 +2388,26 @@ def train_glm(
             termination_criteria=criteria,
         )
 
-    result = iterate_bounded(
-        params0,
-        ReplayableInputs.replay(batch=batch),
-        body,
-        IterationConfig(max_epochs=max_iter - start_epoch),
-        listeners=listeners,
+    import contextlib as _contextlib
+
+    scope = (
+        fault.preemption_scope() if checkpoint is not None
+        else _contextlib.nullcontext()
     )
+    with scope:
+        result = iterate_bounded(
+            params0,
+            ReplayableInputs.replay(batch=batch),
+            body,
+            IterationConfig(max_epochs=max_iter - start_epoch),
+            listeners=listeners,
+        )
     final = jax.tree_util.tree_map(np.asarray, result.final_variables)
     total_epochs = start_epoch + result.epochs_run
     float_losses = [float(x) for x in losses]
+    fault.check_health(
+        float_losses, jax.tree_util.tree_leaves(final), where="epoch_train"
+    )
     if checkpoint is not None and tol_converged[0]:
         # terminated by tol (including convergence landing exactly on the
         # final permitted epoch): stamp the final state as converged so a

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu import obs
+from flink_ml_tpu import fault, obs
 from flink_ml_tpu.api.core import Estimator
 from flink_ml_tpu.common.mapper import ModelMapper
 from flink_ml_tpu.lib.common import (
@@ -312,17 +312,23 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        result = train_glm(
-            (w0, b0),
-            stack,
-            self._grad_fn(),
-            mesh,
-            learning_rate=self.get_learning_rate(),
-            max_iter=self.get_max_iter(),
-            reg=self.get_reg(),
-            tol=self.get_tol(),
-            checkpoint=checkpoint,
-            device_batch=device_batch,
+        # guarded: a NaN/Inf fit rolls back to the last good checkpoint
+        # (or the zero init) and retries at a backed-off learning rate
+        lr = self.get_learning_rate()
+        result = fault.run_guarded(
+            lambda lr_scale: train_glm(
+                (w0, b0),
+                stack,
+                self._grad_fn(),
+                mesh,
+                learning_rate=lr * lr_scale,
+                max_iter=self.get_max_iter(),
+                reg=self.get_reg(),
+                tol=self.get_tol(),
+                checkpoint=checkpoint,
+                device_batch=device_batch,
+            ),
+            what=type(self).__name__,
         )
         return self._finish(result)
 
@@ -350,18 +356,22 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         )
         w0 = jnp.zeros((dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        result = train_glm_dense_2d(
-            (w0, b0),
-            stack,
-            self.LOSS_KIND,
-            mesh,
-            learning_rate=self.get_learning_rate(),
-            max_iter=self.get_max_iter(),
-            reg=self.get_reg(),
-            tol=self.get_tol(),
-            with_intercept=self.get_with_intercept(),
-            checkpoint=self._checkpoint_config(),
-            device_batch=device_batch,
+        lr = self.get_learning_rate()
+        result = fault.run_guarded(
+            lambda lr_scale: train_glm_dense_2d(
+                (w0, b0),
+                stack,
+                self.LOSS_KIND,
+                mesh,
+                learning_rate=lr * lr_scale,
+                max_iter=self.get_max_iter(),
+                reg=self.get_reg(),
+                tol=self.get_tol(),
+                with_intercept=self.get_with_intercept(),
+                checkpoint=self._checkpoint_config(),
+                device_batch=device_batch,
+            ),
+            what=type(self).__name__,
         )
         return self._finish(result)
 
@@ -448,18 +458,22 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        result = train_glm_sparse(
-            (w0, b0),
-            sstack,
-            self.LOSS_KIND,
-            mesh,
-            learning_rate=self.get_learning_rate(),
-            max_iter=self.get_max_iter(),
-            reg=self.get_reg(),
-            tol=self.get_tol(),
-            with_intercept=self.get_with_intercept(),
-            checkpoint=self._checkpoint_config(),
-            device_batch=device_batch,
+        lr = self.get_learning_rate()
+        result = fault.run_guarded(
+            lambda lr_scale: train_glm_sparse(
+                (w0, b0),
+                sstack,
+                self.LOSS_KIND,
+                mesh,
+                learning_rate=lr * lr_scale,
+                max_iter=self.get_max_iter(),
+                reg=self.get_reg(),
+                tol=self.get_tol(),
+                with_intercept=self.get_with_intercept(),
+                checkpoint=self._checkpoint_config(),
+                device_batch=device_batch,
+            ),
+            what=type(self).__name__,
         )
         return self._finish(result)
 
@@ -569,19 +583,23 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             )
         w0 = jnp.zeros((sstack.dim,), dtype=jnp.float32)
         b0 = jnp.zeros((), dtype=jnp.float32)
-        result = train_glm_sparse_hotcold(
-            (w0, b0),
-            hstack,
-            self.LOSS_KIND,
-            mesh,
-            learning_rate=self.get_learning_rate(),
-            max_iter=self.get_max_iter(),
-            reg=self.get_reg(),
-            tol=self.get_tol(),
-            with_intercept=self.get_with_intercept(),
-            checkpoint=self._checkpoint_config(),
-            device_batch=device_batch,
-            resident_slabs=resident,
+        lr = self.get_learning_rate()
+        result = fault.run_guarded(
+            lambda lr_scale: train_glm_sparse_hotcold(
+                (w0, b0),
+                hstack,
+                self.LOSS_KIND,
+                mesh,
+                learning_rate=lr * lr_scale,
+                max_iter=self.get_max_iter(),
+                reg=self.get_reg(),
+                tol=self.get_tol(),
+                with_intercept=self.get_with_intercept(),
+                checkpoint=self._checkpoint_config(),
+                device_batch=device_batch,
+                resident_slabs=resident,
+            ),
+            what=type(self).__name__,
         )
         return self._finish(result)
 
@@ -818,17 +836,24 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         b0 = jnp.zeros((), dtype=jnp.float32)
         use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
         with oc.maybe_spill(blocks, use_spill) as blocks:
-            result = oc.train_out_of_core(
-                (w0, b0),
-                blocks,
-                lambda: oc.make_chunk_step_fn(
-                    key, mb_grad, mesh, lr, reg, param_spec=param_spec
+            # guarded: a rollback retries at a backed-off learning rate —
+            # the scale joins the program key so the colder-step chunk
+            # program compiles fresh instead of hitting the hot one
+            result = fault.run_guarded(
+                lambda lr_scale: oc.train_out_of_core(
+                    (w0, b0),
+                    blocks,
+                    lambda: oc.make_chunk_step_fn(
+                        key + ("lrs", lr_scale), mb_grad, mesh,
+                        lr * lr_scale, reg, param_spec=param_spec,
+                    ),
+                    mesh,
+                    max_iter=self.get_max_iter(),
+                    tol=self.get_tol(),
+                    checkpoint=checkpoint,
+                    place_params=place_params,
                 ),
-                mesh,
-                max_iter=self.get_max_iter(),
-                tol=self.get_tol(),
-                checkpoint=checkpoint,
-                place_params=place_params,
+                what=type(self).__name__,
             )
         if trim is not None:  # the placer's own inverse: trim 2-D padding
             w_t, b_t = trim(result.params)
@@ -952,19 +977,23 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
 
         use_spill = getattr(table, "spill", False) and self.get_max_iter() > 1
         with oc.maybe_spill(blocks, use_spill) as blocks:
-            result = oc.train_out_of_core(
-                (w0, b0),
-                blocks,
-                lambda: oc.make_chunk_step_fn(
-                    key, mb_grad, mesh, lr, reg, param_spec=param_spec
+            result = fault.run_guarded(
+                lambda lr_scale: oc.train_out_of_core(
+                    (w0, b0),
+                    blocks,
+                    lambda: oc.make_chunk_step_fn(
+                        key + ("lrs", lr_scale), mb_grad, mesh,
+                        lr * lr_scale, reg, param_spec=param_spec,
+                    ),
+                    mesh,
+                    max_iter=self.get_max_iter(),
+                    tol=self.get_tol(),
+                    checkpoint=checkpoint,
+                    place_params=place_params,
+                    meta_extra={"hotcold_layout": layout_sig},
+                    validate_meta=validate_meta,
                 ),
-                mesh,
-                max_iter=self.get_max_iter(),
-                tol=self.get_tol(),
-                checkpoint=checkpoint,
-                place_params=place_params,
-                meta_extra={"hotcold_layout": layout_sig},
-                validate_meta=validate_meta,
+                what=type(self).__name__,
             )
         w_t = np.asarray(result.params[0])[fplan["perm"]]
         result.params = (w_t, result.params[1])

@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_ml_tpu import obs
+from flink_ml_tpu import fault, obs
 from flink_ml_tpu.lib.common import (
     TrainResult,
     _cache_get,
@@ -58,7 +58,6 @@ from flink_ml_tpu.lib.common import (
 )
 from flink_ml_tpu.ops.batch import CsrRows
 from flink_ml_tpu.parallel.collectives import psum, shard_map
-from flink_ml_tpu.table.sources import _atomic_np_save
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.metrics import StepMetrics
 
@@ -325,67 +324,106 @@ def train_out_of_core(
     final_delta: Optional[float] = None
     epoch = start_epoch
     converged = False
-    while epoch < max_iter and not converged:
-        epoch_start = jax.tree_util.tree_map(jnp.copy, params)
-        # fresh accumulators every epoch: the chunk program donates its
-        # carry, so a reused zero scalar would be a deleted buffer
-        if make_carry is not None:
-            carry = make_carry(params)
-        else:
-            carry = (params, jnp.zeros((), dtype=jnp.float32),
-                     jnp.zeros((), dtype=jnp.float32))
-        n_rows = 0
+    # checkpointed runs catch SIGTERM for the duration of the loop: the
+    # flag is polled at epoch boundaries (the only points bit-identical to
+    # an uninterrupted run), an emergency snapshot commits, and the process
+    # exits cleanly for the existing resume path to continue
+    scope = (
+        fault.preemption_scope() if checkpoint is not None
+        else contextlib.nullcontext()
+    )
+    with scope:
+        while epoch < max_iter and not converged:
+            epoch_start = jax.tree_util.tree_map(jnp.copy, params)
+            # fresh accumulators every epoch: the chunk program donates its
+            # carry, so a reused zero scalar would be a deleted buffer
+            if make_carry is not None:
+                carry = make_carry(params)
+            else:
+                carry = (params, jnp.zeros((), dtype=jnp.float32),
+                         jnp.zeros((), dtype=jnp.float32))
+            n_rows = 0
 
-        def placed_blocks():
-            for batch, real in blocks_factory():
-                yield shard_batch(mesh, batch), real
+            def placed_blocks():
+                from flink_ml_tpu.fault.retry import with_retry
 
-        inflight: deque = deque()
-        for placed, real_rows in _prefetch(placed_blocks()):
-            carry, tick = chunk_fn(carry, placed)
-            n_rows += real_rows
-            if serialize_chunks:
-                jax.block_until_ready(tick)
-                continue
-            inflight.append(tick)
-            if len(inflight) > max_inflight_chunks:
-                jax.block_until_ready(inflight.popleft())
-        inflight.clear()
-        if finalize is not None:
-            params, loss_sum, w_sum, last_delta_dev = finalize(
-                carry, epoch_start
-            )
-        else:
-            params, loss_sum, w_sum = carry
-            last_delta_dev = _l2_delta(params, epoch_start)
-        pending.append((loss_sum, w_sum))
-        total_rows += n_rows
-        epoch += 1
-        obs.counter_add("train.ooc_epochs")
-        obs.counter_add("train.ooc_rows", n_rows)
-        if tol > 0.0:
-            final_delta = float(last_delta_dev)  # the per-epoch sync tol demands
-            converged = final_delta <= tol
-        at_boundary = checkpoint is not None and (
-            (epoch - start_epoch) % checkpoint.every_n_epochs == 0
-            or epoch == max_iter or converged
-        )
-        if at_boundary:
-            from flink_ml_tpu.iteration.checkpoint import (
-                prune_checkpoints,
-                save_checkpoint,
-            )
+                for batch, real in blocks_factory():
+                    # per-block H2D placement is a transient-failure
+                    # surface (device blips, injected chaos): retried with
+                    # backoff so one hiccup doesn't abort the epoch
+                    placed = with_retry(
+                        lambda b=batch: shard_batch(mesh, b), "ooc.place"
+                    )
+                    yield placed, real
 
-            losses.extend(_drain_pending(pending))
-            leaves, treedef = jax.tree_util.tree_flatten(params)
-            host_leaves = fetch_flat(*leaves)
-            host_params = jax.tree_util.tree_unflatten(treedef, host_leaves)
-            save_checkpoint(
-                checkpoint.directory, epoch - 1, host_params,
-                meta={"losses": losses, "converged": converged, "tol": tol,
-                      "final_delta": final_delta, **(meta_extra or {})},
+            inflight: deque = deque()
+            for placed, real_rows in _prefetch(placed_blocks()):
+                carry, tick = chunk_fn(carry, placed)
+                n_rows += real_rows
+                if serialize_chunks:
+                    jax.block_until_ready(tick)
+                    continue
+                inflight.append(tick)
+                if len(inflight) > max_inflight_chunks:
+                    jax.block_until_ready(inflight.popleft())
+            inflight.clear()
+            if finalize is not None:
+                params, loss_sum, w_sum, last_delta_dev = finalize(
+                    carry, epoch_start
+                )
+            else:
+                params, loss_sum, w_sum = carry
+                last_delta_dev = _l2_delta(params, epoch_start)
+            pending.append((loss_sum, w_sum))
+            total_rows += n_rows
+            epoch += 1
+            obs.counter_add("train.ooc_epochs")
+            obs.counter_add("train.ooc_rows", n_rows)
+            if tol > 0.0:
+                final_delta = float(last_delta_dev)  # per-epoch sync tol demands
+                converged = final_delta <= tol
+            # a run that just FINISHED (converged or out of epochs) at this
+            # boundary returns its result instead of exiting for resume —
+            # same rule as run_chunked_checkpoint's epilogue
+            preempt_now = (
+                checkpoint is not None and fault.preempted()
+                and not converged and epoch < max_iter
             )
-            prune_checkpoints(checkpoint.directory, checkpoint.keep)
+            at_boundary = checkpoint is not None and (
+                (epoch - start_epoch) % checkpoint.every_n_epochs == 0
+                or epoch == max_iter or converged
+            )
+            if at_boundary or preempt_now:
+                from flink_ml_tpu.iteration.checkpoint import (
+                    prune_checkpoints,
+                    save_checkpoint,
+                )
+
+                losses.extend(_drain_pending(pending))
+                leaves, treedef = jax.tree_util.tree_flatten(params)
+                host_leaves = fetch_flat(*leaves)
+                host_params = jax.tree_util.tree_unflatten(
+                    treedef, host_leaves
+                )
+                # health BEFORE the snapshot: the latest checkpoint must
+                # always be the last GOOD state, or the guard's rollback
+                # would resume straight back into the divergence
+                fault.check_health(
+                    losses, host_leaves, where="stream_train"
+                )
+
+                def _snapshot():
+                    save_checkpoint(
+                        checkpoint.directory, epoch - 1, host_params,
+                        meta={"losses": losses, "converged": converged,
+                              "tol": tol, "final_delta": final_delta,
+                              **(meta_extra or {})},
+                    )
+                    prune_checkpoints(checkpoint.directory, checkpoint.keep)
+
+                if preempt_now:
+                    fault.emergency_save(_snapshot)  # raises Preempted
+                _snapshot()
 
     losses.extend(_drain_pending(pending))
     leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -396,6 +434,7 @@ def train_out_of_core(
     else:
         host_leaves = fetch_flat(*leaves)
     host_params = jax.tree_util.tree_unflatten(treedef, host_leaves)
+    fault.check_health(losses, host_leaves, final_delta, where="stream_train")
     metrics.end_step(
         samples=total_rows, epochs=epoch - start_epoch,
         loss=losses[-1] if losses else 0.0,
@@ -757,6 +796,29 @@ def reservoir_sample_rows(chunks: Iterator[Table], extract, cap: int, rng,
     return sample[:filled] if filled < cap else sample, seen
 
 
+class _Crc32Writer:
+    """File wrapper that CRCs and counts every byte as ``np.save`` streams
+    it — the sidecar commit record in the SAME pass as the write.  Reading
+    the file back to checksum it would double the save epoch's I/O, and
+    spill-scale data is by definition too large for the page cache to
+    absorb the second pass."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def write(self, b):
+        import zlib
+
+        self.crc = zlib.crc32(b, self.crc)
+        self.size += len(b)
+        return self._f.write(b)
+
+    def __getattr__(self, name):  # tell/seek/flush pass through
+        return getattr(self._f, name)
+
+
 class BlockSpill:
     """Parse once, stream binary thereafter — in final packed layout.
 
@@ -776,6 +838,21 @@ class BlockSpill:
 
     The spill directory is owned by the caller and deleted via ``close()``
     (the estimator uses a per-fit temporary directory).
+
+    **Fault tolerance** (PR 3): every block carries a sidecar
+    ``block-NNNNNN.meta.json`` recording each leaf file's on-disk length
+    and CRC32, written AFTER the leaf files as the block's commit record.
+    Replay epochs validate the sidecars first — lengths every epoch (a
+    handful of stats), checksums once per file (the first replay pays one
+    extra read of pages ``device_put`` was about to pull anyway) — and a
+    corrupted or truncated block downgrades the epoch to a transparent
+    rebuild from the source factory instead of feeding the device garbage
+    or crashing.  An INTERRUPTED first epoch (exception mid-save, a
+    preemption) leaves ``complete=False`` with orphan block files on
+    disk; the next wrap restarts the save cleanly — stale blocks from the
+    dead attempt are truncated first, so a shorter re-run can never
+    replay a longer dead run's tail.  Block writes and replay opens ride
+    the transient-I/O retry policy (``fault.retry``).
     """
 
     def __init__(self, directory: str):
@@ -786,11 +863,24 @@ class BlockSpill:
         self.complete = False
         self._meta: list = []  # (n_rows, n_leaves) per block
         self._treedef = None
+        self._crc_checked = False  # first replay verifies checksums once
 
     def wrap(self, factory: Callable[[], Iterator]) -> Callable[[], Iterator]:
         def wrapped():
             if self.complete:
-                return self._load_iter()
+                if self._validate():
+                    return self._load_iter()
+                # corrupted/truncated spill: degrade to a rebuild from
+                # the source, never crash the epoch (the factory is the
+                # durable truth; the spill is just its binary cache)
+                obs.counter_add("fault.spill_rebuilds")
+                warnings.warn(
+                    "spill block validation failed (corrupted or "
+                    "truncated block files); rebuilding the spill from "
+                    "the source factory for this epoch",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
             return self._save_iter(factory())
 
         return wrapped
@@ -800,19 +890,75 @@ class BlockSpill:
 
         return os.path.join(self.directory, f"block-{i:06d}-{j:03d}.npy")
 
-    def _save_iter(self, items: Iterator):
+    def _block_meta_path(self, i: int) -> str:
         import os
 
+        return os.path.join(self.directory, f"block-{i:06d}.meta.json")
+
+    def _reset_partial(self):
+        """Truncate every artifact of a dead or invalid save attempt so
+        the restarted save starts from a clean directory — re-wrapping
+        after a mid-iteration failure must never interleave two attempts'
+        blocks (the old attempt may have written MORE blocks than the new
+        one will)."""
+        import os
+
+        self.complete = False
+        self._meta.clear()
+        self._crc_checked = False
+        for name in os.listdir(self.directory):
+            if name.startswith("block-"):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass  # best effort; a leftover .tmp never replays
+
+    def _save_iter(self, items: Iterator):
+        import json
+        import os
+
+        from flink_ml_tpu.fault.injection import maybe_fail
+        from flink_ml_tpu.fault.retry import with_retry
+
+        self._reset_partial()
         i = 0
         for batch, n_rows in items:
             with obs.phase("spill.write_block"):
                 leaves, treedef = jax.tree_util.tree_flatten(batch)
                 self._treedef = treedef
                 nbytes = 0
+                leaf_meta = []
                 for j, x in enumerate(leaves):
                     arr = np.asarray(x)
-                    _atomic_np_save(self._path(i, j), arr)
+                    p = self._path(i, j)
+
+                    def write(p=p, arr=arr):
+                        # tmp + rename atomicity with the CRC computed in
+                        # the same pass the bytes are written
+                        maybe_fail("spill.write")
+                        tmp = p + ".tmp"
+                        with open(tmp, "wb") as f:
+                            w = _Crc32Writer(f)
+                            np.save(w, arr)
+                            stats = {"size": w.size, "crc32": w.crc}
+                        os.replace(tmp, p)
+                        return stats
+
+                    leaf_meta.append(with_retry(write, "spill.write"))
                     nbytes += arr.nbytes
+                # sidecar last: the block's commit record (a crash between
+                # leaf writes leaves no sidecar -> validation fails -> the
+                # next wrap rebuilds); rides the same transient-I/O retry
+                # as the leaf writes it commits
+                def write_sidecar(i=i, n_rows=n_rows, leaf_meta=leaf_meta):
+                    mp = self._block_meta_path(i)
+                    with open(mp + ".tmp", "w") as f:
+                        json.dump(
+                            {"n_rows": int(n_rows), "leaves": leaf_meta}, f
+                        )
+                    os.replace(mp + ".tmp", mp)
+
+                with_retry(write_sidecar, "spill.write")
             obs.counter_add("spill.blocks_written")
             obs.counter_add("spill.bytes_written", nbytes)
             self._meta.append((int(n_rows), len(leaves)))
@@ -820,10 +966,54 @@ class BlockSpill:
             yield batch, n_rows
         self.complete = True
 
+    def _validate(self) -> bool:
+        """Do the on-disk blocks still match their commit records?
+
+        Lengths are checked every replay (cheap stats); CRCs once, on the
+        first replay (one extra read of pages the same epoch was about to
+        pull through ``device_put`` anyway).  Any mismatch — or an
+        injected ``spill.read`` fault — reports the spill as corrupt."""
+        import json
+        import os
+        import zlib
+
+        from flink_ml_tpu.fault.injection import InjectedFault, maybe_fail
+
+        try:
+            for i, (n_rows, n_leaves) in enumerate(self._meta):
+                maybe_fail("spill.read")
+                with open(self._block_meta_path(i)) as f:
+                    side = json.load(f)
+                if side["n_rows"] != n_rows or len(side["leaves"]) != n_leaves:
+                    return False
+                for j, leaf in enumerate(side["leaves"]):
+                    p = self._path(i, j)
+                    if os.path.getsize(p) != leaf["size"]:
+                        return False
+                    if not self._crc_checked:
+                        # streamed CRC: one whole-file read() would spike
+                        # host RSS by the largest leaf — spill-scale data
+                        # is exactly what must not be materialized at once
+                        crc = 0
+                        with open(p, "rb") as f:
+                            for chunk in iter(lambda: f.read(1 << 20), b""):
+                                crc = zlib.crc32(chunk, crc)
+                        if crc != leaf["crc32"]:
+                            return False
+        except (OSError, ValueError, KeyError, InjectedFault):
+            return False
+        self._crc_checked = True
+        return True
+
     def _load_iter(self):
+        from flink_ml_tpu.fault.retry import with_retry
+
         for i, (n_rows, n_leaves) in enumerate(self._meta):
             leaves = [
-                np.load(self._path(i, j), mmap_mode="r")
+                with_retry(
+                    lambda p=self._path(i, j): np.load(p, mmap_mode="r"),
+                    "spill.read",
+                )
                 for j in range(n_leaves)
             ]
             obs.counter_add("spill.blocks_replayed")
@@ -832,7 +1022,11 @@ class BlockSpill:
     def close(self):
         import shutil
 
+        # removes committed blocks AND any partial-save leftovers (.tmp
+        # staging files, orphan leaves of an interrupted attempt)
         shutil.rmtree(self.directory, ignore_errors=True)
+        self.complete = False
+        self._meta.clear()
 
 
 def scan_sparse_stream(chunked_table, vector_col: str, mb: int,

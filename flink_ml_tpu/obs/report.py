@@ -338,6 +338,50 @@ def diff_against_baseline(reports: List[dict], baseline: dict,
     return rows
 
 
+#: per-fit counters that mean the run leaned on the fault layer to pass —
+#: surfaced by ``--check`` so a chronically-retrying deployment is visible
+#: in the same place as a throughput regression
+_FAULT_COUNTER_PREFIXES = (
+    "fault.retries", "fault.rollbacks", "fault.fallbacks",
+    "fault.emergency_checkpoints", "fault.spill_rebuilds", "fault.giveups",
+)
+
+
+def fault_assisted_runs(reports: List[dict]) -> List[dict]:
+    """Fit reports whose per-fit counter delta shows fault-layer activity
+    (retries, rollbacks, fallbacks, emergency checkpoints): the run
+    PASSED, but only because something recovered — a fleet where these
+    trend up is degrading before it starts failing.
+
+    Only the LATEST fit report per name is judged (mirroring
+    :func:`latest_bench_by_name`): runs.jsonl is append-only, and
+    re-printing every historical fault-assisted fit forever would bury
+    the current signal under runs long since fixed.  Runs whose delta
+    also carries ``fault.injected`` are marked ``injected: True``: those
+    faults were deliberate chaos (a chaos-smoke or test run), not
+    environment degradation, and the CLI labels them so they never bury
+    the real signal."""
+    latest_fit: Dict[str, dict] = {}
+    for r in reports:
+        if r.get("kind") == "fit":
+            latest_fit[str(r.get("name", ""))] = r
+    flagged = []
+    for _, r in sorted(latest_fit.items()):
+        counters = (r.get("metrics") or {}).get("counters") or {}
+        hits = {
+            k: v for k, v in counters.items()
+            if v and any(k == p or k.startswith(p + ".")
+                         for p in _FAULT_COUNTER_PREFIXES)
+        }
+        if hits:
+            flagged.append(
+                {"name": r.get("name"), "ts": r.get("ts"),
+                 "git_sha": r.get("git_sha"), "fault_counters": hits,
+                 "injected": bool(counters.get("fault.injected"))}
+            )
+    return flagged
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flink_ml_tpu.obs",
@@ -357,6 +401,15 @@ def main(argv=None) -> int:
     with open(args.baseline) as f:
         baseline = json.load(f)
     reports = load_reports(args.reports)
+    # fault-assisted fits are flagged alongside the perf diff: a run that
+    # only passed by retrying is one environment blip from not passing
+    for fr in fault_assisted_runs(reports):
+        counters = ", ".join(
+            f"{k}={v:g}" for k, v in sorted(fr["fault_counters"].items())
+        )
+        tag = " (injected chaos)" if fr.get("injected") else ""
+        print(f"FAULT-ASSISTED fit {fr['name']}{tag} "
+              f"[{fr.get('git_sha', '')}]: {counters}")
     rows = diff_against_baseline(reports, baseline, args.threshold)
     if not rows:
         print("no measured baselines in"

@@ -18,6 +18,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flink_ml_tpu.fault.injection import maybe_fail
+from flink_ml_tpu.fault.watchdog import with_timeout
+
 
 def default_mesh(axis_names: Sequence[str] = ("data",), devices=None) -> Mesh:
     """All available devices laid out on the first axis (pure data parallel)."""
@@ -113,13 +116,22 @@ def agree_max(*values: int):
     value, all processes agree on the max, and packers accept the agreed
     value as a floor (``min_nnz_pad`` / ``min_steps``) — padding is free
     (pad entries carry zero weight), divergence is a hang or a silent
-    wrong answer."""
+    wrong answer.
+
+    Guarded by the ``FMT_AGREE_TIMEOUT_S`` watchdog: a dead peer turns the
+    allgather into an infinite hang, which the watchdog converts into a
+    :class:`~flink_ml_tpu.fault.watchdog.CollectiveTimeoutError` naming
+    this collective."""
+    maybe_fail("agree")
     if jax.process_count() == 1:
         return values
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(
-        np.asarray(values, np.int64)
+    gathered = with_timeout(
+        lambda: multihost_utils.process_allgather(
+            np.asarray(values, np.int64)
+        ),
+        name="agree_max",
     )
     return tuple(int(v) for v in np.max(gathered, axis=0))
 
@@ -128,12 +140,16 @@ def agree_sum(array: np.ndarray) -> np.ndarray:
     """Cross-process element-wise SUM (identity single-process) — e.g. the
     global feature-frequency vector every process must derive identically
     before a hot/cold split (each process only sees its own shard's
-    counts)."""
+    counts).  Same ``FMT_AGREE_TIMEOUT_S`` watchdog as :func:`agree_max`."""
+    maybe_fail("agree")
     if jax.process_count() == 1:
         return np.asarray(array)
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(np.asarray(array))
+    gathered = with_timeout(
+        lambda: multihost_utils.process_allgather(np.asarray(array)),
+        name="agree_sum",
+    )
     return np.sum(gathered, axis=0)
 
 
@@ -154,6 +170,8 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     :func:`local_data_parallel_size` shards and the per-process slice of the
     global batch size).  Single-process behavior is unchanged.
     """
+    maybe_fail("place.h2d")
+
     def _put(x):
         ndim = getattr(x, "ndim", 0)
         return _place_local_block(
@@ -267,6 +285,7 @@ def shard_batch_prefetched(mesh: Mesh, batch, axis: str = "data",
     only the transfer schedule differs."""
     if jax.process_count() > 1:
         return shard_batch(mesh, batch, axis=axis)
+    maybe_fail("place.h2d")
     if chunk_bytes is None:
         chunk_bytes = _placement_chunk_bytes()
     if min_bytes is None:

@@ -51,6 +51,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import warnings
 import weakref
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
@@ -346,11 +347,38 @@ class SlabPool:
         Only safe when the builder itself dispatches nothing collective."""
         import jax
 
+        from flink_ml_tpu.fault.injection import maybe_fail
+        from flink_ml_tpu.fault.retry import with_retry
+
         multi = jax.process_count() > 1 and agreed
         if not (_agreed_enabled() if multi else enabled()):
             return builder()
-        with self._lock:
-            entry = self._lookup(key)
+        try:
+            maybe_fail("slab.lookup")
+            with self._lock:
+                entry = self._lookup(key)
+        except Exception as exc:  # noqa: BLE001 - transient-only, see below
+            # graceful degradation, for EVERY pool consumer (training
+            # wrappers, KNN model load, the batched-apply path): the pool
+            # is an optimization, never a correctness dependency, so a
+            # TRANSIENT failure of the pool machinery itself builds
+            # direct.  Gated off agreed multi-process lookups — peers
+            # already synchronized on this lookup's hit/miss, and a
+            # unilateral local fallback would desync the collective
+            # schedule.  Non-transient errors are real bugs: re-raise.
+            from flink_ml_tpu.fault.retry import is_transient
+
+            if multi or not is_transient(exc):
+                raise
+            obs.counter_add("fault.fallbacks")
+            obs.counter_add("fault.fallbacks.slab_pool")
+            warnings.warn(
+                f"slab-pool lookup failed transiently ({exc!r}); falling "
+                "back to direct placement for this call",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return builder()
         local_hit = entry is not None
         if multi:
             from flink_ml_tpu.parallel.mesh import agree_max
@@ -366,7 +394,15 @@ class SlabPool:
         import time
 
         t0 = time.perf_counter()
-        value = builder()  # outside the lock: placement is the slow part
+        # outside the lock: placement is the slow part.  Cold placement is
+        # a transient-failure surface (device OOM blips, tunneled-backend
+        # hiccups, injected chaos) — retried with backoff; single-process
+        # only, because a multi-process builder's collectives must dispatch
+        # exactly once per peer agreement round
+        if jax.process_count() == 1:
+            value = with_retry(builder, "slab.build")
+        else:
+            value = builder()
         # the pack+place cost a warm fit skips — recorded HERE because
         # estimator paths resolve placement before the fused driver runs
         # (its own train.place covers only driver-internal placement)
@@ -531,6 +567,8 @@ def place_batch(mesh, batch, axis: str = "data"):
     leaves, treedef = jax.tree_util.tree_flatten(batch)
     refs: list = []
     token = tuple(array_token(leaf, refs) for leaf in leaves)
+    # transient pool-machinery failures degrade to a direct placement
+    # inside get_or_build — the pool is never a correctness dependency
     return pool().get_or_build(
         ("place", mesh, axis, treedef, token),
         lambda: shard_batch_prefetched(mesh, batch, axis=axis),

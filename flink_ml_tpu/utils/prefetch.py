@@ -27,6 +27,8 @@ import threading
 import warnings
 from typing import Iterator
 
+from flink_ml_tpu.fault.injection import maybe_fail
+
 __all__ = ["prefetch_iter"]
 
 
@@ -40,6 +42,10 @@ def prefetch_iter(items: Iterator, depth: int = 2,
     def work():
         try:
             for item in items:
+                # chaos hook: a producer-thread failure must surface at
+                # the consumer (re-raise mid-stream), never vanish with
+                # the thread — the contract the fault layer leans on
+                maybe_fail("prefetch.produce")
                 q.put(item)
         except BaseException as exc:  # noqa: BLE001 - re-raised at consumer
             failure.append(exc)

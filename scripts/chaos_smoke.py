@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Chaos smoke (ISSUE 3): the tier-1-fast fit matrix under seeded fault
+injection, asserting convergence parity and nonzero retry accounting.
+
+Legs (all on the virtual 8-device CPU mesh):
+
+  1. **fused GLM** — fit under an injected cold-placement fault plus a
+     slab-pool lookup fault; params must EQUAL the fault-free run's
+     (retry and fallback are schedule-transparent), with ``fault.retries``
+     and ``fault.fallbacks`` nonzero in the fit RunReports.
+  2. **streamed out-of-core GLM** — spill-backed fit under an injected
+     spill-read corruption plus a placement fault; params must EQUAL the
+     fault-free run's (the corrupted epoch rebuilds from source).
+  3. **mid-run SIGTERM/resume** — for BOTH paths, a worker subprocess
+     receives a real SIGTERM mid-fit, commits an emergency checkpoint,
+     exits 0; a resume subprocess completes the run and its params must be
+     BIT-IDENTICAL to an uninterrupted run's.
+  4. **dead-peer watchdog** — ``agree_max`` against a wedged allgather
+     must raise the ``FMT_AGREE_TIMEOUT_S`` diagnostic, not hang.
+
+Run directly (``python scripts/chaos_smoke.py``) or via the CI
+``chaos-smoke`` job.  Exit code 0 = all parity and accounting assertions
+held.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# environment before jax import: virtual mesh, x64 (match the test suite),
+# telemetry on so RunReports carry the fault accounting this smoke asserts
+os.environ.setdefault("FLINK_ML_TPU_COMPILE_CACHE", "off")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+if "--worker" not in sys.argv:
+    # telemetry in the parent only: the SIGTERM workers run fault-free
+    # fits of the same estimators, and their clean fit reports would
+    # otherwise steal the latest-per-name slot fault_assisted_runs judges
+    os.environ["FMT_OBS"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+N, DIM, CHUNK_ROWS = 256, 5, 64
+
+
+def make_xy():
+    rng = np.random.RandomState(17)
+    X = rng.randn(N, DIM)
+    y = (X @ rng.randn(DIM) > 0).astype(np.float64)
+    return X, y
+
+
+def dense_table():
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    X, y = make_xy()
+    return Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X.astype(np.float32), "label": y},
+    )
+
+
+def chunked_table(spill=True):
+    from flink_ml_tpu.table.schema import Schema
+    from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+    X, y = make_xy()
+    rows = [tuple(X[i]) + (y[i],) for i in range(N)]
+    schema = Schema([f"f{i}" for i in range(DIM)] + ["label"],
+                    ["double"] * (DIM + 1))
+    return ChunkedTable(CollectionSource(rows, schema), CHUNK_ROWS,
+                        spill=spill)
+
+
+def fused_est(ckpt=None):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    est = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(4)
+    )
+    if ckpt:
+        est.set_checkpoint_dir(str(ckpt)).set_checkpoint_interval(1)
+    return est
+
+
+def streamed_est(ckpt=None):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    est = (
+        LogisticRegression()
+        .set_feature_cols([f"f{i}" for i in range(DIM)])
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(4)
+        .set_global_batch_size(32)
+    )
+    if ckpt:
+        est.set_checkpoint_dir(str(ckpt)).set_checkpoint_interval(1)
+    return est
+
+
+def auc(scores, y):
+    """Rank-statistic AUC (no sklearn in the image)."""
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = y > 0
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def params_of(model):
+    return np.asarray(model.coefficients()), float(model.intercept())
+
+
+# -- worker modes (SIGTERM legs run in real subprocesses) ---------------------
+
+
+def worker(mode: str, ckpt: str) -> None:
+    if mode.startswith("fused"):
+        if mode == "fused-crash":
+            # die to a real SIGTERM right after the first snapshot commits
+            import flink_ml_tpu.iteration.checkpoint as ck
+
+            orig, seen = ck.save_checkpoint, {"n": 0}
+
+            def killing_save(*a, **kw):
+                path = orig(*a, **kw)
+                seen["n"] += 1
+                if seen["n"] == 1:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                return path
+
+            ck.save_checkpoint = killing_save
+        model = fused_est(ckpt).fit(dense_table())
+    else:
+        table = chunked_table(spill=False)
+        if mode == "ooc-crash":
+            served = {"n": 0}
+            orig_chunks = type(table).chunks
+
+            def killing_chunks(self):
+                for t in orig_chunks(self):
+                    served["n"] += 1
+                    if served["n"] == N // CHUNK_ROWS + 2:  # mid-epoch 2
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    yield t
+
+            type(table).chunks = killing_chunks
+        model = streamed_est(ckpt).fit(table)
+    w, b = params_of(model)
+    print("PARAMS " + " ".join(f"{v:.17g}" for v in list(w) + [b]),
+          flush=True)
+
+
+def run_worker(mode, ckpt):
+    env = dict(os.environ)
+    env.pop("FMT_FAULT_INJECT", None)
+    # the SIGTERM workers run fault-FREE fits of the same estimators; with
+    # obs on they would append clean fit reports AFTER the chaos fits and
+    # steal the latest-per-name slot fault_assisted_runs judges
+    env["FMT_OBS"] = "0"
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", mode,
+         str(ckpt)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+
+
+def sigterm_resume_leg(mode: str, tmp: str) -> None:
+    plain = run_worker(f"{mode}-run", os.path.join(tmp, f"{mode}-ref"))
+    assert plain.returncode == 0, plain.stderr
+    ref = [ln for ln in plain.stdout.splitlines() if ln.startswith("PARAMS")]
+    assert ref, plain.stdout
+
+    ckpt = os.path.join(tmp, f"{mode}-crash")
+    crashed = run_worker(f"{mode}-crash", ckpt)
+    assert crashed.returncode == 0, (
+        f"{mode}: preempted worker must exit cleanly (0), got "
+        f"{crashed.returncode}: {crashed.stderr[-2000:]}"
+    )
+    assert "PARAMS" not in crashed.stdout, "worker survived its SIGTERM"
+    assert os.listdir(ckpt), "no emergency checkpoint committed"
+
+    resumed = run_worker(f"{mode}-run", ckpt)
+    assert resumed.returncode == 0, resumed.stderr
+    res = [ln for ln in resumed.stdout.splitlines()
+           if ln.startswith("PARAMS")]
+    assert res == ref, (
+        f"{mode}: resumed params are not bit-identical\n{res}\n{ref}"
+    )
+    print(f"  {mode}: SIGTERM -> emergency checkpoint -> exact resume OK")
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2], sys.argv[3])
+        return 0
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    from flink_ml_tpu import fault, obs
+    from flink_ml_tpu.table import slab_pool
+
+    X, y = make_xy()
+
+    # -- leg 1: fused GLM under a cold-placement fault (retried) --------------
+    base_model = fused_est().fit(dense_table())
+    w0, b0 = params_of(base_model)
+    slab_pool.reset_pool()
+    obs.reset()
+    fault.configure("place.h2d@1", seed=0)
+    try:
+        chaos_model = fused_est().fit(dense_table())
+    finally:
+        fault.configure(None)
+    w1, b1 = params_of(chaos_model)
+    np.testing.assert_array_equal(w1, w0)
+    assert b1 == b0
+    counters = obs.registry().snapshot()["counters"]
+    assert counters.get("fault.retries", 0) >= 1, counters
+    assert counters.get("fault.injected", 0) >= 1, counters
+    s0 = auc(X.astype(np.float32) @ w0 + b0, y)
+    s1 = auc(X.astype(np.float32) @ w1 + b1, y)
+    assert s1 == s0
+    print(f"  fused GLM: chaos params exact, AUC parity {s1:.4f}, "
+          f"retries={counters.get('fault.retries'):g}")
+
+    # -- leg 1b: fused GLM under a slab-pool lookup fault (degrades) ----------
+    import warnings
+
+    slab_pool.reset_pool()
+    obs.reset()
+    fault.configure("slab.lookup@1", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            pool_chaos = fused_est().fit(dense_table())
+    finally:
+        fault.configure(None)
+    w2, b2 = params_of(pool_chaos)
+    np.testing.assert_array_equal(w2, w0)
+    assert b2 == b0
+    counters = obs.registry().snapshot()["counters"]
+    assert counters.get("fault.fallbacks", 0) >= 1, counters
+    print("  fused GLM: pool-lookup fault degraded to direct placement, "
+          f"params exact, fallbacks={counters.get('fault.fallbacks'):g}")
+
+    # -- leg 2: streamed out-of-core under spill corruption + placement fault
+    obs.reset()
+    base_stream = streamed_est().fit(chunked_table())
+    sw0, sb0 = params_of(base_stream)
+    obs.reset()
+    fault.configure("spill.read@1,place.h2d@1", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            chaos_stream = streamed_est().fit(chunked_table())
+    finally:
+        fault.configure(None)
+    sw1, sb1 = params_of(chaos_stream)
+    np.testing.assert_array_equal(sw1, sw0)
+    assert sb1 == sb0
+    counters = obs.registry().snapshot()["counters"]
+    assert counters.get("fault.spill_rebuilds", 0) >= 1, counters
+    assert counters.get("fault.retries", 0) >= 1, counters
+    print("  streamed ooc: spill corruption rebuilt, params exact, "
+          f"retries={counters.get('fault.retries'):g}")
+
+    # -- leg 3: SIGTERM mid-run -> emergency checkpoint -> exact resume -------
+    with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as tmp:
+        sigterm_resume_leg("fused", tmp)
+        sigterm_resume_leg("ooc", tmp)
+
+    # -- leg 4: dead-peer watchdog --------------------------------------------
+    import time
+
+    from flink_ml_tpu.fault.watchdog import CollectiveTimeoutError
+    from flink_ml_tpu.parallel import mesh
+
+    real_count = jax.process_count
+    jax.process_count = lambda: 2
+    from jax.experimental import multihost_utils
+
+    real_gather = multihost_utils.process_allgather
+    multihost_utils.process_allgather = lambda *a, **k: time.sleep(120)
+    os.environ["FMT_AGREE_TIMEOUT_S"] = "1.0"
+    t0 = time.perf_counter()
+    try:
+        mesh.agree_max(7)
+        raise AssertionError("agree_max with a dead peer did not raise")
+    except CollectiveTimeoutError as exc:
+        took = time.perf_counter() - t0
+        assert took < 10.0 and "agree_max" in str(exc)
+        print(f"  watchdog: dead-peer agree_max diagnosed in {took:.1f}s")
+    finally:
+        jax.process_count = real_count
+        multihost_utils.process_allgather = real_gather
+        os.environ.pop("FMT_AGREE_TIMEOUT_S", None)
+
+    # -- RunReport accounting: the chaos fits are self-identifying ------------
+    from flink_ml_tpu.obs.report import fault_assisted_runs, load_reports
+
+    flagged = fault_assisted_runs(load_reports(reports_dir))
+    assert flagged, "no fit RunReport carried fault counters"
+    names = {json.dumps(sorted(f["fault_counters"])) for f in flagged}
+    print(f"  RunReports: {len(flagged)} fault-assisted fit(s) flagged "
+          f"({len(names)} distinct counter sets)")
+    print("chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,662 @@
+"""Fault-tolerance layer (ISSUE 3): injection, retry, watchdog, guarded
+fits, spill/checkpoint crash-consistency, SIGTERM kill-and-resume."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.fault import guard, injection, retry, watchdog
+from flink_ml_tpu.fault.injection import InjectedFault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(tmp_path, monkeypatch):
+    # fit RunReports must land in a per-test dir, never the committed
+    # reports/ (chaos counters there would pollute every obs --check)
+    monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path / "_reports"))
+    injection.reset()
+    guard.reset_preempted()
+    yield
+    injection.reset()
+    guard.reset_preempted()
+    obs.disable()
+    obs.reset()
+
+
+def _dense_table(n=256, dim=5, seed=3):
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    return Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+
+
+def _logreg(lr=0.5, iters=3, **extra):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    est = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(lr).set_max_iter(iters)
+    )
+    for k, v in extra.items():
+        getattr(est, f"set_{k}")(v)
+    return est
+
+
+class TestInjectionRegistry:
+    def test_nth_call_fires_once(self):
+        injection.configure("x.y@2")
+        injection.maybe_fail("x.y")  # call 1 passes
+        with pytest.raises(InjectedFault):
+            injection.maybe_fail("x.y")  # call 2 fires
+        injection.maybe_fail("x.y")  # call 3 passes again
+        assert injection.fire_count("x.y") == 1
+
+    def test_sticky_fires_from_n(self):
+        injection.configure("x.y@2+")
+        injection.maybe_fail("x.y")
+        for _ in range(3):
+            with pytest.raises(InjectedFault):
+                injection.maybe_fail("x.y")
+        assert injection.fire_count("x.y") == 3
+
+    def test_probability_mode_is_seeded_deterministic(self):
+        def run(seed):
+            injection.configure("p~0.5", seed=seed)
+            fired = []
+            for i in range(32):
+                try:
+                    injection.maybe_fail("p")
+                    fired.append(0)
+                except InjectedFault:
+                    fired.append(1)
+            return fired
+
+        a, b = run(7), run(7)
+        assert a == b and 0 < sum(a) < 32
+        assert run(8) != a  # a different seed is a different schedule
+
+    def test_unknown_point_and_inactive_are_noops(self):
+        injection.maybe_fail("never.configured")
+        injection.configure("a@1")
+        injection.maybe_fail("other.point")
+        assert not injection.fire_count()
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError):
+            injection.configure("point-without-schedule")
+        with pytest.raises(ValueError):
+            injection.configure("x@0")
+
+
+class TestRetry:
+    def test_transient_retried_then_succeeds(self):
+        obs.enable()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        policy = retry.RetryPolicy(attempts=3, base_delay_s=0.001)
+        assert retry.with_retry(flaky, "t", policy) == "ok"
+        assert obs.registry().counter("fault.retries") == 2
+        assert obs.registry().counter("fault.retries.t") == 2
+
+    def test_nontransient_raises_immediately(self):
+        calls = {"n": 0}
+
+        def bug():
+            calls["n"] += 1
+            raise ValueError("real bug")
+
+        with pytest.raises(ValueError):
+            retry.with_retry(bug, "t", retry.RetryPolicy(attempts=5))
+        assert calls["n"] == 1
+
+    def test_giveup_reraises_and_counts(self):
+        obs.enable()
+
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry.with_retry(
+                always, "t", retry.RetryPolicy(attempts=2, base_delay_s=0.001)
+            )
+        assert obs.registry().counter("fault.giveups") == 1
+
+    def test_transient_statuses(self):
+        assert retry.is_transient(InjectedFault("x", 1))
+        assert retry.is_transient(OSError())
+        assert retry.is_transient(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+        assert not retry.is_transient(RuntimeError("shape mismatch"))
+        assert not retry.is_transient(ValueError("nope"))
+
+    def test_backoff_grows_and_caps(self):
+        p = retry.RetryPolicy(attempts=9, base_delay_s=0.1, max_delay_s=0.4,
+                              factor=2.0, jitter=0.0)
+        assert [p.delay(k) for k in (1, 2, 3, 4)] == [0.1, 0.2, 0.4, 0.4]
+
+
+class TestWatchdog:
+    def test_timeout_names_the_collective(self):
+        t0 = time.perf_counter()
+        with pytest.raises(watchdog.CollectiveTimeoutError) as ei:
+            watchdog.with_timeout(
+                lambda: time.sleep(30), "agree_max", timeout_s=0.3
+            )
+        assert time.perf_counter() - t0 < 5.0
+        assert "agree_max" in str(ei.value)
+        assert "FMT_AGREE_TIMEOUT_S" in str(ei.value)
+
+    def test_result_and_errors_pass_through(self):
+        assert watchdog.with_timeout(lambda: 42, "x", timeout_s=1.0) == 42
+
+        def boom():
+            raise ValueError("the collective's own error")
+
+        with pytest.raises(ValueError, match="own error"):
+            watchdog.with_timeout(boom, "x", timeout_s=1.0)
+
+    def test_zero_timeout_is_identity(self, monkeypatch):
+        monkeypatch.delenv("FMT_AGREE_TIMEOUT_S", raising=False)
+        assert watchdog.with_timeout(lambda: "v", "x") == "v"
+
+    def test_agree_max_dead_peer_raises_diagnostic(self, monkeypatch):
+        """The acceptance scenario: a dead peer wedges the allgather;
+        agree_max must raise the watchdog diagnostic, not hang."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        from flink_ml_tpu.parallel import mesh
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda *_a, **_k: time.sleep(60),
+        )
+        monkeypatch.setenv("FMT_AGREE_TIMEOUT_S", "0.3")
+        with pytest.raises(watchdog.CollectiveTimeoutError) as ei:
+            mesh.agree_max(3)
+        assert ei.value.collective == "agree_max"
+
+    def test_agree_injection_point(self):
+        from flink_ml_tpu.parallel import mesh
+
+        injection.configure("agree@1")
+        with pytest.raises(InjectedFault):
+            mesh.agree_max(1)
+
+
+class TestGuard:
+    def test_check_health_raises_on_nonfinite(self):
+        guard.check_health([0.5, 0.2], [np.ones(3)])  # healthy: no raise
+        # a transient early overflow the run RECOVERED from is healthy —
+        # only the current (last) loss judges the state
+        guard.check_health([float("inf"), 0.5], [np.ones(3)])
+        with pytest.raises(guard.NumericHealthError):
+            guard.check_health([0.5, float("nan")], [])
+        with pytest.raises(guard.NumericHealthError):
+            guard.check_health([], [np.array([1.0, np.inf])])
+        with pytest.raises(guard.NumericHealthError):
+            guard.check_health([], [], delta=float("nan"))
+
+    def test_check_health_disabled(self, monkeypatch):
+        monkeypatch.setenv("FMT_GUARD", "0")
+        guard.check_health([float("nan")], [])  # no raise
+
+    def test_run_guarded_backs_off_and_recovers(self, monkeypatch):
+        monkeypatch.setenv("FMT_GUARD_LR_BACKOFF", "0.25")
+        obs.enable()
+        seen = []
+
+        def attempt(scale):
+            seen.append(scale)
+            if len(seen) < 3:
+                raise guard.NumericHealthError("diverged")
+            return "model"
+
+        with pytest.warns(RuntimeWarning):
+            assert guard.run_guarded(attempt) == "model"
+        assert seen == [1.0, 0.25, 0.0625]
+        assert obs.registry().counter("fault.rollbacks") == 2
+
+    def test_run_guarded_gives_up_with_history(self, monkeypatch):
+        monkeypatch.setenv("FMT_GUARD_MAX_RETRIES", "1")
+
+        def attempt(scale):
+            raise guard.NumericHealthError("still bad")
+
+        with pytest.warns(RuntimeWarning), \
+                pytest.raises(guard.NumericHealthError, match="2 attempt"):
+            guard.run_guarded(attempt)
+
+    def test_diverged_fit_rolls_back_to_colder_lr(self, monkeypatch):
+        """End to end: an absurd learning rate drives the fused GLM fit to
+        non-finite params; the guard retries at a backed-off scale and the
+        returned model is finite, with the rollback accounted."""
+        from flink_ml_tpu.lib import LinearRegression
+
+        monkeypatch.setenv("FMT_GUARD_LR_BACKOFF", "1e-9")
+        obs.enable()
+        t = _dense_table()
+        est = (
+            LinearRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(1e6).set_max_iter(6)  # squared loss explodes
+        )
+        with pytest.warns(RuntimeWarning):
+            model = est.fit(t)
+        assert np.all(np.isfinite(model.coefficients()))
+        assert obs.registry().counter("fault.rollbacks") >= 1
+        snap = obs.registry().snapshot()["counters"]
+        assert snap.get("fault.numeric_errors", 0) >= 1
+
+
+class TestPlacementFaults:
+    def test_injected_placement_fault_is_retried(self):
+        """A transient H2D failure inside the pooled cold placement is
+        retried with backoff; the fit completes and matches fault-free."""
+        t = _dense_table()
+        reference = _logreg().fit(t).coefficients()
+        from flink_ml_tpu.table import slab_pool
+
+        slab_pool.reset_pool()
+        obs.enable()
+        injection.configure("place.h2d@1")
+        model = _logreg().fit(_dense_table())
+        np.testing.assert_array_equal(model.coefficients(), reference)
+        assert obs.registry().counter("fault.retries") >= 1
+        assert injection.fire_count("place.h2d") == 1
+
+    def test_pool_lookup_fault_degrades_to_streamed_placement(self):
+        t = _dense_table()
+        reference = _logreg().fit(t).coefficients()
+        from flink_ml_tpu.table import slab_pool
+
+        slab_pool.reset_pool()
+        obs.enable()
+        injection.configure("slab.lookup@1")
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            model = _logreg().fit(_dense_table())
+        np.testing.assert_array_equal(model.coefficients(), reference)
+        assert obs.registry().counter("fault.fallbacks") >= 1
+
+    def test_prefetch_producer_fault_surfaces_at_consumer(self):
+        from flink_ml_tpu.utils.prefetch import prefetch_iter
+
+        injection.configure("prefetch.produce@3")
+        out = []
+        with pytest.raises(InjectedFault):
+            for x in prefetch_iter(iter(range(6)), depth=2, name="t"):
+                out.append(x)
+        assert out == [0, 1]
+
+
+class TestSpillFaults:
+    def _factory(self, n_blocks=3, dim=3):
+        def factory():
+            for i in range(n_blocks):
+                yield (
+                    np.full((4, dim), i, np.float32),
+                    np.arange(4, dtype=np.float32) + i,
+                ), 4
+
+        return factory
+
+    def test_partial_write_restarts_clean(self, tmp_path):
+        """RED for the pre-fix BlockSpill: an interrupted first epoch left
+        stale meta + orphan blocks, and the restarted save APPENDED to
+        them — replay then yielded the dead attempt's blocks too."""
+        from flink_ml_tpu.lib.out_of_core import BlockSpill
+
+        spill = BlockSpill(str(tmp_path / "s"))
+        good = self._factory(3)
+
+        def dying():
+            yield from list(good())[:2]
+            raise RuntimeError("interrupted mid-iteration")
+
+        with pytest.raises(RuntimeError, match="interrupted"):
+            list(spill.wrap(lambda: dying())())
+        assert not spill.complete
+        # orphan artifacts of the dead attempt are on disk (the red
+        # precondition the restart must truncate)
+        assert any(
+            f.startswith("block-") for f in os.listdir(spill.directory)
+        )
+        out = list(spill.wrap(good)())
+        assert len(out) == 3 and spill.complete
+        replay = list(spill.wrap(good)())
+        assert len(replay) == 3
+        for (got, n), (want, wn) in zip(replay, good()):
+            assert n == wn
+            np.testing.assert_array_equal(np.asarray(got[0]), want[0])
+            np.testing.assert_array_equal(np.asarray(got[1]), want[1])
+        spill.close()
+        assert not os.path.exists(spill.directory)
+
+    def test_corrupted_block_rebuilds_from_source(self, tmp_path):
+        from flink_ml_tpu.lib.out_of_core import BlockSpill
+
+        obs.enable()
+        spill = BlockSpill(str(tmp_path / "s"))
+        good = self._factory(3)
+        list(spill.wrap(good)())  # epoch 1: save
+        with open(spill._path(1, 0), "r+b") as f:  # truncate a leaf
+            f.truncate(8)
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            out = list(spill.wrap(good)())  # epoch 2: rebuild, no crash
+        assert len(out) == 3
+        assert obs.registry().counter("fault.spill_rebuilds") == 1
+        # the rebuild recommitted valid blocks: replay works again
+        replay = list(spill.wrap(good)())
+        np.testing.assert_array_equal(
+            np.asarray(replay[1][0][0]), list(good())[1][0][0]
+        )
+
+    def test_flipped_byte_caught_by_crc(self, tmp_path):
+        from flink_ml_tpu.lib.out_of_core import BlockSpill
+
+        spill = BlockSpill(str(tmp_path / "s"))
+        good = self._factory(2)
+        list(spill.wrap(good)())
+        p = spill._path(0, 0)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:  # same length, different content
+            f.seek(size - 4)
+            f.write(b"\xff\xff\xff\xff")
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            list(spill.wrap(good)())
+
+    def test_injected_spill_read_fault_rebuilds(self, tmp_path):
+        from flink_ml_tpu.lib.out_of_core import BlockSpill
+
+        spill = BlockSpill(str(tmp_path / "s"))
+        good = self._factory(2)
+        list(spill.wrap(good)())
+        injection.configure("spill.read@1")
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            out = list(spill.wrap(good)())
+        assert len(out) == 2
+
+    def test_spill_write_fault_retried_transparently(self, tmp_path):
+        from flink_ml_tpu.lib.out_of_core import BlockSpill
+
+        obs.enable()
+        injection.configure("spill.write@2")
+        spill = BlockSpill(str(tmp_path / "s"))
+        good = self._factory(3)
+        out = list(spill.wrap(good)())
+        assert len(out) == 3 and spill.complete
+        assert obs.registry().counter("fault.retries.spill.write") == 1
+        assert len(list(spill.wrap(good)())) == 3  # replay valid
+
+    def test_streamed_fit_with_spill_corruption_matches_fault_free(
+        self, tmp_path
+    ):
+        """Chaos parity, spill leg: a corrupted spill read mid-fit must
+        not change the trained model (the epoch rebuilds from source)."""
+        from flink_ml_tpu.table.schema import Schema
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        rng = np.random.RandomState(5)
+        X = rng.randn(200, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        rows = [tuple(X[i]) + (y[i],) for i in range(200)]
+        schema = Schema([f"f{i}" for i in range(4)] + ["label"],
+                        ["double"] * 5)
+
+        def fit():
+            from flink_ml_tpu.lib import LogisticRegression
+
+            return (
+                LogisticRegression()
+                .set_feature_cols([f"f{i}" for i in range(4)])
+                .set_label_col("label").set_prediction_col("p")
+                .set_learning_rate(0.5).set_max_iter(3)
+                .set_global_batch_size(32)
+                .fit(ChunkedTable(CollectionSource(rows, schema), 64,
+                                  spill=True))
+            )
+
+        reference = fit().coefficients()
+        injection.configure("spill.read@1")
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            model = fit()
+        np.testing.assert_array_equal(model.coefficients(), reference)
+
+
+class TestCheckpointCrashConsistency:
+    def test_orphan_sidecar_swept_on_scan(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import (
+            latest_checkpoint,
+            save_checkpoint,
+        )
+
+        save_checkpoint(str(tmp_path), 2, (np.arange(3.0),))
+        orphan = tmp_path / "epoch_5.npz.meta.json"
+        orphan.write_text(json.dumps({"epoch": 5}))
+        stale_tmp = tmp_path / "epoch_6.npz.tmp"
+        stale_tmp.write_bytes(b"partial")
+        latest = latest_checkpoint(str(tmp_path))
+        assert latest is not None and latest.endswith("epoch_2.npz")
+        assert not orphan.exists()
+        assert not stale_tmp.exists()
+
+    def test_data_written_before_meta(self, tmp_path, monkeypatch):
+        """A crash during the DATA write must leave NO sidecar (meta is
+        the commit record, written last) — the pre-fix order stranded an
+        orphan sidecar describing data that never existed."""
+        from flink_ml_tpu.iteration.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        injection.configure("ckpt.save@1")
+        params = (np.arange(4.0),)
+        with pytest.raises(InjectedFault):
+            save_checkpoint(str(tmp_path), 0, params)
+        assert not any(
+            n.endswith(".meta.json") for n in os.listdir(tmp_path)
+        ), "orphan sidecar committed before its data"
+        injection.reset()
+        path = save_checkpoint(str(tmp_path), 0, params)
+        loaded, meta = load_checkpoint(path, like=params)
+        np.testing.assert_array_equal(loaded[0], params[0])
+        assert meta["epoch"] == 0
+
+    def test_save_fault_retried(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        obs.enable()
+        injection.configure("ckpt.save@1")
+        params = (np.arange(4.0),)
+        path = save_checkpoint(str(tmp_path), 1, params)
+        assert obs.registry().counter("fault.retries.ckpt.save") == 1
+        loaded, meta = load_checkpoint(path, like=params)
+        np.testing.assert_array_equal(loaded[0], params[0])
+
+
+class TestObsFlagging:
+    def test_fault_assisted_runs_flagged(self):
+        from flink_ml_tpu.obs.report import fault_assisted_runs
+
+        reports = [
+            {"kind": "fit", "name": "A", "git_sha": "x",
+             "metrics": {"counters": {"fault.retries": 2.0,
+                                      "fault.retries.ckpt.save": 2.0,
+                                      "train.epochs": 3}}},
+            {"kind": "fit", "name": "B",
+             "metrics": {"counters": {"train.epochs": 3}}},
+            {"kind": "bench", "name": "C",
+             "metrics": {"counters": {"fault.retries": 1}}},
+            {"kind": "fit", "name": "D",
+             "metrics": {"counters": {"fault.rollbacks": 1}}},
+        ]
+        flagged = fault_assisted_runs(reports)
+        assert [f["name"] for f in flagged] == ["A", "D"]
+        assert flagged[0]["fault_counters"] == {
+            "fault.retries": 2.0, "fault.retries.ckpt.save": 2.0,
+        }
+
+    def test_retrying_fit_report_carries_fault_delta(self, tmp_path,
+                                                     monkeypatch):
+        """End to end: a fit that passed only by retrying writes a
+        RunReport whose per-fit counter delta the CLI flags."""
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        from flink_ml_tpu.obs.report import fault_assisted_runs, load_reports
+        from flink_ml_tpu.table import slab_pool
+
+        slab_pool.reset_pool()
+        obs.enable()
+        injection.configure("place.h2d@1")
+        _logreg().fit(_dense_table(seed=21))
+        flagged = fault_assisted_runs(load_reports(str(tmp_path)))
+        assert flagged and flagged[-1]["name"] == "LogisticRegression"
+        assert flagged[-1]["fault_counters"].get("fault.retries", 0) >= 1
+
+
+class TestPreemption:
+    N, DIM, CHUNK = 192, 4, 48
+
+    def _chunked(self, kill_at=None):
+        from flink_ml_tpu.table.schema import Schema
+        from flink_ml_tpu.table.sources import ChunkedTable, CollectionSource
+
+        rng = np.random.RandomState(9)
+        X = rng.randn(self.N, self.DIM)
+        y = (X @ rng.randn(self.DIM) > 0).astype(np.float64)
+        rows = [tuple(X[i]) + (y[i],) for i in range(self.N)]
+        schema = Schema([f"f{i}" for i in range(self.DIM)] + ["label"],
+                        ["double"] * (self.DIM + 1))
+        source = CollectionSource(rows, schema)
+
+        class Killing(ChunkedTable):
+            served = 0
+
+            def chunks(inner):
+                for t in super().chunks():
+                    Killing.served += 1
+                    if Killing.served == kill_at:
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    yield t
+
+        cls = ChunkedTable if kill_at is None else Killing
+        return cls(source, self.CHUNK)
+
+    def _fit(self, table, ckpt_dir):
+        from flink_ml_tpu.lib import LogisticRegression
+
+        return (
+            LogisticRegression()
+            .set_feature_cols([f"f{i}" for i in range(self.DIM)])
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(0.5).set_max_iter(4)
+            .set_global_batch_size(32)
+            .set_checkpoint_dir(str(ckpt_dir)).set_checkpoint_interval(2)
+            .fit(table)
+        )
+
+    def test_sigterm_mid_epoch_emergency_checkpoint_then_exact_resume(
+        self, tmp_path
+    ):
+        """SIGTERM lands mid-epoch-1 of a streamed fit; the guard finishes
+        the epoch, commits an emergency snapshot OFF the every-2-epochs
+        cadence, raises a clean SystemExit(0) — and the resumed run is
+        bit-identical to the uninterrupted one."""
+        from flink_ml_tpu.iteration.checkpoint import latest_checkpoint
+
+        obs.enable()
+        reference = self._fit(self._chunked(), tmp_path / "ref")
+
+        with pytest.warns(RuntimeWarning, match="emergency"), \
+                pytest.raises(SystemExit) as ei:
+            self._fit(self._chunked(kill_at=2), tmp_path / "c")
+        assert ei.value.code == 0
+        # only epoch 1 completed -> emergency snapshot epoch_0: off the
+        # every-2-epochs cadence (the first regular boundary is epoch_1)
+        latest = latest_checkpoint(str(tmp_path / "c"))
+        assert latest is not None and latest.endswith("epoch_0.npz")
+        assert obs.registry().counter("fault.emergency_checkpoints") == 1
+
+        guard.reset_preempted()
+        resumed = self._fit(self._chunked(), tmp_path / "c")
+        np.testing.assert_array_equal(
+            resumed.coefficients(), reference.coefficients()
+        )
+        assert resumed.intercept() == reference.intercept()
+
+    def test_preemption_on_finished_run_returns_result(self, tmp_path,
+                                                       monkeypatch):
+        """A SIGTERM that lands on the run's FINAL epoch must not discard
+        the completed fit for a pointless resume round-trip: the driver
+        returns the result (the listener-path driver used to exit)."""
+        import flink_ml_tpu.fault as fault_pkg
+
+        monkeypatch.setattr(fault_pkg, "preempted", lambda: True)
+        from flink_ml_tpu.lib import LogisticRegression
+
+        model = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(0.5).set_max_iter(1)
+            .set_checkpoint_dir(str(tmp_path / "ck"))
+            .set_checkpoint_interval(1)
+            .fit(_dense_table())
+        )
+        assert model.train_epochs_ == 1
+        assert np.all(np.isfinite(model.coefficients()))
+
+    def test_subprocess_kill_and_resume_bit_identical(self, tmp_path):
+        """The satellite's full scenario in real processes: worker dies to
+        a delivered SIGTERM with exit code 0, a fresh process resumes, and
+        the final params match an uninterrupted worker bit-for-bit."""
+        worker = os.path.join(REPO, "tests", "ooc_preempt_worker.py")
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+        def run(phase, ckpt):
+            return subprocess.run(
+                [sys.executable, worker, phase, str(ckpt)],
+                capture_output=True, text=True, timeout=240, env=env,
+            )
+
+        plain = run("plain", tmp_path / "ref")
+        assert plain.returncode == 0, plain.stderr
+        ref_line = [ln for ln in plain.stdout.splitlines()
+                    if ln.startswith("PARAMS")]
+        assert ref_line, plain.stdout
+
+        crashed = run("crash", tmp_path / "c")
+        assert crashed.returncode == 0, (crashed.stdout, crashed.stderr)
+        assert "PARAMS" not in crashed.stdout  # died before completion
+        assert os.listdir(tmp_path / "c"), "no emergency checkpoint"
+
+        resumed = run("resume", tmp_path / "c")
+        assert resumed.returncode == 0, resumed.stderr
+        res_line = [ln for ln in resumed.stdout.splitlines()
+                    if ln.startswith("PARAMS")]
+        assert res_line == ref_line  # bit-identical
