@@ -34,21 +34,44 @@ class Stage(WithParams):
     """Root of the pipeline node hierarchy; serializable via save/load."""
 
     def save(self, path: str) -> None:
+        from flink_ml_tpu.serve.integrity import atomic_json_dump
+
         os.makedirs(path, exist_ok=True)
         meta = {
             "module": type(self).__module__,
             "class": type(self).__qualname__,
             "params": self.get_params().to_json(),
         }
-        with open(os.path.join(path, _STAGE_FILE), "w") as f:
-            json.dump(meta, f, indent=2)
+        # model data first, descriptor last-as-commit (atomic tmp+rename):
+        # a crash mid-save leaves no stage.json, which load reports as
+        # corruption instead of resolving a stage with half-written data
         self.save_model_data(path)
+        atomic_json_dump(meta, os.path.join(path, _STAGE_FILE))
 
     @classmethod
     def load(cls, path: str) -> "Stage":
-        with open(os.path.join(path, _STAGE_FILE)) as f:
-            meta = json.load(f)
-        klass = _resolve_class(meta["module"], meta["class"])
+        from flink_ml_tpu.serve.errors import ModelIntegrityError
+
+        descriptor = os.path.join(path, _STAGE_FILE)
+        try:
+            with open(descriptor) as f:
+                meta = json.load(f)
+            # field access inside the guard: a parseable-but-wrong
+            # descriptor (partial overwrite, a JSON list) is the same
+            # corruption contract as an unparseable one
+            module, qualname = meta["module"], meta["class"]
+            params_json = meta["params"]
+        except FileNotFoundError:
+            raise ModelIntegrityError(
+                f"{path!r} has no {_STAGE_FILE} — not a saved stage, or a "
+                "save that died before its commit descriptor was written"
+            ) from None
+        except (ValueError, KeyError, TypeError) as e:
+            raise ModelIntegrityError(
+                f"stage descriptor {descriptor!r} is unreadable ({e}); "
+                "the saved stage is corrupt"
+            ) from e
+        klass = _resolve_class(module, qualname)
         if not issubclass(klass, Stage):
             raise TypeError(f"{klass} is not a Stage")
         # the static-load convention (Stage.java:41-43): a class owning its
@@ -59,7 +82,7 @@ class Stage(WithParams):
             return klass.load(path)
         stage = klass.__new__(klass)
         Stage.__init__(stage)  # params container
-        stage._params = Params.from_json(meta["params"])
+        stage._params = Params.from_json(params_json)
         stage.load_model_data(path)
         return stage
 

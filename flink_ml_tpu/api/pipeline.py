@@ -133,33 +133,71 @@ class PipelineModel(Model):
 
 
 def _save_stages(stages: Sequence[Stage], path: str, kind: str) -> None:
+    from flink_ml_tpu.serve.integrity import atomic_json_dump
+
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, _PIPELINE_FILE), "w") as f:
-        json.dump({"kind": kind, "num_stages": len(stages)}, f)
-    # also record the standard stage descriptor so a pipeline nests inside
-    # another pipeline and load_stage() resolves it uniformly
-    container = Pipeline if kind == "Pipeline" else PipelineModel
-    with open(os.path.join(path, "stage.json"), "w") as f:
-        json.dump(
-            {"module": container.__module__, "class": container.__qualname__, "params": "{}"},
-            f,
-        )
+    # stage dirs first, descriptors last: pipeline.json is the commit
+    # record of the whole save — a crash mid-save leaves stage dirs
+    # without a descriptor, which load reports as corruption instead of
+    # loading a partial pipeline
     for i, stage in enumerate(stages):
         stage.save(os.path.join(path, f"stage_{i:03d}"))
+    # the standard stage descriptor so a pipeline nests inside another
+    # pipeline and load_stage() resolves it uniformly
+    container = Pipeline if kind == "Pipeline" else PipelineModel
+    atomic_json_dump(
+        {"module": container.__module__, "class": container.__qualname__,
+         "params": "{}"},
+        os.path.join(path, "stage.json"),
+    )
+    atomic_json_dump(
+        {"kind": kind, "num_stages": len(stages)},
+        os.path.join(path, _PIPELINE_FILE),
+    )
 
 
 def _check_kind(path: str, expected: str) -> None:
-    with open(os.path.join(path, _PIPELINE_FILE)) as f:
-        kind = json.load(f)["kind"]
+    from flink_ml_tpu.serve.errors import ModelIntegrityError
+
+    descriptor = os.path.join(path, _PIPELINE_FILE)
+    try:
+        with open(descriptor) as f:
+            kind = json.load(f)["kind"]
+    except FileNotFoundError:
+        raise ModelIntegrityError(
+            f"{path!r} has no {_PIPELINE_FILE} — not a saved pipeline, or "
+            "a save that died before its commit descriptor was written"
+        ) from None
+    except (ValueError, KeyError, TypeError) as e:
+        raise ModelIntegrityError(
+            f"pipeline descriptor {descriptor!r} is unreadable ({e}); "
+            "the saved pipeline is corrupt"
+        ) from e
     if kind != expected:
         raise ValueError(f"{path} holds a {kind}, not a {expected}")
 
 
 def _load_stages(path: str) -> Tuple[str, List[Stage]]:
-    with open(os.path.join(path, _PIPELINE_FILE)) as f:
-        meta = json.load(f)
-    stages = [
-        load_stage(os.path.join(path, f"stage_{i:03d}"))
-        for i in range(meta["num_stages"])
-    ]
-    return meta["kind"], stages
+    from flink_ml_tpu.serve.errors import ModelIntegrityError
+
+    descriptor = os.path.join(path, _PIPELINE_FILE)
+    try:
+        with open(descriptor) as f:
+            meta = json.load(f)
+        kind, num_stages = meta["kind"], int(meta["num_stages"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise ModelIntegrityError(
+            f"pipeline descriptor {descriptor!r} is unreadable ({e}); "
+            "the saved pipeline is corrupt"
+        ) from e
+    stages = []
+    for i in range(num_stages):
+        stage_dir = os.path.join(path, f"stage_{i:03d}")
+        if not os.path.isdir(stage_dir):
+            raise ModelIntegrityError(
+                f"saved pipeline {path!r} promises {num_stages} stages "
+                f"but {stage_dir!r} is missing — partial save or deleted "
+                "stage directory"
+            )
+        stages.append(load_stage(stage_dir))
+    return kind, stages

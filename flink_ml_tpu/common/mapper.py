@@ -21,10 +21,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from flink_ml_tpu import obs
 from flink_ml_tpu.params.params import Params
+from flink_ml_tpu.serve.errors import MapperOutputMisalignedError
 from flink_ml_tpu.table.output_cols import OutputColsHelper
-from flink_ml_tpu.table.schema import Schema
+from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
 
 from flink_ml_tpu.common.model_source import ModelSource
@@ -60,6 +63,34 @@ class Mapper:
         """
         raise NotImplementedError
 
+    def serve_validation_spec(self) -> Optional[Dict]:
+        """What to validate batches against: ``None`` (no validation — the
+        default; stateless transforms define their own invalid-value
+        semantics) or kwargs for
+        :func:`flink_ml_tpu.serve.quarantine.validate_feature_batch`
+        (``dim`` plus ``vector_col``/``feature_cols``).  Model mappers
+        override this with the loaded model's feature geometry; the
+        ``FMT_SERVE_QUARANTINE`` gate lives once at the apply boundary, so
+        overrides never need to re-check it."""
+        return None
+
+    def validate_batch(self, batch: Table):
+        """Serving-boundary validation: ``None`` when every row is
+        servable, else ``(good_mask, reasons)``.  Driven by
+        :meth:`serve_validation_spec`; override directly only for
+        validation that feature geometry can't express."""
+        spec = self.serve_validation_spec()
+        if spec is None:
+            return None
+        from flink_ml_tpu.serve import quarantine
+
+        return quarantine.validate_feature_batch(batch, **spec)
+
+    def serve_name(self) -> str:
+        """The name this mapper's serving telemetry (quarantine side-table,
+        circuit breaker, fallback counters) is keyed by."""
+        return type(self).__name__
+
     # -- provided machinery --------------------------------------------------
 
     def get_output_schema(self) -> Schema:
@@ -76,17 +107,60 @@ class Mapper:
         slab_pool.pool().reap()
         obs.counter_add("inference.rows", table.num_rows())
         if batch_size is None or table.num_rows() <= batch_size:
-            with obs.phase("inference.map_batch"):
-                out = self.map_batch(table)
-            obs.counter_add("inference.batches")
-            return self._helper.get_result_table(table, out)
+            return self._apply_batch(table, row_offset=0)
         parts = []
+        offset = 0
         for batch in table.iter_batches(batch_size):
+            parts.append(self._apply_batch(batch, row_offset=offset))
+            offset += batch.num_rows()
+        return Table.concat(parts)
+
+    def _apply_batch(self, batch: Table, row_offset: int = 0) -> Table:
+        """One batch through the hardened serving boundary: validate ->
+        quarantine bad rows (they leave the jitted computation entirely and
+        land in the reason-coded side-table) -> map the good rows ->
+        row-alignment check -> OutputColsHelper merge."""
+        from flink_ml_tpu.serve import quarantine
+
+        verdict = (
+            self.validate_batch(batch) if quarantine.enabled() else None
+        )
+        if verdict is not None:
+            good_mask, reasons = verdict
+            quarantine.emit(self.serve_name(), batch, good_mask, reasons,
+                            row_offset=row_offset)
+            batch = batch.filter_rows(good_mask)
+        if batch.num_rows() == 0 and verdict is not None:
+            # every row quarantined: synthesize empty output columns of the
+            # declared types rather than asking the mapper to map nothing
+            out = {
+                name: np.zeros(0, dtype=DataTypes.numpy_dtype(typ))
+                for name, typ in zip(self._helper.output_col_names,
+                                     self._helper.output_col_types)
+            }
+        else:
             with obs.phase("inference.map_batch"):
                 out = self.map_batch(batch)
-            obs.counter_add("inference.batches")
-            parts.append(self._helper.get_result_table(batch, out))
-        return Table.concat(parts)
+        obs.counter_add("inference.batches")
+        self._check_output_alignment(out, batch)
+        return self._helper.get_result_table(batch, out)
+
+    def _check_output_alignment(self, out: Dict[str, Sequence],
+                                batch: Table) -> None:
+        """Every produced output column must be row-aligned with the batch.
+
+        Without this, a buggy mapper returning a short/long column shears
+        rows silently whenever no reserved input column survives into the
+        result to trip the ragged-table check downstream."""
+        n = batch.num_rows()
+        for name in self._helper.output_col_names:
+            values = out.get(name)
+            if values is None:
+                continue  # absence is the helper's (named) error to raise
+            if len(values) != n:
+                raise MapperOutputMisalignedError(
+                    self.serve_name(), name, len(values), n
+                )
 
 
 class ModelMapper(Mapper):
@@ -107,6 +181,12 @@ class ModelMapper(Mapper):
         For device mappers this is where columns become replicated jnp arrays.
         """
         raise NotImplementedError
+
+    def serve_name(self) -> str:
+        """Model mappers key serving telemetry by their model stage's class
+        (the mapper classes are often anonymous inner classes)."""
+        stage = getattr(self, "_model_stage", None)
+        return type(stage).__name__ if stage is not None else type(self).__name__
 
 
 class MapperAdapter:

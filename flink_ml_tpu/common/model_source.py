@@ -48,6 +48,32 @@ class RowsModelSource(ModelSource):
         return (self._table,)
 
 
+class FileModelSource(ModelSource):
+    """Model data from persisted table files, integrity-verified at open.
+
+    The load-then-serve boundary the reference's ModelMapperAdapter.open()
+    assumes is hardened here: each path's length+CRC32 commit record is
+    verified and the rows parse-checked by
+    :func:`~flink_ml_tpu.utils.persistence.load_table` — a truncated or
+    corrupted model file raises
+    :class:`~flink_ml_tpu.serve.errors.ModelIntegrityError` at open time,
+    never serves wrong predictions.  Tables load once and are cached (the
+    broadcast-variable analog: open() is the one materialization point)."""
+
+    def __init__(self, *paths: str):
+        if not paths:
+            raise ValueError("FileModelSource needs at least one table path")
+        self._paths = tuple(paths)
+        self._tables: Tuple[Table, ...] = ()
+
+    def get_model_tables(self) -> Tuple[Table, ...]:
+        if not self._tables:
+            from flink_ml_tpu.utils.persistence import load_table
+
+            self._tables = tuple(load_table(p) for p in self._paths)
+        return self._tables
+
+
 class BroadcastModelSource(ModelSource):
     """Model tables + a device-replicated pytree of the packed model.
 

@@ -38,6 +38,9 @@ Planted points (grep ``maybe_fail`` for the live set):
 ``prefetch.produce``:func:`~flink_ml_tpu.utils.prefetch.prefetch_iter` producer
 ``ckpt.save``       :func:`~flink_ml_tpu.iteration.checkpoint.save_checkpoint`
 ``agree``           :func:`~flink_ml_tpu.parallel.mesh.agree_max`/``agree_sum``
+``serve.dispatch``  :func:`~flink_ml_tpu.serve.breaker.dispatch` — every
+                    mapper's inference device call (retried, then breaker
+                    + CPU fallback)
 ==================  =========================================================
 """
 

@@ -17,6 +17,21 @@ from flink_ml_tpu.lib.glm import GlmEstimatorBase, GlmModelBase, LinearScoreMapp
 from flink_ml_tpu.table.schema import DataTypes, Schema
 
 
+def _stable_sigmoid(scores: np.ndarray) -> np.ndarray:
+    """Overflow-free sigmoid: ``np.exp(-scores)`` overflows (with a runtime
+    warning and an inf that rounds through to 0.0) once a score passes
+    ~-745 in f64 / ~-88 in f32 — scores a wide model on unnormalized
+    serving traffic produces routinely.  Exponentiate only the negative
+    half-line instead."""
+    scores = np.asarray(scores, dtype=np.float64)
+    out = np.empty_like(scores)
+    pos = scores >= 0
+    out[pos] = 1.0 / (1.0 + np.exp(-scores[pos]))
+    e = np.exp(scores[~pos])
+    out[~pos] = e / (1.0 + e)
+    return out
+
+
 class LogisticRegressionModel(GlmModelBase):
     """Predicts the {0,1} label; optional probability detail column."""
 
@@ -35,10 +50,9 @@ class LogisticRegressionModel(GlmModelBase):
 
             def map_batch(self, batch):
                 scores = self._scores(batch)
-                prob = 1.0 / (1.0 + np.exp(-scores))
                 out = {model.get_prediction_col(): (scores > 0).astype(np.float64)}
                 if detail is not None:
-                    out[detail] = prob.astype(np.float64)
+                    out[detail] = _stable_sigmoid(scores)
                 return out
 
         return _Mapper(self, data_schema)
@@ -48,7 +62,7 @@ class LogisticRegressionModel(GlmModelBase):
         mapper = self._make_mapper(table.schema)
         mapper.load_model(*self.get_model_data())
         scores = mapper._scores(table)
-        return 1.0 / (1.0 + np.exp(-scores))
+        return _stable_sigmoid(scores)
 
 
 from functools import lru_cache

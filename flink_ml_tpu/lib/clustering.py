@@ -248,18 +248,49 @@ class KMeansModelMapper(ModelMapper):
             [t.col("centroid")[i].to_dense().values for i in order]
         )
         self._centroids = jnp.asarray(cents, dtype=jnp.float32)
+        # host copy for the circuit-breaker CPU fallback
+        self._centroids_np = np.asarray(cents, dtype=np.float32)
+
+    def serve_validation_spec(self):
+        model = self._model_stage
+        return {
+            "dim": int(self._centroids.shape[1]),
+            "vector_col": model.get_vector_col(),
+            "feature_cols": model.get_feature_cols(),
+        }
 
     def map_batch(self, batch: Table):
+        from flink_ml_tpu import serve
+
         model = self._model_stage
         X, _ = resolve_features(batch, model, dim=int(self._centroids.shape[1]))
         X = X.astype(np.float32)
         n = X.shape[0]
-        both = apply_sharded(_assign_apply, X, self._centroids)
+        both = serve.dispatch(
+            self.serve_name(),
+            device=lambda: apply_sharded(_assign_apply, X, self._centroids),
+            fallback=lambda: self._assign_cpu(X),
+        )
         out = {model.get_prediction_col(): both[:n, 0].astype(np.int64)}
         detail = model.get_prediction_detail_col()
         if detail is not None:
             out[detail] = np.sqrt(both[:n, 1])
         return out
+
+    def _assign_cpu(self, X: np.ndarray) -> np.ndarray:
+        """NumPy nearest-centroid fallback (same distance formula and
+        lowest-id tie-break as the device argmin)."""
+        c = self._centroids_np
+        d = np.maximum(
+            np.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * (X @ c.T)
+            + np.sum(c * c, axis=1),
+            0.0,
+        )
+        return np.stack(
+            [np.argmin(d, axis=1).astype(np.float64), np.min(d, axis=1)],
+            axis=1,
+        )
 
 
 class KMeansModel(TableModelBase, KMeansParams):

@@ -148,14 +148,31 @@ class StandardScalerModelMapper(ModelMapper):
             scale = np.ones_like(stds)
         self._shift = jnp.asarray(shift)
         self._inv_scale = jnp.asarray(1.0 / scale)
+        # host copies for the circuit-breaker CPU fallback; the fused
+        # subtract-multiply is elementwise, so fallback parity is exact
+        self._shift_np = np.asarray(shift, dtype=np.float32)
+        self._inv_scale_np = np.asarray(1.0 / scale, dtype=np.float32)
+
+    def serve_validation_spec(self):
+        return {
+            "dim": self._dim,
+            "vector_col": self._model_stage.get_selected_col(),
+        }
 
     def map_batch(self, batch: Table):
+        from flink_ml_tpu import serve
+
         model = self._model_stage
         X = batch.features_dense(model.get_selected_col(), dim=self._dim)
         # apply_sharded already returns a host array sliced to the batch rows;
         # matrix-backed vector column: stays one contiguous array end-to-end
-        out = apply_sharded(
-            _scale_apply, X.astype(np.float32), self._shift, self._inv_scale
+        Xf = X.astype(np.float32)
+        out = serve.dispatch(
+            self.serve_name(),
+            device=lambda: apply_sharded(
+                _scale_apply, Xf, self._shift, self._inv_scale
+            ),
+            fallback=lambda: (Xf - self._shift_np) * self._inv_scale_np,
         )
         return {model.resolved_output_col(): out}
 
@@ -256,12 +273,27 @@ class MinMaxScalerModelMapper(ModelMapper):
         b = np.where(varying, lo - mins * a, 0.5 * (lo + hi))
         self._a = jnp.asarray(a, dtype=jnp.float32)
         self._b = jnp.asarray(b, dtype=jnp.float32)
+        # host copies for the circuit-breaker CPU fallback (elementwise
+        # affine: exact parity with the device path)
+        self._a_np = np.asarray(a, dtype=np.float32)
+        self._b_np = np.asarray(b, dtype=np.float32)
+
+    def serve_validation_spec(self):
+        return {
+            "dim": self._dim,
+            "vector_col": self._model_stage.get_selected_col(),
+        }
 
     def map_batch(self, batch: Table):
+        from flink_ml_tpu import serve
+
         model = self._model_stage
         X = batch.features_dense(model.get_selected_col(), dim=self._dim)
-        out = apply_sharded(
-            _affine_apply, X.astype(np.float32), self._a, self._b
+        Xf = X.astype(np.float32)
+        out = serve.dispatch(
+            self.serve_name(),
+            device=lambda: apply_sharded(_affine_apply, Xf, self._a, self._b),
+            fallback=lambda: Xf * self._a_np + self._b_np,
         )
         return {model.resolved_output_col(): out}
 

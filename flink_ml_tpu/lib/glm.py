@@ -162,6 +162,18 @@ class LinearScoreMapper(ModelMapper):
         w = np.asarray(t.col("coefficients")[0].to_dense().values)
         self._w = jnp.asarray(w, dtype=jnp.float32)
         self._b = jnp.asarray(float(t.col("intercept")[0]), dtype=jnp.float32)
+        # host copies for the circuit-breaker CPU fallback: when the device
+        # path is open-circuited, scoring must not touch device memory at all
+        self._w_np = np.asarray(w, dtype=np.float32)
+        self._b_np = np.float32(t.col("intercept")[0])
+
+    def serve_validation_spec(self):
+        model = self._model_stage
+        return {
+            "dim": int(self._w.shape[0]),
+            "vector_col": model.get_vector_col(),
+            "feature_cols": model.get_feature_cols(),
+        }
 
     def _scores(self, batch: Table) -> np.ndarray:
         model = self._model_stage
@@ -171,6 +183,7 @@ class LinearScoreMapper(ModelMapper):
             # count is bucketed (power of two) so varying batch sizes reuse
             # one compiled program; pad rows receive only zero contributions
             # and are sliced away.
+            from flink_ml_tpu import serve
             from flink_ml_tpu.lib.common import bucket_rows
             from flink_ml_tpu.ops.batch import CsrBatch
 
@@ -180,7 +193,13 @@ class LinearScoreMapper(ModelMapper):
                 csr.indices, csr.values, csr.row_ids,
                 n_rows=bucket_rows(max(n, 1)), n_cols=csr.n_cols,
             )
-            return np.asarray(_sparse_score_fn(padded, self._w, self._b))[:n]
+            return serve.dispatch(
+                self.serve_name(),
+                device=lambda: np.asarray(
+                    _sparse_score_fn(padded, self._w, self._b)
+                )[:n],
+                fallback=lambda: self._scores_cpu_sparse(csr, n),
+            )
         X, _ = resolve_features(batch, model, dim=int(self._w.shape[0]))
         # asarray, not astype: a matrix-backed f32 column passes through
         # zero-copy, so the slab pool sees a STABLE buffer and re-scoring
@@ -197,9 +216,27 @@ class LinearScoreMapper(ModelMapper):
             ("linear_scores", vector_col, int(self._w.shape[0]))
             if X is col else None
         )
-        return apply_sharded(
-            _score_apply, X, self._w, self._b, pool_key=pool_key
+        from flink_ml_tpu import serve
+
+        return serve.dispatch(
+            self.serve_name(),
+            device=lambda: apply_sharded(
+                _score_apply, X, self._w, self._b, pool_key=pool_key
+            ),
+            fallback=lambda: X @ self._w_np + self._b_np,
         )
+
+    def _scores_cpu_sparse(self, csr, n: int) -> np.ndarray:
+        """NumPy segment-matvec fallback (same math as _sparse_score_fn;
+        f32 accumulation order may differ by summation grouping)."""
+        out = np.zeros(n + 1, dtype=np.float32)  # slot n absorbs pad entries
+        np.add.at(
+            out,
+            np.minimum(np.asarray(csr.row_ids), n),
+            np.asarray(csr.values, dtype=np.float32)
+            * self._w_np[np.asarray(csr.indices)],
+        )
+        return out[:n] + self._b_np
 
 
 class GlmEstimatorBase(Estimator, GlmTrainParams):

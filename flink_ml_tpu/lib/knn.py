@@ -234,6 +234,13 @@ class KnnModelMapper(ModelMapper):
         # class-id encoding for the vote
         self._classes = np.unique(y)
         y_ids = np.searchsorted(self._classes, y)
+        # host references for the circuit-breaker CPU fallback (the
+        # reference set IS the model; a dead device path must still answer
+        # queries).  References, not f32 copies: the fallback converts one
+        # reference chunk at a time, so the healthy path pays no extra
+        # host residency beyond the model table it already holds
+        self._xt_host = X
+        self._yt_ids = np.asarray(y_ids, dtype=np.int64)
 
         from flink_ml_tpu.parallel.mesh import (
             data_parallel_size,
@@ -299,7 +306,17 @@ class KnnModelMapper(ModelMapper):
             self._xt, self._yt = place_model()
         self._chunk = chunk
 
+    def serve_validation_spec(self):
+        model = self._model_stage
+        return {
+            "dim": int(self._xt.shape[1]),
+            "vector_col": model.get_vector_col(),
+            "feature_cols": model.get_feature_cols(),
+        }
+
     def map_batch(self, batch: Table):
+        from flink_ml_tpu import serve
+
         model = self._model_stage
         k = model.get_k()
         X, _ = resolve_features(batch, model, dim=int(self._xt.shape[1]))
@@ -308,12 +325,16 @@ class KnnModelMapper(ModelMapper):
         apply_factory = (
             _knn_apply_model_sharded if self._sharded else _knn_apply
         )
-        out = apply_sharded(
-            lambda mesh: apply_factory(
-                mesh, k, self._chunk, len(self._classes),
-                bool(model.get_bf16_distances()),
+        out = serve.dispatch(
+            self.serve_name(),
+            device=lambda: apply_sharded(
+                lambda mesh: apply_factory(
+                    mesh, k, self._chunk, len(self._classes),
+                    bool(model.get_bf16_distances()),
+                ),
+                X, self._xt, self._yt,
             ),
-            X, self._xt, self._yt,
+            fallback=lambda: self._map_cpu(X, k),
         )
         pred_ids = out[:n, 0].astype(np.int64)
         result = {model.get_prediction_col(): self._classes[pred_ids]}
@@ -321,6 +342,48 @@ class KnnModelMapper(ModelMapper):
         if detail is not None:
             result[detail] = np.sqrt(np.maximum(out[:n, 1], 0.0))  # nearest distance
         return result
+
+    #: reference rows per CPU-fallback chunk — bounds the fallback's
+    #: distance-matrix slice to O(batch x chunk), mirroring the device scan
+    CPU_FALLBACK_CHUNK = 8192
+
+    def _map_cpu(self, X: np.ndarray, k: int) -> np.ndarray:
+        """NumPy top-k + vote fallback with the device scan's memory bound:
+        the reference set streams through in chunks, a running best-k
+        carries across them, and memory stays O(batch x chunk) — never the
+        full (batch, train) matrix (a million-row model's fallback must
+        not OOM the serving host during the exact outage it exists for).
+        Tie-break parity with the device scan: the carry is sorted by
+        (distance, global row index) and each chunk appends rows in index
+        order, so a stable selection keeps the lower global index on exact
+        ties; votes break ties toward the lowest class id."""
+        xt, yt = self._xt_host, self._yt_ids
+        n = X.shape[0]
+        chunk = self.CPU_FALLBACK_CHUNK
+        x2 = np.sum(X * X, axis=1, keepdims=True, dtype=np.float32)
+        best_d = np.full((n, k), np.inf, dtype=np.float32)
+        best_y = np.zeros((n, k), dtype=np.int64)
+        for a in range(0, xt.shape[0], chunk):
+            xc = np.asarray(xt[a : a + chunk], dtype=np.float32)
+            yc = yt[a : a + chunk]
+            d = x2 - 2.0 * (X @ xc.T) + np.sum(xc * xc, axis=1)
+            cat_d = np.concatenate([best_d, d.astype(np.float32)], axis=1)
+            cat_y = np.concatenate(
+                [best_y, np.broadcast_to(yc, (n, yc.shape[0]))], axis=1
+            )
+            order = np.argsort(cat_d, axis=1, kind="stable")[:, :k]
+            best_d = np.take_along_axis(cat_d, order, axis=1)
+            best_y = np.take_along_axis(cat_y, order, axis=1)
+        n_classes = len(self._classes)
+        votes = np.zeros((n, n_classes), dtype=np.int64)
+        for c in range(n_classes):
+            votes[:, c] = np.sum(
+                np.logical_and(best_y == c, np.isfinite(best_d)), axis=1
+            )
+        pred = np.argmax(votes, axis=1)  # argmax keeps the lowest id on ties
+        return np.concatenate(
+            [pred[:, None].astype(np.float32), best_d], axis=1
+        )
 
 
 class KnnModel(TableModelBase, KnnParams):

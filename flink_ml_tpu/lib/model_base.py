@@ -85,6 +85,23 @@ class TableModelBase(Model):
             self._mapper_cache = mapper
             self._mapper_cache_key = key
         batch = MLEnvironmentFactory.get_default().default_batch_size
-        return (self._mapper_cache.apply(table, batch_size=batch),)
+        # per-transform serve accounting: the serve.* counter delta across
+        # this apply (quarantined rows, fallbacks, dispatch retries) lands
+        # in a 'transform' RunReport, which `obs --check` judges for the
+        # SERVE-DEGRADED flag (completed, but only via the CPU fallback)
+        from flink_ml_tpu import obs as _obs
+        from flink_ml_tpu.serve import serve_counter_snapshot
+
+        serve0 = serve_counter_snapshot() if _obs.enabled() else None
+        out = self._mapper_cache.apply(table, batch_size=batch)
+        if serve0 is not None:
+            from flink_ml_tpu.obs.report import transform_report
+            from flink_ml_tpu.serve import serve_counter_delta
+
+            transform_report(
+                type(self).__name__, rows=table.num_rows(),
+                serve_delta=serve_counter_delta(serve0),
+            )
+        return (out,)
     # transform_chunks (streamed inference) is inherited from Transformer;
     # the mapper cache above keeps the model device-resident across chunks

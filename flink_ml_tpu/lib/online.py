@@ -206,13 +206,41 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
             x, y, w = self._window_xyw(window_table)
             return sgd_step(state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
 
+        # host mirror of the freshest reachable params for the CPU fallback:
+        # the live ``state`` is a device pytree, and pulling it during an
+        # outage is itself a device call — the fallback must score from
+        # memory the dead accelerator cannot take down.  Refreshed on every
+        # fallback while the device still answers D2H; when even that fails,
+        # the last-reachable model serves (stale-model degraded semantics).
+        host_params = {
+            "w": np.zeros((self._dim,), dtype=np.float32),
+            "b": np.float32(0.0),
+        }
+
         def predict(state, batch_table: Table):
+            from flink_ml_tpu import serve
+
             X, _ = resolve_features(batch_table, self, dim=self._dim)
             n = X.shape[0]
             b = bucket_rows(n, 64)
             Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
             Xp[:n] = X
-            scores = np.asarray(score(state, jnp.asarray(Xp)))[:n]
+
+            def cpu_scores():
+                try:
+                    host_params["w"], host_params["b"] = (
+                        np.asarray(state[0], np.float32),
+                        np.float32(np.asarray(state[1])),
+                    )
+                except Exception:  # noqa: BLE001 - D2H died with the device
+                    pass
+                return Xp[:n] @ host_params["w"] + host_params["b"]
+
+            scores = serve.dispatch(
+                "OnlineLogisticRegression.predict",
+                device=lambda: np.asarray(score(state, jnp.asarray(Xp)))[:n],
+                fallback=cpu_scores,
+            )
             return (scores > 0).astype(np.float64)
 
         params0 = (

@@ -10,13 +10,18 @@ new parameters (withBroadcastSet:114) is the replicated params placement.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from flink_ml_tpu.lib.glm import GlmEstimatorBase, GlmModelBase, LinearScoreMapper
 from flink_ml_tpu.table.schema import DataTypes, Schema
 
 
 class LinearRegressionModel(GlmModelBase):
-    """Predicts x·w + b into ``predictionCol``."""
+    """Predicts x·w + b into ``predictionCol``.
+
+    Serving robustness (quarantine of bad feature rows, the dispatch
+    circuit breaker, and the NumPy CPU fallback) rides the shared
+    :class:`~flink_ml_tpu.lib.glm.LinearScoreMapper` machinery."""
 
     def _make_mapper(self, data_schema: Schema):
         model = self
@@ -26,7 +31,10 @@ class LinearRegressionModel(GlmModelBase):
                 return [model.get_prediction_col()], [DataTypes.DOUBLE]
 
             def map_batch(self, batch):
-                return {model.get_prediction_col(): self._scores(batch)}
+                # explicit f64 cast: the declared output type is DOUBLE and
+                # the device/fallback paths hand back f32 scores
+                scores = np.asarray(self._scores(batch), dtype=np.float64)
+                return {model.get_prediction_col(): scores}
 
         return _Mapper(self, data_schema)
 

@@ -231,6 +231,62 @@ def fit_report(name: str, shape=None, step_metrics=None, extra=None,
         return None
 
 
+def transform_report(name: str, rows: int, serve_delta: dict,
+                     extra: Optional[dict] = None,
+                     directory: Optional[str] = None) -> Optional[str]:
+    """Write a ``transform`` RunReport (no-op when obs is disabled).
+
+    ``serve_delta`` is the serve-counter movement across the one transform
+    (quarantined rows, fallbacks, device successes, dispatch retries) —
+    computed by the caller so fit-report delta attribution stays
+    untouched.  The full registry snapshot is deliberately omitted:
+    transforms run at serving rate, and the serve delta is the whole
+    signal ``--check`` judges."""
+    if not _obs_enabled():
+        return None
+    try:
+        report = RunReport(
+            kind="transform",
+            name=name,
+            ts=time.time(),
+            git_sha=git_sha(),
+            device=device_topology(),
+            extra={"rows": int(rows), "serve": dict(serve_delta),
+                   **(extra or {})},
+        )
+        return write_run_report(report, directory)
+    except Exception:  # noqa: BLE001 - telemetry must never fail a transform
+        return None
+
+
+def serve_degraded_runs(reports: List[dict]) -> List[dict]:
+    """Transform reports that only completed via the CPU fallback.
+
+    A transform whose serve delta shows fallbacks with ZERO successful
+    device dispatches served every batch from the degraded path — the
+    accelerator was effectively down for it.  Latest report per transform
+    name only (the fault_assisted_runs rule: history must not bury the
+    current signal).  Quarantine-only activity does not flag: dropping
+    poison rows while the device serves is the system working as
+    designed."""
+    latest: Dict[str, dict] = {}
+    for r in reports:
+        if r.get("kind") == "transform":
+            latest[str(r.get("name", ""))] = r
+    flagged = []
+    for _, r in sorted(latest.items()):
+        serve = (r.get("extra") or {}).get("serve") or {}
+        fallbacks = serve.get("serve.fallbacks", 0)
+        device_ok = serve.get("serve.device_ok", 0)
+        if fallbacks and not device_ok:
+            flagged.append(
+                {"name": r.get("name"), "ts": r.get("ts"),
+                 "git_sha": r.get("git_sha"), "serve": serve,
+                 "rows": (r.get("extra") or {}).get("rows")}
+            )
+    return flagged
+
+
 def bench_report(record: dict, directory: Optional[str] = None) -> Optional[str]:
     """Write a ``bench`` RunReport from one bench_all result record."""
     if not _obs_enabled():
@@ -410,6 +466,14 @@ def main(argv=None) -> int:
         tag = " (injected chaos)" if fr.get("injected") else ""
         print(f"FAULT-ASSISTED fit {fr['name']}{tag} "
               f"[{fr.get('git_sha', '')}]: {counters}")
+    # transforms that only completed via the CPU fallback: the device path
+    # was effectively down — same visibility rule as FAULT-ASSISTED
+    for sr in serve_degraded_runs(reports):
+        counters = ", ".join(
+            f"{k}={v:g}" for k, v in sorted(sr["serve"].items())
+        )
+        print(f"SERVE-DEGRADED transform {sr['name']} "
+              f"[{sr.get('git_sha', '')}]: {counters}")
     rows = diff_against_baseline(reports, baseline, args.threshold)
     if not rows:
         print("no measured baselines in"

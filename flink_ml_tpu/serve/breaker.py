@@ -1,0 +1,258 @@
+"""Inference dispatch hardening: deadline + retry + circuit breaker + CPU
+fallback.
+
+A training fit that dies is an operator page; an inference path that dies
+is a user-facing outage.  Every mapper's device call routes through
+:func:`dispatch`:
+
+* the call is wrapped in the PR-3 transient retry policy
+  (:func:`~flink_ml_tpu.fault.retry.with_retry`, jittered exponential
+  backoff) under the ``serve.dispatch`` injection point, so a placement
+  blip or an injected chaos fault retries instead of failing the batch;
+* every call's wall time lands in the ``serve.deadline_ms`` timing
+  histogram (milliseconds); a call that overruns ``FMT_SERVE_DEADLINE_MS``
+  counts as a breaker failure — a chronically slow device link degrades
+  the same way a failing one does — but its (late) result still serves;
+* repeated failures open a **per-mapper circuit breaker**
+  (``FMT_SERVE_BREAKER_THRESHOLD`` consecutive failures, default 3): while
+  open, the device is not even attempted and the mapper's NumPy CPU
+  fallback serves directly — no retry storm against a dead accelerator.
+  After ``FMT_SERVE_BREAKER_COOLDOWN_S`` (default 30) one half-open probe
+  is allowed; success closes the breaker, failure re-opens it.
+
+Fallback parity contract: the CPU path computes the same per-row math in
+NumPy.  Discrete outputs (labels, cluster ids) are exactly equal; raw
+float scores agree to float-accumulation tolerance (a NumPy matmul and an
+XLA matmul may sum in different orders) — asserted by the parity tests and
+the chaos serving smoke.
+
+Breaker state is visible as the ``serve.breaker_state.<name>`` gauge
+(0 closed, 0.5 half-open, 1 open) and every fallback in
+``serve.fallbacks`` / ``serve.fallbacks.<name>``; per-transform RunReports
+carry the deltas, and ``python -m flink_ml_tpu.obs --check`` prints a
+``SERVE-DEGRADED`` line for any transform that only completed via
+fallback.
+
+Multi-process: ``allow_device(agreed=True)`` agrees the open/closed
+decision across processes (*open wins*, via ``agree_max`` — the mirror of
+the slab pool's miss-wins hit agreement) so collective-bearing device
+applies never split between a device path and a fallback path.  The
+default inference contract is process-local and collective-free, so plain
+``dispatch`` never gathers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import warnings
+from typing import Callable, Dict, Optional
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.fault.injection import maybe_fail
+from flink_ml_tpu.fault.retry import is_transient, with_retry
+
+__all__ = [
+    "CircuitBreaker",
+    "breaker",
+    "dispatch",
+    "reset_breakers",
+    "serve_counter_snapshot",
+    "serve_counter_delta",
+]
+
+_CLOSED, _HALF_OPEN, _OPEN = 0.0, 0.5, 1.0
+
+
+def _threshold() -> int:
+    return int(os.environ.get("FMT_SERVE_BREAKER_THRESHOLD", "3") or 3)
+
+
+def _cooldown_s() -> float:
+    return float(os.environ.get("FMT_SERVE_BREAKER_COOLDOWN_S", "30") or 30)
+
+
+def _deadline_ms() -> float:
+    """``FMT_SERVE_DEADLINE_MS`` (0 = no deadline accounting)."""
+    return float(os.environ.get("FMT_SERVE_DEADLINE_MS", "0") or 0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one named dispatch surface.
+
+    closed -> (``threshold`` consecutive failures) -> open ->
+    (cooldown elapses) -> half-open probe -> closed on success / re-open
+    on failure.  Thread-safe; state transitions publish the
+    ``serve.breaker_state.<name>`` gauge."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._state = _CLOSED
+
+    def _publish(self) -> None:
+        obs.gauge_set(f"serve.breaker_state.{self.name}", self._state)
+
+    @property
+    def state(self) -> float:
+        """0.0 closed / 0.5 half-open / 1.0 open (the gauge vocabulary)."""
+        with self._lock:
+            return self._state
+
+    def _allow_local(self) -> bool:
+        with self._lock:
+            if self._state == _CLOSED:
+                return True
+            if time.monotonic() - self._opened_at >= _cooldown_s():
+                # one probe rides through; concurrent callers in the same
+                # window also probe — harmless (each failure re-opens)
+                self._state = _HALF_OPEN
+                self._publish()
+                return True
+            return False
+
+    def allow_device(self, agreed: bool = False) -> bool:
+        """May this call try the device?  ``agreed=True`` makes the
+        decision cross-process (*open wins*): any process whose breaker
+        blocks forces every process to the fallback, keeping
+        collective-bearing applies aligned."""
+        local_ok = self._allow_local()
+        if agreed:
+            import jax
+
+            if jax.process_count() > 1:
+                from flink_ml_tpu.parallel.mesh import agree_max
+
+                (any_blocked,) = agree_max(int(not local_ok))
+                return not any_blocked
+        return local_ok
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == _HALF_OPEN or self._failures >= _threshold():
+                self._state = _OPEN
+                self._opened_at = time.monotonic()
+            self._publish()
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._failures or self._state != _CLOSED:
+                self._failures = 0
+                self._opened_at = None
+                self._state = _CLOSED
+                self._publish()
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def breaker(name: str) -> CircuitBreaker:
+    """The process-wide breaker for one dispatch surface (created on first
+    use)."""
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(name)
+        if b is None:
+            b = _BREAKERS[name] = CircuitBreaker(name)
+        return b
+
+
+def reset_breakers() -> None:
+    """Drop every breaker (tests; per-run scoping)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def dispatch(name: str, device: Callable, fallback: Optional[Callable] = None,
+             agreed: bool = False):
+    """Run ``device()`` behind ``name``'s breaker; degrade to ``fallback()``.
+
+    The single chokepoint for every mapper's device call:
+
+    * breaker open -> straight to the fallback (``serve.fallbacks``);
+    * else ``device()`` under the transient retry policy and the
+      ``serve.dispatch`` injection point; wall time -> the
+      ``serve.deadline_ms`` histogram, deadline overruns ->
+      ``serve.deadline_exceeded`` + a breaker failure (the late result
+      still serves);
+    * retries exhausted on a transient failure -> breaker failure +
+      fallback (or re-raise when no fallback exists);
+    * non-transient failures (shape bugs, value errors) re-raise
+      immediately — a deterministic bug must never be papered over by a
+      silently different code path.
+    """
+    brk = breaker(name)
+    if fallback is not None and not brk.allow_device(agreed=agreed):
+        obs.counter_add("serve.fallbacks")
+        obs.counter_add(f"serve.fallbacks.{name}")
+        with obs.phase("serve.fallback"):
+            return fallback()
+
+    def attempt():
+        maybe_fail("serve.dispatch")
+        return device()
+
+    t0 = time.perf_counter()
+    try:
+        out = with_retry(attempt, "serve.dispatch")
+    except BaseException as exc:  # noqa: BLE001 - transient-filtered below
+        if not is_transient(exc) or fallback is None:
+            raise
+        brk.record_failure()
+        obs.counter_add("serve.dispatch_failures")
+        obs.counter_add(f"serve.dispatch_failures.{name}")
+        obs.counter_add("serve.fallbacks")
+        obs.counter_add(f"serve.fallbacks.{name}")
+        warnings.warn(
+            f"device dispatch for {name!r} failed after retries "
+            f"({type(exc).__name__}: {exc}); serving this batch from the "
+            "CPU fallback path",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        with obs.phase("serve.fallback"):
+            return fallback()
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    obs.observe("serve.deadline_ms", dt_ms)
+    deadline = _deadline_ms()
+    if deadline > 0 and dt_ms > deadline:
+        # a chronically slow device degrades like a failing one: overruns
+        # feed the breaker, and enough of them route traffic to the CPU
+        obs.counter_add("serve.deadline_exceeded")
+        obs.counter_add(f"serve.deadline_exceeded.{name}")
+        brk.record_failure()
+    else:
+        brk.record_success()
+    obs.counter_add("serve.device_ok")
+    return out
+
+
+# -- per-transform accounting -------------------------------------------------
+
+_SERVE_PREFIXES = ("serve.", "fault.retries.serve", "fault.giveups.serve")
+
+
+def serve_counter_snapshot() -> Dict[str, float]:
+    """Current serve-related counter totals (for per-transform deltas)."""
+    snap = obs.registry().snapshot()["counters"]
+    return {
+        k: v for k, v in snap.items()
+        if any(k.startswith(p) for p in _SERVE_PREFIXES)
+    }
+
+
+def serve_counter_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Serve-counter movement since ``before`` (nonzero entries only)."""
+    now = serve_counter_snapshot()
+    out = {}
+    for k, v in now.items():
+        d = v - before.get(k, 0)
+        if d < 0:  # registry reset in between: attribute the raw total
+            d = v
+        if d:
+            out[k] = d
+    return out

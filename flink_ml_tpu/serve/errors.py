@@ -1,0 +1,46 @@
+"""Serving-layer error vocabulary.
+
+Small and dependency-free on purpose: these types are raised from the
+persistence layer, the pipeline loader, and the mapper boundary, so they
+must be importable from anywhere without dragging the serving machinery
+(or jax) along.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ModelIntegrityError", "MapperOutputMisalignedError"]
+
+
+class ModelIntegrityError(RuntimeError):
+    """A persisted model artifact failed verification at load time.
+
+    Raised instead of serving garbage: a truncated model file, a CRC/length
+    mismatch against the commit record, an unparseable header, or a row
+    whose arity disagrees with the declared schema.  The message always
+    names the artifact path and what disagreed, so an operator can tell a
+    half-written save from bit rot from a schema drift without a debugger.
+    """
+
+
+class MapperOutputMisalignedError(ValueError):
+    """A Mapper's ``map_batch`` output column is not row-aligned with its
+    input batch.
+
+    The ``map_batch`` contract is positional (output row i depends only on
+    input row i); a mapper that returns a short or long column would shear
+    rows in the OutputColsHelper merge whenever no reserved input column
+    remains to catch the length mismatch.  Names the mapper and the column
+    so the bug reads as *whose* contract broke, not as a ragged-table
+    artifact three layers later.
+    """
+
+    def __init__(self, mapper: str, column: str, got: int, expected: int):
+        super().__init__(
+            f"mapper {mapper!r} returned {got} rows for output column "
+            f"{column!r}, but the input batch has {expected} rows — "
+            "map_batch output must be row-aligned with its batch"
+        )
+        self.mapper = mapper
+        self.column = column
+        self.got = got
+        self.expected = expected

@@ -1,0 +1,173 @@
+"""Model-artifact integrity: atomic writes + length/CRC32 commit records.
+
+The same scheme the spill layer uses for its blocks (PR 3,
+``lib/out_of_core.BlockSpill``): every persisted model file is written to
+``<path>.tmp`` with the CRC32 computed in the SAME pass as the bytes,
+fsync'd, renamed into place, and then committed by a ``<path>.commit.json``
+sidecar recording the on-disk length and checksum.  Loaders verify the
+sidecar BEFORE parsing — a truncated or bit-rotted model file raises
+:class:`~flink_ml_tpu.serve.errors.ModelIntegrityError` instead of loading
+as silently-wrong params (a half-written coefficient row parses fine and
+serves garbage forever; the length check alone catches truncation, the CRC
+catches rot).
+
+A missing sidecar is accepted (files written before this layer existed, or
+hand-edited fixtures) — the parse-level checks in the loader still apply.
+A PRESENT-but-wrong sidecar always fails: it is the commit record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+from flink_ml_tpu.serve.errors import ModelIntegrityError
+
+__all__ = [
+    "AtomicFile",
+    "commit_path",
+    "verify_commit_record",
+    "write_commit_record",
+    "atomic_json_dump",
+]
+
+
+def commit_path(path: str) -> str:
+    """The sidecar commit-record path for a model artifact."""
+    return path + ".commit.json"
+
+
+class AtomicFile:
+    """Context manager: write ``path`` atomically with a streamed CRC.
+
+    Opens ``<path>.tmp`` in binary mode; ``write`` accepts str or bytes and
+    CRCs/counts every byte as it streams (reading the file back to checksum
+    it would double the save's I/O).  On clean exit the tmp file is
+    fsync'd and renamed into place and the sidecar commit record written
+    LAST — a crash at any earlier point leaves the previous committed file
+    (or nothing) at the final path, never a truncated artifact.  On error
+    the tmp file is removed.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tmp = path + ".tmp"
+        self._f = None
+        self.crc = 0
+        self.size = 0
+
+    def __enter__(self) -> "AtomicFile":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f = open(self._tmp, "wb")
+        return self
+
+    def write(self, data) -> int:
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self.crc = zlib.crc32(data, self.crc)
+        self.size += len(data)
+        return self._f.write(data)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if exc_type is None:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+        finally:
+            self._f.close()
+        if exc_type is not None:
+            try:
+                os.remove(self._tmp)
+            except OSError:
+                pass
+            return False  # propagate the original error
+        os.replace(self._tmp, self.path)
+        write_commit_record(self.path, size=self.size, crc32=self.crc)
+        return False
+
+
+def write_commit_record(path: str, size: Optional[int] = None,
+                        crc32: Optional[int] = None) -> str:
+    """Write ``<path>.commit.json`` (tmp+rename) for an already-final file.
+
+    ``size``/``crc32`` default to a fresh streamed read of ``path`` — the
+    AtomicFile writer passes both so the commit costs no second read."""
+    if size is None or crc32 is None:
+        size, crc32 = 0, 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc32 = zlib.crc32(chunk, crc32)
+                size += len(chunk)
+    cp = commit_path(path)
+    with open(cp + ".tmp", "w") as f:
+        json.dump({"size": int(size), "crc32": int(crc32)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(cp + ".tmp", cp)
+    return cp
+
+
+def verify_commit_record(path: str, required: bool = False) -> bool:
+    """Check ``path`` against its commit record; True when verified.
+
+    Raises :class:`ModelIntegrityError` on any mismatch (length first —
+    free from a stat — then a streamed CRC), on an unreadable sidecar, or
+    on a missing sidecar when ``required``.  Returns False (no check
+    performed) for a legacy file without a sidecar."""
+    cp = commit_path(path)
+    if not os.path.exists(cp):
+        if required:
+            raise ModelIntegrityError(
+                f"model artifact {path!r} has no commit record ({cp!r}); "
+                "refusing to serve an uncommitted file"
+            )
+        return False
+    try:
+        with open(cp) as f:
+            rec = json.load(f)
+        want_size, want_crc = int(rec["size"]), int(rec["crc32"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise ModelIntegrityError(
+            f"commit record {cp!r} is unreadable ({e}); the artifact "
+            "cannot be verified — restore it or delete both files and "
+            "re-save the model"
+        ) from e
+    try:
+        got_size = os.path.getsize(path)
+    except OSError as e:
+        raise ModelIntegrityError(
+            f"model artifact {path!r} is missing or unreadable ({e}) "
+            "though its commit record exists"
+        ) from e
+    if got_size != want_size:
+        raise ModelIntegrityError(
+            f"model artifact {path!r} is {got_size} bytes but its commit "
+            f"record promises {want_size} — truncated or partially "
+            "overwritten; refusing to load"
+        )
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    if crc != want_crc:
+        raise ModelIntegrityError(
+            f"model artifact {path!r} fails its CRC32 commit record "
+            f"(got {crc:#010x}, recorded {want_crc:#010x}) — on-disk "
+            "corruption; refusing to serve wrong parameters"
+        )
+    return True
+
+
+def atomic_json_dump(obj, path: str) -> None:
+    """JSON-dump ``obj`` to ``path`` atomically (tmp, fsync, rename).
+
+    For the small descriptor files (``pipeline.json``, ``stage.json``)
+    whose truncation would orphan a whole saved pipeline; no sidecar —
+    their loaders validate by parsing."""
+    with open(path + ".tmp", "w") as f:
+        json.dump(obj, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path + ".tmp", path)

@@ -1,0 +1,342 @@
+"""Input validation + per-row quarantine at the mapper boundary.
+
+The reference's ModelMapperAdapter assumes every incoming Row is servable
+(ModelMapperAdapter.java:58-61 maps unconditionally); here one NaN row in
+a column batch would poison the whole jitted computation — a single bad
+byte in a million-row feed turns every prediction in its batch into NaN.
+This module gives ``Mapper.apply`` the hardened boundary instead:
+
+* **validation** — :func:`validate_feature_batch` checks a batch's feature
+  column(s) against the *model*: per-row vector dimension, value type,
+  nulls, and NaN/Inf.  The finite check on matrix-backed columns runs
+  batched on device (one jitted ``isfinite`` reduce — negligible next to
+  the model matmul); object-backed columns pay one host pass over the rows
+  they were going to pay in ``features_dense`` anyway.
+* **quarantine** — bad rows are masked OUT of the jitted computation (the
+  mapper serves the good rows of the batch exactly as it would have served
+  a clean batch) and emitted to a process-wide side-table with a reason
+  code per row (``nan_inf`` / ``bad_dim`` / ``bad_type`` / ``null``),
+  capped by ``FMT_SERVE_QUARANTINE_CAP`` rows per mapper (counters keep
+  the true totals past the cap).
+* **agreement** — :func:`agreed_bad_mask` is the multi-process rule, same
+  shape as the slab pool's hit agreement (``table/slab_pool.py``): *bad
+  wins*.  Inference is process-local by contract (each process scores its
+  own rows — ``apply_sharded`` runs collective-free), so the default path
+  never gathers; a caller whose downstream builder DOES bear collectives
+  (an agreed slab placement keyed on the surviving row count) must pass
+  its mask through the agreement so every process masks the same rows.
+
+Knob: ``FMT_SERVE_QUARANTINE`` (default on).  Off restores the legacy
+fail-open behavior — bad rows flow into the computation unchecked.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.ops.batch import CsrRows
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+__all__ = [
+    "QUARANTINE_REASON_COL",
+    "QUARANTINE_ROW_COL",
+    "agreed_bad_mask",
+    "drain",
+    "emit",
+    "enabled",
+    "quarantine_table",
+    "quarantined_counts",
+    "reset",
+    "validate_feature_batch",
+]
+
+#: extra columns stamped onto quarantined rows in the side-table
+QUARANTINE_REASON_COL = "_quarantine_reason"
+QUARANTINE_ROW_COL = "_quarantine_row"
+
+#: reason codes (the side-table vocabulary)
+REASON_NAN_INF = "nan_inf"
+REASON_BAD_DIM = "bad_dim"
+REASON_BAD_TYPE = "bad_type"
+REASON_NULL = "null"
+
+
+def enabled() -> bool:
+    """Is the quarantine boundary on?  ``FMT_SERVE_QUARANTINE`` (default 1)."""
+    return os.environ.get("FMT_SERVE_QUARANTINE", "1").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+def _cap() -> int:
+    return int(os.environ.get("FMT_SERVE_QUARANTINE_CAP", "10000") or 10000)
+
+
+# -- the on-device finite check ----------------------------------------------
+
+_FINITE_FNS: dict = {}
+
+
+def _rows_finite(X: np.ndarray) -> np.ndarray:
+    """Per-row all-finite mask, batched on device.
+
+    Rows pad to a power-of-two bucket (zeros are finite, so pads never
+    flag) — the same static-shape discipline as the inference applies, so
+    the jit cache stays bounded across batch sizes.
+
+    Outage-safe by construction: validation guards the path that has a
+    CPU fallback, so it must never be the thing that dies first — a
+    transient device failure here degrades to the NumPy ``isfinite``
+    (same semantics, host-side) instead of failing the batch before the
+    mapper's own fallback could have served it."""
+    import jax
+    import jax.numpy as jnp
+
+    n = X.shape[0]
+    b = 64
+    while b < n:
+        b *= 2
+    Xp = X
+    if b != n:
+        Xp = np.zeros((b,) + X.shape[1:], dtype=X.dtype)
+        Xp[:n] = X
+    fn = _FINITE_FNS.get(None)
+    if fn is None:
+        fn = _FINITE_FNS[None] = jax.jit(
+            lambda x: jnp.all(jnp.isfinite(x), axis=1)
+        )
+    try:
+        return np.asarray(fn(Xp))[:n]
+    except Exception as exc:  # noqa: BLE001 - transient-filtered below
+        from flink_ml_tpu.fault.retry import is_transient
+
+        if not is_transient(exc):
+            raise
+        obs.counter_add("serve.validation_fallbacks")
+        return np.isfinite(np.asarray(X, dtype=np.float64)).all(axis=1)
+
+
+# -- validation ---------------------------------------------------------------
+
+
+def validate_feature_batch(
+    batch: Table,
+    dim: int,
+    vector_col: Optional[str] = None,
+    feature_cols: Optional[List[str]] = None,
+    agreed: bool = False,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Validate one batch's features against a model of width ``dim``.
+
+    Returns ``None`` when every row is servable (the common case — the
+    caller keeps its original batch object so zero-copy/pooled paths stay
+    intact), else ``(good_mask, reasons)``: a boolean keep-mask and an
+    object array of reason codes (None for good rows), both batch-aligned.
+
+    ``agreed=True`` routes the bad mask through :func:`agreed_bad_mask`
+    (bad wins across processes) — required whenever the surviving rows
+    feed a collective-bearing builder; the default is the collective-free
+    inference contract.
+    """
+    import jax
+
+    n = batch.num_rows()
+    if n == 0:
+        return None
+    reasons = np.full(n, None, dtype=object)
+    if vector_col is not None:
+        col = batch.col(vector_col)
+        if not DataTypes.is_vector(batch.schema.type_of(vector_col)):
+            # plain numeric column (features_dense reshapes it to (n, 1))
+            finite = np.isfinite(np.asarray(col, dtype=np.float64))
+            reasons[~finite] = REASON_NAN_INF
+        elif isinstance(col, CsrRows):
+            _validate_csr(col, dim, reasons)
+        elif isinstance(col, np.ndarray) and col.ndim == 2:
+            if col.shape[1] > int(dim):
+                reasons[:] = REASON_BAD_DIM  # uniform layout: all rows wide
+            else:
+                finite = _rows_finite(np.asarray(col))
+                reasons[~finite] = REASON_NAN_INF
+        else:
+            _validate_object_rows(col, dim, reasons)
+    elif feature_cols is not None:
+        X = batch.numeric_matrix(feature_cols)  # schema errors stay loud
+        finite = _rows_finite(X)
+        reasons[~finite] = REASON_NAN_INF
+    else:
+        return None
+
+    bad = np.array([r is not None for r in reasons], dtype=bool)
+    if agreed and jax.process_count() > 1:
+        agreed_bad = agreed_bad_mask(bad)
+        # a row another process flagged carries no local diagnosis; stamp
+        # the agreement itself as the reason so the side-table stays honest
+        reasons[np.logical_and(agreed_bad, ~bad)] = "peer_flagged"
+        bad = agreed_bad
+    if not bad.any():
+        return None
+    return ~bad, reasons
+
+
+def _validate_csr(col: CsrRows, dim: int, reasons: np.ndarray) -> None:
+    """Vectorized checks over a CSR-backed sparse column (no per-row Python)."""
+    n = len(col)
+    row_of_entry = np.repeat(np.arange(n), col.nnz_per_row())
+    bad_idx = np.logical_or(col.indices >= int(dim), col.indices < 0)
+    if bad_idx.any():
+        reasons[np.unique(row_of_entry[bad_idx])] = REASON_BAD_DIM
+    bad_val = ~np.isfinite(col.values)
+    if bad_val.any():
+        rows = np.unique(row_of_entry[bad_val])
+        for r in rows:
+            if reasons[r] is None:
+                reasons[r] = REASON_NAN_INF
+
+
+def _validate_object_rows(col, dim: int, reasons: np.ndarray) -> None:
+    for i, v in enumerate(col):
+        if v is None:
+            reasons[i] = REASON_NULL
+        elif isinstance(v, SparseVector):
+            if v.indices.size and (
+                int(v.indices.max()) >= int(dim) or int(v.indices.min()) < 0
+            ):
+                reasons[i] = REASON_BAD_DIM
+            elif not np.isfinite(v.vals).all():
+                reasons[i] = REASON_NAN_INF
+        elif isinstance(v, (DenseVector, Vector)):
+            dv = v if isinstance(v, DenseVector) else v.to_dense()
+            if dv.values.shape[0] > int(dim):
+                reasons[i] = REASON_BAD_DIM
+            elif not np.isfinite(dv.values).all():
+                reasons[i] = REASON_NAN_INF
+        else:
+            reasons[i] = REASON_BAD_TYPE
+
+
+def agreed_bad_mask(bad: np.ndarray) -> np.ndarray:
+    """Cross-process agreement on a quarantine mask: element-wise *bad wins*
+    (identity single-process).
+
+    The quarantine analog of the slab pool's hit agreement (*miss wins*,
+    ``table/slab_pool.py``): divergent masks feed collective-bearing
+    builders differently-shaped survivors — a hang or a silent
+    misalignment — so any process flagging a row forces every process to
+    quarantine it.  Rides ``agree_max``, so the ``FMT_AGREE_TIMEOUT_S``
+    dead-peer watchdog applies."""
+    import jax
+
+    bad = np.asarray(bad, dtype=bool)
+    if jax.process_count() == 1:
+        return bad
+    from flink_ml_tpu.parallel.mesh import agree_max
+
+    return np.asarray(
+        agree_max(*(int(b) for b in bad)), dtype=np.int64
+    ).astype(bool)
+
+
+# -- the side-table -----------------------------------------------------------
+
+_LOCK = threading.Lock()
+_STORE: Dict[str, List[Table]] = {}
+_STORED_ROWS: Dict[str, int] = {}
+_DROPPED: Dict[str, int] = {}
+
+
+def emit(name: str, batch: Table, good_mask: np.ndarray,
+         reasons: np.ndarray, row_offset: int = 0) -> int:
+    """Record ``batch``'s bad rows in ``name``'s quarantine side-table.
+
+    Returns the number of rows quarantined.  The side-table row carries the
+    original columns plus ``_quarantine_reason`` (the code) and
+    ``_quarantine_row`` (the row's offset in the applied table, so an
+    operator can find it in the source feed).  Counters
+    (``serve.quarantined_rows`` and per-reason breakdowns) always hold the
+    true totals; the stored table is capped per mapper."""
+    bad_mask = ~np.asarray(good_mask, dtype=bool)
+    n_bad = int(bad_mask.sum())
+    if n_bad == 0:
+        return 0
+    obs.counter_add("serve.quarantined_rows", n_bad)
+    bad_reasons = np.asarray(reasons, dtype=object)[bad_mask]
+    for reason in set(bad_reasons):
+        obs.counter_add(
+            f"serve.quarantined.{reason}",
+            int(sum(1 for r in bad_reasons if r == reason)),
+        )
+    rows = np.nonzero(bad_mask)[0] + int(row_offset)
+    side = (
+        batch.filter_rows(bad_mask)
+        .with_column(QUARANTINE_REASON_COL, DataTypes.STRING,
+                     list(bad_reasons))
+        .with_column(QUARANTINE_ROW_COL, DataTypes.LONG, rows)
+    )
+    with _LOCK:
+        stored = _STORED_ROWS.get(name, 0)
+        room = max(_cap() - stored, 0)
+        if room >= n_bad:
+            _STORE.setdefault(name, []).append(side)
+            _STORED_ROWS[name] = stored + n_bad
+        elif room > 0:
+            _STORE.setdefault(name, []).append(side.slice_rows(0, room))
+            _STORED_ROWS[name] = stored + room
+            _DROPPED[name] = _DROPPED.get(name, 0) + (n_bad - room)
+        else:
+            _DROPPED[name] = _DROPPED.get(name, 0) + n_bad
+    return n_bad
+
+
+def quarantine_table(name: str) -> Optional[Table]:
+    """The accumulated side-table for one mapper (None when empty)."""
+    with _LOCK:
+        parts = list(_STORE.get(name, ()))
+    if not parts:
+        return None
+    return Table.concat(parts) if len(parts) > 1 else parts[0]
+
+
+def quarantined_counts() -> Dict[str, int]:
+    """Stored-row count per mapper (dropped-past-cap rows not included)."""
+    with _LOCK:
+        return dict(_STORED_ROWS)
+
+
+def drain(name: Optional[str] = None) -> Dict[str, Optional[Table]]:
+    """Remove and return the side-table(s) — one mapper or all of them."""
+    with _LOCK:
+        names = [name] if name is not None else list(_STORE)
+        out = {}
+        for n in names:
+            parts = _STORE.pop(n, [])
+            _STORED_ROWS.pop(n, None)
+            out[n] = (
+                Table.concat(parts) if len(parts) > 1
+                else (parts[0] if parts else None)
+            )
+        return out
+
+
+def reset() -> None:
+    """Clear every side-table and drop counter (tests; per-run scoping)."""
+    with _LOCK:
+        _STORE.clear()
+        _STORED_ROWS.clear()
+        _DROPPED.clear()
+
+
+def make_quarantine_schema(input_schema: Schema) -> Schema:
+    """The side-table schema for a given input schema (docs/consumers)."""
+    names = input_schema.field_names + [
+        QUARANTINE_REASON_COL, QUARANTINE_ROW_COL,
+    ]
+    types = input_schema.field_types + [DataTypes.STRING, DataTypes.LONG]
+    return Schema(names, types)

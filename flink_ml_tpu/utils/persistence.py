@@ -22,30 +22,68 @@ import numpy as np
 
 from flink_ml_tpu.ops.codec import parse_sparse, parse_vector, vector_to_string
 from flink_ml_tpu.ops.vector import Vector
+from flink_ml_tpu.serve.errors import ModelIntegrityError
+from flink_ml_tpu.serve.integrity import AtomicFile, verify_commit_record
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
 
 
 def save_table(table: Table, path: str) -> None:
-    """Write a table as JSONL with a schema header."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    """Write a table as JSONL with a schema header — atomically.
+
+    The bytes stream into ``<path>.tmp`` (CRC32 computed in the same
+    pass), fsync, rename, then a ``<path>.commit.json`` sidecar records
+    the length+CRC as the commit record.  An interrupted save can no
+    longer leave a truncated model file at the final path: either the
+    previous committed file survives untouched, or the new one is fully
+    in place with a matching commit record."""
     schema = table.schema
-    with open(path, "w") as f:
+    with AtomicFile(path) as f:
         f.write(json.dumps({"schema": schema.to_dict()}) + "\n")
         for row in table.to_rows():
             f.write(json.dumps(encode_row(row, schema)) + "\n")
 
 
 def load_table(path: str) -> Table:
+    """Load a saved table, integrity-verified.
+
+    The commit record (when present — legacy files without one still
+    load) is checked first: a length or CRC mismatch raises
+    :class:`~flink_ml_tpu.serve.errors.ModelIntegrityError` before a
+    single row is parsed.  Parse-level damage a sidecar can't see (a
+    hand-truncated legacy file, a row whose arity disagrees with the
+    declared schema) raises the same diagnostic type — a model file must
+    load whole or fail loudly, never serve partial params."""
+    verify_commit_record(path)
     with open(path) as f:
-        header = json.loads(f.readline())
-        schema = Schema.from_dict(header["schema"])
+        try:
+            header = json.loads(f.readline())
+            schema = Schema.from_dict(header["schema"])
+        except (ValueError, KeyError, TypeError) as e:
+            raise ModelIntegrityError(
+                f"model table {path!r} has an unreadable schema header "
+                f"({e}); the file is corrupt or not a saved table"
+            ) from e
         rows: List[tuple] = []
-        for line in f:
+        arity = len(schema)
+        for lineno, line in enumerate(f, start=2):
             line = line.strip()
             if not line:
                 continue
-            raw = json.loads(line)
+            try:
+                raw = json.loads(line)
+            except ValueError as e:
+                raise ModelIntegrityError(
+                    f"model table {path!r} line {lineno} is not valid "
+                    f"JSON ({e}) — truncated or corrupted row data"
+                ) from e
+            if not isinstance(raw, list) or len(raw) != arity:
+                raise ModelIntegrityError(
+                    f"model table {path!r} line {lineno} holds "
+                    f"{len(raw) if isinstance(raw, list) else type(raw).__name__}"
+                    f" values for a {arity}-column schema "
+                    f"{schema.field_names} — row/schema mismatch"
+                )
             rows.append(decode_row(raw, schema))
     return Table.from_rows(rows, schema)
 

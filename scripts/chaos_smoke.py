@@ -21,6 +21,22 @@ Legs (all on the virtual 8-device CPU mesh):
 Run directly (``python scripts/chaos_smoke.py``) or via the CI
 ``chaos-smoke`` job.  Exit code 0 = all parity and accounting assertions
 held.
+
+**Serving mode** (``--serve``, ISSUE 4): the inference-path counterpart.
+For each estimator family (GLM, KMeans, Knn, StandardScaler):
+
+  1. **quarantine** — one injected bad row (NaN) per batch must be masked
+     out with a reason code in the side-table while every surviving row's
+     prediction EQUALS the clean run's;
+  2. **breaker + fallback** — under a sticky ``serve.dispatch`` fault the
+     per-mapper circuit breaker opens and the NumPy CPU fallback serves,
+     with discrete predictions exactly equal to the device run's;
+  3. **model integrity** — one corrupted model file per family must raise
+     ``ModelIntegrityError`` at load (never wrong predictions);
+
+plus the RunReport accounting: transform reports carry the serve deltas
+and ``serve_degraded_runs`` flags the fallback-only transforms (the
+``obs --check`` SERVE-DEGRADED line).
 """
 
 import json
@@ -208,10 +224,152 @@ def sigterm_resume_leg(mode: str, tmp: str) -> None:
     print(f"  {mode}: SIGTERM -> emergency checkpoint -> exact resume OK")
 
 
+def _serve_families(table):
+    """(name, fitted model, prediction column, discrete) per estimator
+    family — the serving-mode test matrix."""
+    from flink_ml_tpu.lib import KMeans, Knn, LogisticRegression, StandardScaler
+
+    lr = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3).fit(table)
+    )
+    km = (
+        KMeans().set_vector_col("features").set_k(4)
+        .set_prediction_col("cluster").set_max_iter(3).fit(table)
+    )
+    knn = (
+        Knn().set_vector_col("features").set_label_col("label")
+        .set_k(3).set_prediction_col("p").fit(table)
+    )
+    sc = (
+        StandardScaler().set_selected_col("features")
+        .set_output_col("scaled").fit(table)
+    )
+    return [
+        ("LogisticRegression", lr, "p", True),
+        ("KMeans", km, "cluster", True),
+        ("Knn", knn, "p", True),
+        ("StandardScaler", sc, "scaled", False),
+    ]
+
+
+def _col_matrix(table, col):
+    """A column as a comparable float matrix (vector columns densify)."""
+    from flink_ml_tpu.table.schema import DataTypes
+
+    if DataTypes.is_vector(table.schema.type_of(col)):
+        return np.asarray(table.features_dense(col), dtype=np.float64)
+    return np.asarray(table.col(col), dtype=np.float64).reshape(-1, 1)
+
+
+def serve_main() -> int:
+    """The serving-robustness chaos matrix (``--serve``)."""
+    import warnings
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_serve_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ["FMT_SERVE_BREAKER_THRESHOLD"] = "2"
+    os.environ["FMT_RETRY_ATTEMPTS"] = "2"
+    os.environ["FMT_RETRY_BASE_S"] = "0.001"
+    from flink_ml_tpu import fault, obs, serve
+    from flink_ml_tpu.serve import ModelIntegrityError, quarantine
+    from flink_ml_tpu.table.table import Table
+
+    table = dense_table()
+    X, y = make_xy()
+    bad_row = 7  # the injected bad row, one per (single-batch) transform
+    Xbad = X.astype(np.float32).copy()
+    Xbad[bad_row, 1] = np.nan
+    bad_table = Table.from_columns(
+        table.schema, {"features": Xbad, "label": y}
+    )
+
+    for name, model, pred_col, discrete in _serve_families(table):
+        serve_name = type(model).__name__  # the mapper telemetry key
+        (clean,) = model.transform(table)
+        ref = _col_matrix(clean, pred_col)
+
+        # -- leg 1: one bad row per batch -> quarantined, good rows exact --
+        quarantine.reset()
+        (q_out,) = model.transform(bad_table)
+        assert q_out.num_rows() == N - 1, (
+            f"{name}: expected {N - 1} served rows, got {q_out.num_rows()}"
+        )
+        qt = quarantine.quarantine_table(serve_name)
+        assert qt is not None and qt.num_rows() == 1, f"{name}: no quarantine"
+        reason = qt.col(quarantine.QUARANTINE_REASON_COL)[0]
+        row = int(qt.col(quarantine.QUARANTINE_ROW_COL)[0])
+        assert reason == "nan_inf" and row == bad_row, (name, reason, row)
+        got = _col_matrix(q_out, pred_col)
+        np.testing.assert_array_equal(
+            got, np.delete(ref, bad_row, axis=0),
+            err_msg=f"{name}: quarantine changed surviving predictions",
+        )
+
+        # -- leg 2: sticky dispatch faults -> breaker opens, fallback parity --
+        serve.reset_breakers()
+        obs.reset()
+        fault.configure("serve.dispatch@1+", seed=0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                model.transform(table)          # breaker absorbs failures
+                (fb_out,) = model.transform(table)  # now fully open
+        finally:
+            fault.configure(None)
+        fb = _col_matrix(fb_out, pred_col)
+        if discrete:
+            np.testing.assert_array_equal(
+                fb, ref, err_msg=f"{name}: fallback predictions diverge"
+            )
+        else:
+            np.testing.assert_allclose(
+                fb, ref, rtol=1e-5, atol=1e-6,
+                err_msg=f"{name}: fallback values diverge",
+            )
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("serve.fallbacks", 0) >= 1, (name, counters)
+        assert serve.breaker(serve_name).state == 1.0, f"{name}: not open"
+
+        # -- leg 3: corrupted model file -> ModelIntegrityError, never junk --
+        stage_dir = os.path.join(tempfile.mkdtemp(prefix="chaos_serve_m_"),
+                                 "stage")
+        model.save(stage_dir)
+        mdf = os.path.join(stage_dir, "model_data.jsonl")
+        blob = bytearray(open(mdf, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(mdf, "wb") as f:
+            f.write(bytes(blob))
+        from flink_ml_tpu.api.core import load_stage
+
+        try:
+            load_stage(stage_dir)
+            raise AssertionError(f"{name}: corrupted model file loaded")
+        except ModelIntegrityError:
+            pass
+        print(f"  {name}: quarantine + breaker fallback + integrity OK "
+              f"(fallbacks={counters.get('serve.fallbacks'):g})")
+
+    # -- RunReport accounting: fallback-only transforms are SERVE-DEGRADED ---
+    from flink_ml_tpu.obs.report import load_reports, serve_degraded_runs
+
+    degraded = serve_degraded_runs(load_reports(reports_dir))
+    assert degraded, "no transform RunReport was flagged SERVE-DEGRADED"
+    for d in degraded:
+        assert d["serve"].get("serve.fallbacks", 0) >= 1, d
+    print(f"  RunReports: {len(degraded)} SERVE-DEGRADED transform(s) "
+          "flagged")
+    print("serving chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
         return 0
+    if "--serve" in sys.argv:
+        return serve_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -1,0 +1,946 @@
+"""Serving robustness layer (ISSUE 4): input quarantine at the mapper
+boundary, model-integrity verification, the inference circuit breaker with
+its NumPy CPU fallback, and the per-transform serve accounting."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import fault, obs, serve
+from flink_ml_tpu.common.mapper import Mapper
+from flink_ml_tpu.fault import injection
+from flink_ml_tpu.ops.vector import DenseVector, SparseVector
+from flink_ml_tpu.serve import (
+    MapperOutputMisalignedError,
+    ModelIntegrityError,
+    quarantine,
+)
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import persistence
+from flink_ml_tpu.utils.persistence import load_table, save_table
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve_state(tmp_path, monkeypatch):
+    # transform RunReports must land in a per-test dir, never the
+    # committed reports/; breakers, quarantine tables, and injection
+    # schedules are process-wide and must not leak across tests
+    monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path / "_reports"))
+    monkeypatch.setenv("FMT_RETRY_BASE_S", "0.001")
+    injection.reset()
+    serve.reset_breakers()
+    quarantine.reset()
+    obs.disable()
+    obs.reset()
+    yield
+    injection.reset()
+    serve.reset_breakers()
+    quarantine.reset()
+    obs.disable()
+    obs.reset()
+
+
+def _dense_table(X, y):
+    return Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+
+
+def _xy(n=64, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    return X, y
+
+
+def _logreg_model(X, y, detail=None):
+    from flink_ml_tpu.lib import LogisticRegression
+
+    est = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3)
+    )
+    if detail:
+        est.set_prediction_detail_col(detail)
+    return est.fit(_dense_table(X, y))
+
+
+# -- quarantine ---------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_nan_row_is_masked_and_good_rows_serve_exactly(self):
+        X, y = _xy()
+        model = _logreg_model(X, y)
+        (clean,) = model.transform(_dense_table(X, y))
+        ref = np.asarray(clean.col("p"))
+
+        Xbad = X.copy()
+        Xbad[5, 2] = np.nan
+        Xbad[17, 0] = np.inf
+        (out,) = model.transform(_dense_table(Xbad, y))
+        assert out.num_rows() == X.shape[0] - 2
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.delete(ref, [5, 17])
+        )
+        qt = quarantine.quarantine_table("LogisticRegressionModel")
+        assert qt is not None and qt.num_rows() == 2
+        assert list(qt.col(quarantine.QUARANTINE_REASON_COL)) == [
+            "nan_inf", "nan_inf",
+        ]
+        assert list(qt.col(quarantine.QUARANTINE_ROW_COL)) == [5, 17]
+
+    def test_quarantine_counters_land_in_registry(self):
+        obs.enable()
+        X, y = _xy()
+        model = _logreg_model(X, y)
+        Xbad = X.copy()
+        Xbad[3, 0] = np.nan
+        model.transform(_dense_table(Xbad, y))
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.quarantined_rows") == 1
+        assert c.get("serve.quarantined.nan_inf") == 1
+
+    def test_object_column_reason_codes(self):
+        """Null, wrong type, over-wide dense, out-of-range sparse, and
+        non-finite sparse rows each carry their own reason code."""
+        dim = 3
+        good = DenseVector(np.ones(dim))
+        rows = [
+            (good, 1.0),
+            (None, 0.0),                                   # null
+            (DenseVector(np.ones(dim + 2)), 0.0),          # bad_dim (wide)
+            (SparseVector(8, [7], [1.0]), 0.0),            # bad_dim (index)
+            (SparseVector(dim, [1], [np.nan]), 0.0),       # nan_inf
+            (good, 0.0),
+        ]
+        t = Table.from_rows(
+            rows,
+            Schema.of(("features", DataTypes.VECTOR), ("label", "double")),
+        )
+        verdict = quarantine.validate_feature_batch(
+            t, dim=dim, vector_col="features"
+        )
+        assert verdict is not None
+        good_mask, reasons = verdict
+        assert list(good_mask) == [True, False, False, False, False, True]
+        assert list(reasons[1:5]) == [
+            "null", "bad_dim", "bad_dim", "nan_inf",
+        ]
+
+    def test_csr_column_vectorized_validation(self):
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        col = CsrRows(
+            dim=4,
+            indptr=[0, 2, 3, 5],
+            indices=[0, 1, 9, 2, 3],       # row 1 holds index 9 >= dim
+            values=[1.0, 2.0, 1.0, np.inf, 1.0],  # row 2 holds an inf
+        )
+        t = Table.from_columns(
+            Schema.of(("v", DataTypes.SPARSE_VECTOR), ("y", "double")),
+            {"v": col, "y": np.zeros(3)},
+        )
+        good_mask, reasons = quarantine.validate_feature_batch(
+            t, dim=4, vector_col="v"
+        )
+        assert list(good_mask) == [True, False, False]
+        assert reasons[1] == "bad_dim" and reasons[2] == "nan_inf"
+
+    def test_sparse_csr_batch_quarantines_through_transform(self):
+        """End to end on the CSR-backed sparse inference path: the NaN row
+        leaves the segment-CSR matvec, survivors score exactly."""
+        from flink_ml_tpu.lib import LogisticRegression
+        from flink_ml_tpu.ops.batch import CsrRows
+
+        rng = np.random.RandomState(1)
+        dim, n = 16, 32
+        indptr = np.arange(0, 2 * n + 1, 2)
+        indices = rng.randint(0, dim, 2 * n)
+        values = rng.randn(2 * n)
+        schema = Schema.of(("features", DataTypes.SPARSE_VECTOR),
+                           ("label", "double"))
+        y = (rng.randn(n) > 0).astype(np.float64)
+        clean_col = CsrRows(dim, indptr, indices, values)
+        t = Table.from_columns(schema, {"features": clean_col, "label": y})
+        model = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_num_features(dim).set_max_iter(2).fit(t)
+        )
+        (clean,) = model.transform(t)
+        ref = np.asarray(clean.col("p"))
+
+        bad_values = values.copy()
+        bad_values[indptr[9]] = np.nan  # poison row 9's first entry
+        tb = Table.from_columns(
+            schema,
+            {"features": CsrRows(dim, indptr, indices, bad_values),
+             "label": y},
+        )
+        (out,) = model.transform(tb)
+        assert out.num_rows() == n - 1
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.delete(ref, 9)
+        )
+        qt = quarantine.quarantine_table("LogisticRegressionModel")
+        assert list(qt.col(quarantine.QUARANTINE_ROW_COL)) == [9]
+        assert qt.col(quarantine.QUARANTINE_REASON_COL)[0] == "nan_inf"
+
+    def test_feature_cols_nan_detection(self):
+        t = Table.from_columns(
+            Schema.of(("a", "double"), ("b", "double")),
+            {"a": [1.0, np.nan, 3.0], "b": [1.0, 1.0, 1.0]},
+        )
+        good_mask, reasons = quarantine.validate_feature_batch(
+            t, dim=2, feature_cols=["a", "b"]
+        )
+        assert list(good_mask) == [True, False, True]
+        assert reasons[1] == "nan_inf"
+
+    def test_clean_batch_returns_none_and_original_object_serves(self):
+        X, y = _xy(16)
+        t = _dense_table(X, y)
+        assert quarantine.validate_feature_batch(
+            t, dim=X.shape[1], vector_col="features"
+        ) is None
+
+    def test_all_rows_quarantined_yields_empty_result(self):
+        X, y = _xy(8)
+        model = _logreg_model(X, y)
+        Xbad = np.full_like(X, np.nan)
+        (out,) = model.transform(_dense_table(Xbad, y))
+        assert out.num_rows() == 0
+        assert out.schema.contains("p")
+        qt = quarantine.quarantine_table("LogisticRegressionModel")
+        assert qt.num_rows() == 8
+
+    def test_quarantine_off_restores_failopen(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_QUARANTINE", "0")
+        X, y = _xy(16)
+        model = _logreg_model(X, y)
+        Xbad = X.copy()
+        Xbad[2, 0] = np.nan
+        (out,) = model.transform(_dense_table(Xbad, y))
+        # legacy behavior: the bad row flows through and poisons only its
+        # own prediction-score row (scores > 0 on NaN -> False -> 0.0)
+        assert out.num_rows() == X.shape[0]
+        assert quarantine.quarantine_table("LogisticRegressionModel") is None
+
+    def test_batched_apply_records_table_level_row_offsets(self):
+        X, y = _xy(64)
+        model = _logreg_model(X, y)
+        mapper = model._make_mapper(_dense_table(X, y).schema)
+        mapper.load_model(*model.get_model_data())
+        Xbad = X.copy()
+        Xbad[5, 0] = np.nan
+        Xbad[40, 1] = np.nan
+        out = mapper.apply(_dense_table(Xbad, y), batch_size=16)
+        assert out.num_rows() == 62
+        qt = quarantine.quarantine_table("LogisticRegressionModel")
+        assert sorted(qt.col(quarantine.QUARANTINE_ROW_COL)) == [5, 40]
+
+    def test_side_table_cap_bounds_memory_not_counters(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_QUARANTINE_CAP", "3")
+        obs.enable()
+        X, y = _xy(16)
+        model = _logreg_model(X, y)
+        Xbad = X.copy()
+        Xbad[:8, 0] = np.nan
+        model.transform(_dense_table(Xbad, y))
+        qt = quarantine.quarantine_table("LogisticRegressionModel")
+        assert qt.num_rows() == 3  # capped
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.quarantined_rows") == 8  # true total
+
+    def test_validation_survives_a_device_outage(self, monkeypatch):
+        """The finite check guards the path that HAS a CPU fallback, so a
+        device blip during validation must degrade to the host isfinite,
+        never fail the batch before the fallback could serve it."""
+        import jax
+
+        def dead_jit(fn):
+            def raises(*a, **kw):
+                raise RuntimeError("UNAVAILABLE: device unreachable")
+
+            return raises
+
+        monkeypatch.setattr(jax, "jit", dead_jit)
+        quarantine._FINITE_FNS.clear()
+        try:
+            obs.enable()
+            X, _ = _xy(8)
+            X[2, 1] = np.nan
+            t = Table.from_columns(
+                Schema.of(("features", DataTypes.DENSE_VECTOR)),
+                {"features": X},
+            )
+            good_mask, reasons = quarantine.validate_feature_batch(
+                t, dim=X.shape[1], vector_col="features"
+            )
+            assert list(good_mask) == [i != 2 for i in range(8)]
+            assert reasons[2] == "nan_inf"
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("serve.validation_fallbacks") == 1
+        finally:
+            quarantine._FINITE_FNS.clear()
+
+    def test_drain_clears_the_side_table(self):
+        X, y = _xy(8)
+        model = _logreg_model(X, y)
+        Xbad = X.copy()
+        Xbad[1, 0] = np.nan
+        model.transform(_dense_table(Xbad, y))
+        drained = quarantine.drain("LogisticRegressionModel")
+        assert drained["LogisticRegressionModel"].num_rows() == 1
+        assert quarantine.quarantine_table("LogisticRegressionModel") is None
+
+
+# -- map_batch row-alignment contract ----------------------------------------
+
+
+class _ShearMapper(Mapper):
+    """A buggy mapper: drops the last row of its output column.  With no
+    reserved input cols the merge would silently build a shorter table."""
+
+    def output_cols(self):
+        return ["out"], [DataTypes.DOUBLE]
+
+    def reserved_cols(self):
+        return []
+
+    def map_batch(self, batch):
+        return {"out": np.zeros(batch.num_rows() - 1)}
+
+
+class TestOutputAlignment:
+    def test_misaligned_output_column_raises_named_error(self):
+        t = Table.from_columns(
+            Schema.of(("a", "double")), {"a": np.arange(4.0)}
+        )
+        mapper = _ShearMapper(t.schema)
+        with pytest.raises(MapperOutputMisalignedError) as ei:
+            mapper.apply(t)
+        msg = str(ei.value)
+        assert "_ShearMapper" in msg and "'out'" in msg
+        assert ei.value.got == 3 and ei.value.expected == 4
+
+    def test_missing_output_column_still_loud(self):
+        class _Missing(Mapper):
+            def output_cols(self):
+                return ["out"], [DataTypes.DOUBLE]
+
+            def map_batch(self, batch):
+                return {}
+
+        t = Table.from_columns(
+            Schema.of(("a", "double")), {"a": np.arange(4.0)}
+        )
+        with pytest.raises(ValueError, match="did not produce"):
+            _Missing(t.schema).apply(t)
+
+
+# -- circuit breaker + dispatch -----------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "3")
+        b = serve.CircuitBreaker("t")
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == 0.0 and b.allow_device()
+        b.record_failure()
+        assert b.state == 1.0 and not b.allow_device()
+
+    def test_half_open_probe_then_close_or_reopen(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_SERVE_BREAKER_COOLDOWN_S", "0")
+        b = serve.CircuitBreaker("t")
+        b.record_failure()
+        assert b.state == 1.0
+        assert b.allow_device()  # cooldown elapsed -> half-open probe
+        assert b.state == 0.5
+        b.record_failure()       # the probe failed -> re-open immediately
+        assert b.state == 1.0
+        assert b.allow_device()
+        b.record_success()
+        assert b.state == 0.0
+
+    def test_success_resets_consecutive_failures(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "2")
+        b = serve.CircuitBreaker("t")
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == 0.0  # never two consecutive
+
+
+class TestDispatch:
+    def test_transient_failure_degrades_to_fallback(self, monkeypatch):
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "2")
+        obs.enable()
+        injection.configure("serve.dispatch@1+")
+        with pytest.warns(RuntimeWarning, match="CPU fallback"):
+            out = serve.dispatch(
+                "t", device=lambda: "device", fallback=lambda: "cpu"
+            )
+        assert out == "cpu"
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.fallbacks") == 1
+        assert c.get("fault.retries.serve.dispatch") == 1
+
+    def test_transient_failure_without_fallback_reraises(self, monkeypatch):
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        injection.configure("serve.dispatch@1+")
+        with pytest.raises(fault.InjectedFault):
+            serve.dispatch("t", device=lambda: "device")
+
+    def test_deterministic_bug_is_never_papered_over(self):
+        def buggy():
+            raise ValueError("shape mismatch")
+
+        with pytest.raises(ValueError, match="shape mismatch"):
+            serve.dispatch("t", device=buggy, fallback=lambda: "cpu")
+        assert serve.breaker("t").state == 0.0  # bugs are not breaker food
+
+    def test_open_breaker_skips_device_entirely(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        calls = {"n": 0}
+
+        def device():
+            calls["n"] += 1
+            raise RuntimeError("UNAVAILABLE: device gone")
+
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            serve.dispatch("t", device=device, fallback=lambda: "cpu")
+        assert serve.breaker("t").state == 1.0
+        out = serve.dispatch("t", device=device, fallback=lambda: "cpu")
+        assert out == "cpu" and calls["n"] == 1  # device not re-attempted
+
+    def test_call_time_lands_in_deadline_histogram(self):
+        obs.enable()
+        serve.dispatch("t", device=lambda: 42, fallback=None)
+        snap = obs.registry().snapshot()["timings"]
+        assert snap["serve.deadline_ms"]["count"] == 1
+
+    def test_deadline_overrun_feeds_the_breaker(self, monkeypatch):
+        import time
+
+        monkeypatch.setenv("FMT_SERVE_DEADLINE_MS", "1")
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "2")
+        obs.enable()
+
+        def slow():
+            time.sleep(0.01)
+            return "late"
+
+        assert serve.dispatch("t", device=slow, fallback=lambda: "cpu") == "late"
+        assert serve.dispatch("t", device=slow, fallback=lambda: "cpu") == "late"
+        # two overruns opened the breaker: the third call serves from CPU
+        assert serve.breaker("t").state == 1.0
+        assert serve.dispatch("t", device=slow, fallback=lambda: "cpu") == "cpu"
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.deadline_exceeded") == 2
+
+
+class TestFallbackParity:
+    """The NumPy CPU path must agree with the device path: discrete
+    outputs exactly, raw scores to float-accumulation tolerance."""
+
+    def _force_fallback(self, fn, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        import warnings
+
+        injection.configure("serve.dispatch@1+")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                fn()          # absorbs the failure, opens the breaker
+                return fn()   # fully degraded
+        finally:
+            injection.reset()
+
+    def test_logreg_dense(self, monkeypatch):
+        X, y = _xy()
+        model = _logreg_model(X, y, detail="prob")
+        t = _dense_table(X, y)
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.asarray(ref.col("p"))
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.col("prob")), np.asarray(ref.col("prob")),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_logreg_sparse(self, monkeypatch):
+        rng = np.random.RandomState(3)
+        dim, n = 32, 48
+        rows = []
+        for i in range(n):
+            idx = rng.choice(dim, 4, replace=False)
+            rows.append(
+                (SparseVector(dim, np.sort(idx), rng.randn(4)),
+                 float(i % 2))
+            )
+        t = Table.from_rows(
+            rows,
+            Schema.of(("features", DataTypes.SPARSE_VECTOR),
+                      ("label", "double")),
+        )
+        from flink_ml_tpu.lib import LogisticRegression
+
+        model = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_num_features(dim).set_max_iter(2).fit(t)
+        )
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.asarray(ref.col("p"))
+        )
+
+    def test_kmeans_assignment(self, monkeypatch):
+        from flink_ml_tpu.lib import KMeans
+
+        X, y = _xy(n=96, d=3, seed=5)
+        t = _dense_table(X, y)
+        model = (
+            KMeans().set_vector_col("features").set_k(5)
+            .set_prediction_col("c").set_prediction_detail_col("dist")
+            .set_max_iter(4).fit(t)
+        )
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        np.testing.assert_array_equal(
+            np.asarray(out.col("c")), np.asarray(ref.col("c"))
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.col("dist")), np.asarray(ref.col("dist")),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_knn_vote(self, monkeypatch):
+        from flink_ml_tpu.lib import Knn
+
+        X, y = _xy(n=48, d=3, seed=7)
+        t = _dense_table(X, y)
+        model = (
+            Knn().set_vector_col("features").set_label_col("label")
+            .set_k(3).set_prediction_col("p").fit(t)
+        )
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.asarray(ref.col("p"))
+        )
+
+    def test_knn_fallback_chunks_the_reference_set(self, monkeypatch):
+        """The CPU fallback must carry its top-k across reference chunks
+        (memory bound O(batch x chunk)) and still match the device path —
+        exercised with a chunk far smaller than the training set."""
+        from flink_ml_tpu.lib import Knn
+        from flink_ml_tpu.lib.knn import KnnModelMapper
+
+        monkeypatch.setattr(KnnModelMapper, "CPU_FALLBACK_CHUNK", 16)
+        X, y = _xy(n=80, d=3, seed=11)
+        t = _dense_table(X, y)
+        model = (
+            Knn().set_vector_col("features").set_label_col("label")
+            .set_k(5).set_prediction_col("p")
+            .set_prediction_detail_col("d").fit(t)
+        )
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        np.testing.assert_array_equal(
+            np.asarray(out.col("p")), np.asarray(ref.col("p"))
+        )
+        # compare SQUARED distances: the self-match's true distance is 0,
+        # where sqrt turns a ~5e-7 f32 cancellation residue into ~7e-4
+        np.testing.assert_allclose(
+            np.asarray(out.col("d")) ** 2, np.asarray(ref.col("d")) ** 2,
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_online_predict_fallback_serves_without_device_reads(
+        self, monkeypatch
+    ):
+        """The streaming predict fallback must not require a D2H pull: when
+        even the param fetch dies, the last-reachable host mirror serves."""
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        import warnings
+
+        from flink_ml_tpu.lib import OnlineLogisticRegression
+
+        X, y = _xy(n=96, d=3, seed=13)
+        t = _dense_table(X, y)
+        est = (
+            OnlineLogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_global_batch_size(32).set_window_ms(100)
+        )
+        injection.configure("serve.dispatch@1+")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                from flink_ml_tpu.table.sources import GeneratorSource
+
+                rows = t.to_rows()
+                source = GeneratorSource.linear_timestamps(
+                    rows, 4, t.schema
+                )
+                pred_source = GeneratorSource.linear_timestamps(
+                    rows, 4, t.schema
+                )
+                model, result = est.fit_unbounded(
+                    source, prediction_source=pred_source
+                )
+        finally:
+            injection.reset()
+        # every batch predicted through the fallback, none dropped
+        assert len(result.predictions) == len(rows)
+
+    def test_standard_scaler_exact(self, monkeypatch):
+        from flink_ml_tpu.lib import StandardScaler
+
+        X, y = _xy(n=32)
+        t = _dense_table(X, y)
+        model = (
+            StandardScaler().set_selected_col("features")
+            .set_output_col("s").fit(t)
+        )
+        (ref,) = model.transform(t)
+        (out,) = self._force_fallback(lambda: model.transform(t), monkeypatch)
+        # elementwise math: the fallback is bit-exact, not just close
+        np.testing.assert_array_equal(
+            np.asarray(out.features_dense("s")),
+            np.asarray(ref.features_dense("s")),
+        )
+
+
+# -- multi-process agreement (satellite: mirror the slab pool's rules) --------
+
+
+class TestMultiProcessAgreement:
+    def _two_process(self, monkeypatch, peer_row):
+        """Simulate a 2-process fleet: allgather returns our row stacked
+        with a fixed peer row (the test_fault dead-peer idiom)."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda x, **kw: np.stack(
+                [np.asarray(x), np.asarray(peer_row, dtype=np.asarray(x).dtype)]
+            ),
+        )
+
+    def test_agreed_bad_mask_bad_wins(self, monkeypatch):
+        local = np.array([False, True, False, False])
+        peer = [1, 0, 0, 1]  # the peer flagged rows 0 and 3
+        self._two_process(monkeypatch, peer)
+        agreed = quarantine.agreed_bad_mask(local)
+        assert list(agreed) == [True, True, False, True]
+
+    def test_agreed_mask_identity_single_process(self):
+        local = np.array([True, False])
+        assert list(quarantine.agreed_bad_mask(local)) == [True, False]
+
+    def test_validate_agreed_stamps_peer_flagged_rows(self, monkeypatch):
+        X, y = _xy(4)
+        t = _dense_table(X, y)
+        self._two_process(monkeypatch, [0, 1, 0, 0])  # peer flags row 1
+        verdict = quarantine.validate_feature_batch(
+            t, dim=X.shape[1], vector_col="features", agreed=True
+        )
+        assert verdict is not None
+        good_mask, reasons = verdict
+        assert list(good_mask) == [True, False, True, True]
+        assert reasons[1] == "peer_flagged"
+
+    def test_breaker_agreed_open_wins(self, monkeypatch):
+        b = serve.CircuitBreaker("t")
+        assert b.allow_device()          # locally closed
+        self._two_process(monkeypatch, [1])  # peer reports blocked
+        assert not b.allow_device(agreed=True)
+        self._two_process(monkeypatch, [0])  # peer reports open-for-device
+        assert b.allow_device(agreed=True)
+
+
+# -- model integrity ----------------------------------------------------------
+
+
+def _small_table():
+    return Table.from_columns(
+        Schema.of(("w", DataTypes.DENSE_VECTOR), ("b", "double")),
+        {"w": np.arange(12.0).reshape(4, 3), "b": np.arange(4.0)},
+    )
+
+
+class TestModelIntegrity:
+    def test_save_load_round_trip_with_commit_record(self, tmp_path):
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        assert os.path.exists(path + ".commit.json")
+        back = load_table(path)
+        np.testing.assert_array_equal(
+            back.features_dense("w"), t.features_dense("w")
+        )
+
+    def test_interrupted_save_never_leaves_truncated_file(
+        self, tmp_path, monkeypatch
+    ):
+        """RED (satellite): pre-atomic-save an interrupted write left a
+        truncated model at the final path; now the committed version
+        survives untouched and no .tmp debris remains."""
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        committed = open(path).read()
+
+        original = persistence.encode_row
+        calls = {"n": 0}
+
+        def dying_encode(row, schema):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise OSError(5, "I/O error mid-write")  # the kill
+            return original(row, schema)
+
+        monkeypatch.setattr(persistence, "encode_row", dying_encode)
+        with pytest.raises(OSError):
+            save_table(t, path)
+        monkeypatch.setattr(persistence, "encode_row", original)
+        assert open(path).read() == committed  # previous commit intact
+        assert not os.path.exists(path + ".tmp")
+        load_table(path)  # and it still verifies
+
+    def test_corrupted_byte_raises_model_integrity_error(self, tmp_path):
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        with pytest.raises(ModelIntegrityError, match="CRC32"):
+            load_table(path)
+
+    def test_truncation_with_commit_record_is_a_length_mismatch(
+        self, tmp_path
+    ):
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        lines = open(path).read().splitlines(keepends=True)
+        with open(path, "w") as f:
+            f.writelines(lines[:-1])  # drop a whole trailing row, cleanly
+        with pytest.raises(ModelIntegrityError, match="bytes"):
+            load_table(path)
+
+    def test_truncated_jsonl_tail_without_sidecar_still_loud(self, tmp_path):
+        """RED (satellite): a legacy file (no commit record) truncated
+        mid-row must raise the integrity diagnostic, not half-load."""
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        os.remove(path + ".commit.json")
+        raw = open(path).read()
+        with open(path, "w") as f:
+            f.write(raw[: int(len(raw) * 0.93)])
+        with pytest.raises(ModelIntegrityError, match="line"):
+            load_table(path)
+
+    def test_legacy_file_without_sidecar_loads(self, tmp_path):
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        os.remove(path + ".commit.json")
+        back = load_table(path)
+        assert back.num_rows() == t.num_rows()
+
+    def test_row_schema_arity_mismatch_is_integrity_error(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        schema = Schema.of(("a", "double"), ("b", "double"))
+        with open(path, "w") as f:
+            f.write(json.dumps({"schema": schema.to_dict()}) + "\n")
+            f.write("[1.0]\n")  # arity 1 for a 2-column schema
+        with pytest.raises(ModelIntegrityError, match="mismatch"):
+            load_table(path)
+
+    def test_file_model_source_verifies_at_open(self, tmp_path):
+        from flink_ml_tpu.common.model_source import FileModelSource
+
+        t = _small_table()
+        path = str(tmp_path / "m.jsonl")
+        save_table(t, path)
+        (back,) = FileModelSource(path).get_model_tables()
+        assert back.num_rows() == 4
+        blob = bytearray(open(path, "rb").read())
+        blob[-3] ^= 0x55
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        with pytest.raises(ModelIntegrityError):
+            FileModelSource(path).get_model_tables()
+
+    def test_corrupt_stage_descriptor_is_integrity_error(self, tmp_path):
+        from flink_ml_tpu.api.core import load_stage
+        from flink_ml_tpu.lib import StandardScaler
+
+        X, y = _xy(16)
+        model = (
+            StandardScaler().set_selected_col("features")
+            .set_output_col("s").fit(_dense_table(X, y))
+        )
+        stage_dir = str(tmp_path / "stage")
+        model.save(stage_dir)
+        with open(os.path.join(stage_dir, "stage.json"), "w") as f:
+            f.write('{"module": "x", ')  # truncated descriptor
+        with pytest.raises(ModelIntegrityError, match="unreadable"):
+            load_stage(stage_dir)
+
+    def test_parseable_but_wrong_descriptor_is_integrity_error(
+        self, tmp_path
+    ):
+        """A partially-overwritten descriptor that still parses as JSON
+        (missing keys, a list) must follow the same ModelIntegrityError
+        contract as an unparseable one — supervisors fail over on that
+        type, not on a stray KeyError."""
+        from flink_ml_tpu.api.core import load_stage
+        from flink_ml_tpu.api.pipeline import PipelineModel
+        from flink_ml_tpu.lib import StandardScaler
+
+        X, y = _xy(16)
+        model = (
+            StandardScaler().set_selected_col("features")
+            .set_output_col("s").fit(_dense_table(X, y))
+        )
+        stage_dir = str(tmp_path / "stage")
+        model.save(stage_dir)
+        for payload in ('{"params": "{}"}', "[1, 2, 3]"):
+            with open(os.path.join(stage_dir, "stage.json"), "w") as f:
+                f.write(payload)
+            with pytest.raises(ModelIntegrityError):
+                load_stage(stage_dir)
+
+        pd = str(tmp_path / "pipe")
+        PipelineModel([model]).save(pd)
+        with open(os.path.join(pd, "pipeline.json"), "w") as f:
+            f.write('{"kind": "PipelineModel"}')  # num_stages lost
+        with pytest.raises(ModelIntegrityError):
+            PipelineModel.load(pd)
+
+    def test_pipeline_missing_stage_dir_is_integrity_error(self, tmp_path):
+        import shutil
+
+        from flink_ml_tpu.api.pipeline import PipelineModel
+
+        X, y = _xy(16)
+        model = _logreg_model(X, y)
+        pd = str(tmp_path / "pipe")
+        PipelineModel([model]).save(pd)
+        shutil.rmtree(os.path.join(pd, "stage_000"))
+        with pytest.raises(ModelIntegrityError, match="missing"):
+            PipelineModel.load(pd)
+
+    def test_nan_and_none_round_trip_double_vs_int(self):
+        """persistence.py null special cases (satellite): NaN encodes as
+        null; null decodes to NaN for float columns and stays None for
+        int/string columns."""
+        schema = Schema.of(("d", "double"), ("i", "int"), ("s", "string"))
+        assert persistence.encode_row((np.nan, 3, "x"), schema) == [
+            None, 3, "x",
+        ]
+        assert persistence.encode_row((np.float64("nan"), 1, None),
+                                      schema) == [None, 1, None]
+        d, i, s = persistence.decode_row([None, None, None], schema)
+        assert np.isnan(d) and i is None and s is None
+
+    def test_double_column_nan_round_trips_through_files(self, tmp_path):
+        t = Table.from_columns(
+            Schema.of(("d", "double")), {"d": [1.5, np.nan, -2.0]}
+        )
+        path = str(tmp_path / "nan.jsonl")
+        save_table(t, path)
+        back = np.asarray(load_table(path).col("d"))
+        assert back[0] == 1.5 and np.isnan(back[1]) and back[2] == -2.0
+
+
+# -- per-transform serve accounting -------------------------------------------
+
+
+class TestServeReports:
+    def test_transform_writes_serve_report(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        obs.enable()
+        X, y = _xy()
+        model = _logreg_model(X, y)
+        Xbad = X.copy()
+        Xbad[1, 0] = np.nan
+        model.transform(_dense_table(Xbad, y))
+        from flink_ml_tpu.obs.report import load_reports
+
+        transforms = [
+            r for r in load_reports(str(tmp_path))
+            if r["kind"] == "transform"
+        ]
+        assert transforms, "transform wrote no RunReport"
+        r = transforms[-1]
+        assert r["name"] == "LogisticRegressionModel"
+        assert r["extra"]["rows"] == X.shape[0]
+        assert r["extra"]["serve"]["serve.quarantined_rows"] == 1
+        assert r["extra"]["serve"]["serve.device_ok"] >= 1
+
+    def test_fallback_only_transform_is_serve_degraded(
+        self, tmp_path, monkeypatch
+    ):
+        import warnings
+
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "1")
+        obs.enable()
+        X, y = _xy()
+        model = _logreg_model(X, y)
+        t = _dense_table(X, y)
+        model.transform(t)  # healthy: device_ok > 0 -> not degraded
+        injection.configure("serve.dispatch@1+")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                model.transform(t)  # opens the breaker
+                model.transform(t)  # fallback-only
+        finally:
+            injection.reset()
+        from flink_ml_tpu.obs.report import load_reports, serve_degraded_runs
+
+        flagged = serve_degraded_runs(load_reports(str(tmp_path)))
+        assert len(flagged) == 1
+        assert flagged[0]["name"] == "LogisticRegressionModel"
+        assert flagged[0]["serve"]["serve.fallbacks"] >= 1
+
+    def test_healthy_transform_is_not_degraded(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path))
+        obs.enable()
+        X, y = _xy()
+        model = _logreg_model(X, y)
+        model.transform(_dense_table(X, y))
+        from flink_ml_tpu.obs.report import load_reports, serve_degraded_runs
+
+        assert serve_degraded_runs(load_reports(str(tmp_path))) == []
