@@ -1270,6 +1270,110 @@ def bench_warm_fit(n_rows=200_000, n_features=28, epochs=5, batch=16384):
     })
 
 
+def bench_serve_fused(n_rows=200_000, n_features=16, batch=4096, sweeps=3):
+    """Staged vs fused pipeline inference (ISSUE 6): a 3-stage serving
+    chain (StandardScaler -> MinMaxScaler -> LogisticRegression score)
+    transformed with ``FMT_FUSE_TRANSFORM`` off (the per-stage path: one
+    dispatch + 2 host<->device hops per stage per batch) and on (one fused
+    dispatch per batch, columns device-resident across stages).
+
+    The emitted ``fused_over_staged`` ratio (fused wall / staged wall,
+    lower is better) is the machine-robust number BASELINE.json gates:
+    dispatch count per batch is 1 vs 3 by construction (asserted via the
+    ``pipeline.fused_dispatches`` counter), so a broken planner drags the
+    ratio toward 1.0 on any host.  Exact discrete-prediction parity vs the
+    staged path is asserted, not just recorded — a fused plan that serves
+    different labels is a bug, never a data point.
+    """
+    import warnings
+
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    rng = np.random.RandomState(13)
+    X = (2.0 * rng.randn(n_rows, n_features) + 3.0).astype(np.float32)
+    true_w = (rng.randn(n_features) / np.sqrt(n_features)).astype(np.float32)
+    y = ((X - 3.0) @ true_w > 0).astype(np.float64)
+    t = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        MinMaxScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_prediction_detail_col("proba")
+        .set_learning_rate(0.5).set_max_iter(5),
+    ]).fit(t)
+
+    env = MLEnvironmentFactory.get_default()
+    old_bs, env.default_batch_size = env.default_batch_size, batch
+    old_knob = os.environ.get("FMT_FUSE_TRANSFORM")
+
+    def timed(fuse: bool):
+        os.environ["FMT_FUSE_TRANSFORM"] = "1" if fuse else "0"
+        model.transform(t)  # warmup: compile every per-batch bucket
+        walls = []
+        for _ in range(sweeps):
+            t0 = time.perf_counter()
+            (out,) = model.transform(t)
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls)), out
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            staged_s, staged_out = timed(False)
+            obs.reset()
+            fused_s, fused_out = timed(True)
+        counters = obs.registry().snapshot()["counters"]
+        n_batches = -(-n_rows // batch)
+        # (sweeps + warmup) transforms x one dispatch per batch per run
+        dispatches_per_transform = (
+            counters.get("pipeline.fused_dispatches", 0) / (sweeps + 1)
+        )
+        assert dispatches_per_transform == n_batches, (
+            dispatches_per_transform, n_batches)
+        pred_parity = bool(np.array_equal(
+            np.asarray(staged_out.col("pred")),
+            np.asarray(fused_out.col("pred")),
+        ))
+        assert pred_parity, "fused discrete predictions diverge from staged"
+        proba_err = float(np.max(np.abs(
+            np.asarray(staged_out.col("proba"))
+            - np.asarray(fused_out.col("proba"))
+        )))
+    finally:
+        env.default_batch_size = old_bs
+        if old_knob is None:
+            os.environ.pop("FMT_FUSE_TRANSFORM", None)
+        else:
+            os.environ["FMT_FUSE_TRANSFORM"] = old_knob
+
+    return _emit({
+        "metric": "PipelineModel.transform fused_over_staged",
+        "value": round(fused_s / staged_s, 4),
+        "unit": "ratio (lower is better)",
+        "staged_ms": round(staged_s * 1e3, 1),
+        "fused_ms": round(fused_s * 1e3, 1),
+        "staged_rows_per_sec": round(n_rows / staged_s, 1),
+        "fused_rows_per_sec": round(n_rows / fused_s, 1),
+        "dispatches_per_batch_staged": 3,
+        "dispatches_per_batch_fused": 1,
+        "pred_parity": pred_parity,
+        "proba_max_abs_err": proba_err,
+        "shape": f"{n_rows}x{n_features} f32, 3 stages "
+                 f"(scaler->scaler->LR score), batch={batch}, "
+                 f"{n_batches} batches, median of {sweeps}",
+    })
+
+
 def bench_sparse_file(n_rows, dim, nnz):
     """Create (once) the synthetic Criteo-shaped LibSVM file."""
     rng = np.random.RandomState(5)
@@ -1301,6 +1405,7 @@ WORKLOADS = {
     "sparse_ooc": bench_sparse_ooc,
     "pipeline": bench_pipeline,
     "warmfit": bench_warm_fit,
+    "serve": bench_serve_fused,
 }
 
 

@@ -111,16 +111,51 @@ class Pipeline(Estimator):
 
 
 class PipelineModel(Model):
-    """A Model composed of stages; sequential transform (PipelineModel.java:53-59)."""
+    """A Model composed of stages; sequential transform (PipelineModel.java:53-59).
+
+    When ``FMT_FUSE_TRANSFORM`` is on (the default), transform routes
+    through the fused inference planner (`common/fused.py`): maximal runs
+    of kernel-capable mappers compile into ONE device dispatch per batch
+    with the vector columns held device-resident across stages, and
+    anything the planner cannot fuse — kernel-less mappers, AlgoOperators,
+    a tripped per-plan breaker — serves through this sequential path in
+    place, bit-identical on discrete outputs."""
 
     def __init__(self, stages: Sequence[AlgoOperator] = ()):
         self.stages: List[AlgoOperator] = list(stages)
 
     def transform(self, *inputs: Table) -> Tuple[Table, ...]:
-        last_inputs = inputs
-        for stage in self.stages:
-            last_inputs = stage.transform(*last_inputs)
-        return last_inputs
+        from flink_ml_tpu import obs
+        from flink_ml_tpu.common import fused
+        from flink_ml_tpu.common.mapper import pipeline_reap_scope
+
+        # one slab-pool reap for the WHOLE chain (stage applies inside the
+        # scope skip theirs — an S-stage pipeline must not pay S reaps);
+        # per-transform serve accounting wraps the chain the same way the
+        # single-model transform does
+        with pipeline_reap_scope():
+            serve0 = None
+            if obs.enabled():
+                from flink_ml_tpu.serve import serve_counter_snapshot
+
+                serve0 = serve_counter_snapshot()
+            if len(inputs) == 1 and isinstance(inputs[0], Table) \
+                    and len(self.stages) > 1 and fused.fusion_enabled():
+                out = fused.transform_fused(self, inputs)
+            else:
+                out = inputs
+                for stage in self.stages:
+                    out = stage.transform(*out)
+            if serve0 is not None and len(inputs) == 1 \
+                    and isinstance(inputs[0], Table):
+                from flink_ml_tpu.obs.report import transform_report
+                from flink_ml_tpu.serve import serve_counter_delta
+
+                transform_report(
+                    type(self).__name__, rows=inputs[0].num_rows(),
+                    serve_delta=serve_counter_delta(serve0),
+                )
+        return out
 
     def save(self, path: str) -> None:
         _save_stages(self.stages, path, kind="PipelineModel")

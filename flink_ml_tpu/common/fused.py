@@ -1,0 +1,715 @@
+"""Fused device-resident pipeline inference — one dispatch per batch.
+
+The reference applies pipeline stages sequentially (PipelineModel.java:53-59)
+and the staged port reproduces that literally: every stage places its batch
+on device, runs one jitted call, and fetches results back to host numpy
+before the next stage re-uploads them.  With per-dispatch latency around
+100 ms on a tunneled device (BENCH_r05 ``call_latency_ms``), an S-stage
+serving pipeline pays S dispatches plus 2·S host<->device transfers per
+batch.  This module closes that gap — the inference-side twin of the
+warm-fit dispatch gap the slab pool closed for training:
+
+* every shipped mapper publishes an optional **pure device kernel**
+  (:meth:`~flink_ml_tpu.common.mapper.Mapper.fused_kernel` -> a
+  :class:`FusedKernel`: jnp-in/jnp-out, no host materialization);
+* the planner walks a ``PipelineModel``'s stage chain, greedily groups
+  maximal runs of kernel-capable mappers, and compiles each run into ONE
+  jitted program per batch: the vector/feature columns stay device-resident
+  across fused stages (the ``env``), host-lookup stages (StringIndexer,
+  OneHotEncoder) ride along as host pre-kernels without a dispatch of
+  their own;
+* quarantine's validation runs once at plan entry instead of once per
+  stage; host prep (feature extraction + H2D staging) of batch i+1 is
+  double-buffered under batch i's compute via the shared
+  :func:`~flink_ml_tpu.utils.prefetch.prefetch_iter` idiom;
+* the whole fused call dispatches through :func:`~flink_ml_tpu.serve.
+  dispatch` under a **per-plan circuit breaker** whose fallback is the
+  existing per-stage path — a mapper without a kernel, an incompatible
+  column flow, or a tripped breaker transparently splits the plan and
+  serves exactly as today (bit-identical on discrete outputs);
+* column bookkeeping (OutputColsHelper merges, reserved cols, quarantine
+  side-tables with original row offsets) is computed once at plan build
+  and applied at plan exit: reserved passthrough columns come straight off
+  the run-input table's buffers, never copied per batch.
+
+Parity contract: a fused run computes exactly the per-stage device math on
+exactly the per-stage batch buckets; the only difference is that
+intermediate f32 columns skip their host round-trip (f32 -> host -> f32 is
+value-exact), so discrete outputs are bit-identical and float scores agree
+to accumulation tolerance.  Entry-only validation is the one sanctioned
+semantic difference: a mid-chain stage never re-validates device-produced
+values (the staged path would), so a kernel that *manufactures* NaNs from
+clean inputs flows them onward — the same contract as any single fused
+device program.
+
+Telemetry: ``pipeline.fused_dispatches`` (exactly one per batch per fused
+run), ``pipeline.fused_rows``, ``pipeline.plan_fallback_batches``, the
+``pipeline.fusion_ratio`` gauge (fused stages / total stages) and the
+``pipeline.fused_call_ms`` timing histogram.
+
+Knob: ``FMT_FUSE_TRANSFORM`` (default on).  Off restores the stage-at-a-
+time transform verbatim.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.common.mapper import ColumnSink, _kept_indices
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+__all__ = [
+    "FusedInput",
+    "FusedKernel",
+    "fusion_enabled",
+    "transform_fused",
+]
+
+
+def fusion_enabled() -> bool:
+    """Is fused pipeline inference on?  ``FMT_FUSE_TRANSFORM`` (default 1)."""
+    return os.environ.get("FMT_FUSE_TRANSFORM", "1").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+@dataclass(frozen=True)
+class FusedInput:
+    """One feature input a device kernel reads — the same column-selection
+    vocabulary as ``serve_validation_spec`` (one vector column or a list of
+    numeric columns, with the model's width pinned)."""
+
+    dim: int
+    vector_col: Optional[str] = None
+    feature_cols: Optional[Tuple[str, ...]] = None
+
+
+@dataclass
+class FusedKernel:
+    """A mapper's declaration of how it participates in a fused plan.
+
+    Device kernels: ``fn(*inputs, *model_args) -> {key: jnp array}`` is the
+    pure jnp computation (``csr_fn`` the sparse-input variant, both
+    row-aligned with input rows); ``finalize(fetched, n) -> {col: values}``
+    converts the fetched (host, row-sliced) arrays into the mapper's
+    declared output columns — the cheap elementwise host tail of
+    ``map_batch`` (sigmoid, class-id lookup, sqrt).  ``env_outputs`` names
+    the keys whose device values flow onward as device-resident dense
+    columns: ``{key: (output column name, width)}``.
+
+    Host kernels (``host=True``, everything else ignored): the mapper's
+    ``map_batch`` is already a pure host lookup with no device dispatch —
+    it joins a run as a pre-kernel so a chain like
+    indexer -> encoder -> sparse LR still fuses into one dispatch.
+    """
+
+    host: bool = False
+    inputs: Sequence[FusedInput] = ()
+    fn: Optional[Callable] = None
+    csr_fn: Optional[Callable] = None
+    out_keys: Sequence[str] = ()
+    model_args: tuple = ()
+    finalize: Optional[Callable] = None
+    env_outputs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+
+# -- plan assembly ------------------------------------------------------------
+
+
+class _DeviceStage:
+    """One device-kernel stage inside a fused run (planner-internal)."""
+
+    __slots__ = (
+        "index", "mapper", "kernel", "input_refs", "call_fn", "marg_lo",
+        "marg_hi", "fetch", "out_keys", "validates",
+    )
+
+    def __init__(self, index, mapper, kernel):
+        self.index = index
+        self.mapper = mapper
+        self.kernel = kernel
+        self.input_refs: List[Tuple[str, object]] = []  # ('env', col)|('arg', i)
+        self.call_fn = kernel.fn
+        self.marg_lo = self.marg_hi = 0
+        self.fetch = False
+        self.out_keys: Tuple[str, ...] = tuple(kernel.out_keys)
+        self.validates = False  # reads host-sourced features -> entry check
+
+
+def _stage_infos(stages, start: int, schema: Schema):
+    """Consecutive kernel-capable (stage, mapper, kernel) triples from
+    ``start``, chaining schemas through each mapper's OutputColsHelper."""
+    from flink_ml_tpu.lib.model_base import TableModelBase
+
+    infos = []
+    s = schema
+    for j in range(start, len(stages)):
+        stage = stages[j]
+        if not isinstance(stage, TableModelBase):
+            break
+        mapper = stage.loaded_mapper(s)
+        kernel = mapper.fused_kernel()
+        if kernel is None:
+            break
+        infos.append((stage, mapper, kernel))
+        s = mapper.get_output_schema()
+    return infos
+
+
+class FusedRun:
+    """A compiled maximal run of kernel-capable stages: plan metadata plus
+    the per-mesh jitted fused program and the per-batch executor."""
+
+    def __init__(self, host_stages, device_stages, data_descs, model_args,
+                 validators, exit_schema, exit_src, run_input_schema,
+                 post_host_schema, batch_size, has_csr, serve_name):
+        self.host_stages = host_stages          # [(stage, mapper, kernel)]
+        self.device_stages = device_stages      # [_DeviceStage]
+        self.data_descs = data_descs            # extraction descriptors
+        self.model_args = tuple(model_args)
+        self.validators = validators            # mappers validated at entry
+        self.exit_schema = exit_schema
+        self.exit_src = exit_src                # field -> 'input'|'batch'|j
+        self.run_input_schema = run_input_schema
+        self.post_host_schema = post_host_schema
+        self.batch_size = batch_size
+        self.has_csr = has_csr
+        self.serve_name = serve_name
+        self.n_stages = len(host_stages) + len(device_stages)
+        self._apply_fns: Dict = {}
+        # flat fetch layout: [(device stage, key)] in program output order
+        self.fetch_layout = [
+            (ds, key)
+            for ds in device_stages if ds.fetch
+            for key in ds.out_keys
+        ]
+        self.batch_cols = [
+            name for name in exit_schema.field_names
+            if exit_src[name] == "batch"
+        ]
+        self.device_cols = {
+            name for name in exit_schema.field_names
+            if isinstance(exit_src[name], int)
+        }
+
+    # -- the one jitted program ----------------------------------------------
+
+    def _fused_fn(self):
+        device_stages = self.device_stages
+        n_data = len(self.data_descs)
+
+        def fused(*args):
+            data = args[:n_data]
+            margs = args[n_data:]
+            env: Dict[str, object] = {}
+            outs = []
+            for ds in device_stages:
+                ins = [
+                    env[ref] if kind == "env" else data[ref]
+                    for kind, ref in ds.input_refs
+                ]
+                res = ds.call_fn(*ins, *margs[ds.marg_lo:ds.marg_hi])
+                for key, (col, _w) in ds.kernel.env_outputs.items():
+                    env[col.lower()] = res[key]
+                if ds.fetch:
+                    outs.extend(res[k] for k in ds.out_keys)
+            return tuple(outs)
+
+        return fused
+
+    def _apply_fn(self, mesh):
+        fn = self._apply_fns.get(mesh)
+        if fn is not None:
+            return fn
+        import jax
+
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+
+        fused = self._fused_fn()
+        if self.has_csr or data_parallel_size(mesh) == 1:
+            # sparse inputs follow the staged sparse-score contract (plain
+            # jit, process-local); a 1-wide data axis degenerates anyway
+            fn = jax.jit(fused)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from flink_ml_tpu.parallel.collectives import shard_map
+
+            in_specs = tuple(
+                [P("data")] * len(self.data_descs)
+                + [P()] * len(self.model_args)
+            )
+            out_specs = tuple([P("data")] * len(self.fetch_layout))
+            fn = jax.jit(shard_map(
+                fused, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ))
+        self._apply_fns[mesh] = fn
+        return fn
+
+    # -- per-batch execution --------------------------------------------------
+
+    def _bucket(self, n: int, row_multiple: int) -> int:
+        from flink_ml_tpu.lib.common import _bucket_for, bucket_rows
+
+        if self.has_csr:
+            # staged sparse scoring buckets without a row-multiple (plain
+            # jit); the whole run follows so every input shares one bucket
+            return bucket_rows(max(n, 1))
+        return _bucket_for(n, 256, row_multiple)
+
+    def _extract(self, batch: Table, b: int, mesh, row_multiple: int):
+        """Host half of one batch's device inputs: feature extraction +
+        pad-to-bucket + best-effort async placement (runs on the prefetch
+        producer thread, overlapping the previous batch's compute)."""
+        from flink_ml_tpu.lib.common import _pad_rows_to
+
+        args = []
+        for desc in self.data_descs:
+            kind = desc[0]
+            if kind == "dense":
+                _, col, dim = desc
+                X = np.asarray(
+                    batch.features_dense(col, dim=dim), dtype=np.float32
+                )
+                args.append(_pad_rows_to(X, b))
+            elif kind == "matrix":
+                _, cols, _dim = desc
+                X = np.asarray(batch.numeric_matrix(list(cols)),
+                               dtype=np.float32)
+                args.append(_pad_rows_to(X, b))
+            else:  # csr
+                from flink_ml_tpu.ops.batch import CsrBatch
+
+                _, col, dim = desc
+                csr = batch.features_csr(col, n_cols=dim)
+                args.append(CsrBatch(
+                    csr.indices, csr.values, csr.row_ids,
+                    n_rows=b, n_cols=csr.n_cols,
+                ))
+        placed = []
+        for a in args:
+            placed.append(_try_place(a, mesh, row_multiple))
+        return placed
+
+    def _validate_entry(self, batch: Table, offset: int):
+        """Plan-entry quarantine: each entry validator (a device stage
+        whose features are host-sourced) checks the batch in stage order,
+        bad rows land in ITS side-table with original-feed row offsets,
+        survivors flow on.  Mid-run (device-produced) inputs are not
+        re-checked — the entry-only contract documented on the module."""
+        from flink_ml_tpu.serve import quarantine
+
+        if not quarantine.enabled() or not self.validators:
+            return batch, None
+        n = batch.num_rows()
+        b = batch
+        orig: Optional[np.ndarray] = None  # b's rows as ORIGINAL indices
+        for mapper in self.validators:
+            if b.num_rows() == 0:
+                break
+            verdict = mapper.validate_batch(b)
+            if verdict is None:
+                continue
+            good, reasons = verdict
+            good = np.asarray(good, bool)
+            if orig is None:
+                quarantine.emit(mapper.serve_name(), b, good, reasons,
+                                row_offset=offset)
+                orig = np.nonzero(good)[0]
+            else:
+                # a later validator sees the FILTERED batch: expand its
+                # verdict back to original-batch coordinates before
+                # emitting, or the side-table's _quarantine_row would
+                # point at the wrong source-feed row
+                bad_orig = orig[~good]
+                g2 = np.ones(n, dtype=bool)
+                g2[bad_orig] = False
+                r2 = np.full(n, None, dtype=object)
+                r2[bad_orig] = np.asarray(reasons, dtype=object)[~good]
+                quarantine.emit(mapper.serve_name(), batch, g2, r2,
+                                row_offset=offset)
+                orig = orig[good]
+            b = b.filter_rows(good)
+        if orig is None:
+            return b, None
+        good_all = np.zeros(n, dtype=bool)
+        good_all[orig] = True
+        return b, good_all
+
+    def _prep_batches(self, table: Table, mesh, row_multiple: int):
+        batch_size = self.batch_size
+        if batch_size is None or table.num_rows() <= batch_size:
+            batches = [table]
+        else:
+            batches = table.iter_batches(batch_size)
+        offset = 0
+        for batch in batches:
+            n_in = batch.num_rows()
+            t = batch
+            for _stage, mapper, _k in self.host_stages:
+                out = mapper._map_checked(t, validated=False)
+                t = mapper._helper.get_result_table(t, out)
+            t, good = self._validate_entry(t, offset)
+            n = t.num_rows()
+            args = (
+                self._extract(t, self._bucket(n, row_multiple), mesh,
+                              row_multiple)
+                if n else None
+            )
+            yield offset, n_in, n, good, t, args
+            offset += n_in
+
+    def _device_batch(self, mesh, n: int, args):
+        """The single fused dispatch for one batch: (re)place -> one jitted
+        call -> one bundled fetch -> per-stage host finalize."""
+        import jax
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.lib.common import fetch_flat
+
+        t0 = time.perf_counter()
+        placed = [
+            a if isinstance(a, jax.Array) or not isinstance(a, np.ndarray)
+            else jnp.asarray(a)
+            for a in args
+        ]
+        res = self._apply_fn(mesh)(*placed, *self.model_args)
+        fetched = fetch_flat(*res)
+        out: Dict[str, Sequence] = {}
+        i = 0
+        for ds in self.device_stages:
+            if not ds.fetch:
+                continue
+            vals = {}
+            for key in ds.out_keys:
+                vals[key] = fetched[i][:n]
+                i += 1
+            cols = ds.kernel.finalize(vals, n)
+            for c, v in cols.items():
+                # finalize hands back every declared output col; keep only
+                # the ones the exit schema attributes to THIS stage (a col
+                # overwritten in place by a later fused stage is dropped)
+                if self.exit_schema.contains(c):
+                    canon = self.exit_schema.resolve(c)
+                    if self.exit_src.get(canon) == ds.index:
+                        out[canon] = v
+        obs.counter_add("pipeline.fused_dispatches")
+        obs.counter_add("pipeline.fused_rows", n)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        obs.observe("pipeline.fused_call_ms", dt_ms)
+        obs.observe(f"pipeline.fused_call_ms.{self.serve_name}", dt_ms)
+        return out
+
+    def _staged_batch(self, t: Table, offset: int):
+        """The per-stage fallback for one batch (breaker open / device
+        failure): each device stage's own ``_apply_batch`` — which routes
+        through its own ``serve.dispatch`` and CPU fallback — serves the
+        batch exactly as the unfused pipeline would.  Entry validation
+        already ran, so per-stage re-validation is skipped (same rows in,
+        same rows out: the sink's row accounting stays aligned)."""
+        for ds in self.device_stages:
+            t = ds.mapper._apply_batch(t, row_offset=offset, validate=False)
+        obs.counter_add("pipeline.plan_fallback_batches")
+        return {name: t.col(name) for name in self.device_cols}
+
+    def execute(self, table: Table) -> Table:
+        from flink_ml_tpu import serve
+        from flink_ml_tpu.parallel.mesh import data_parallel_size, \
+            inference_mesh
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+        from flink_ml_tpu.utils.prefetch import prefetch_iter
+
+        obs.counter_add("inference.rows", table.num_rows())
+        mesh = inference_mesh(MLEnvironmentFactory.get_default().get_mesh())
+        row_multiple = data_parallel_size(mesh)
+        field_order = self.exit_schema.field_names
+        out_names = sorted(
+            self.device_cols | set(self.batch_cols), key=field_order.index
+        )
+        out_types = [self.exit_schema.type_of(n) for n in out_names]
+        sink = ColumnSink(out_names, out_types, table.num_rows())
+        kept_parts: List[Tuple[int, int, Optional[np.ndarray]]] = []
+        filtered = False
+
+        gen = self._prep_batches(table, mesh, row_multiple)
+        many = (
+            self.batch_size is not None
+            and table.num_rows() > self.batch_size
+        )
+        if many:
+            # double-buffer: batch i+1's host prep + H2D staging runs on
+            # the producer thread under batch i's compute (the shared
+            # prefetch idiom, utils/prefetch.py)
+            gen = prefetch_iter(gen, depth=2, name="fused-prefetch")
+        for offset, n_in, n, good, t, args in gen:
+            if n == 0:
+                out = {
+                    name: np.zeros(0, dtype=DataTypes.numpy_dtype(typ))
+                    for name, typ in zip(out_names, out_types)
+                    if name in self.device_cols
+                }
+            else:
+                out = serve.dispatch(
+                    self.serve_name,
+                    device=lambda: self._device_batch(mesh, n, args),
+                    fallback=lambda: self._staged_batch(t, offset),
+                )
+            for name in self.batch_cols:
+                out[name] = t.col(name)
+            sink.append(out, n)
+            filtered = filtered or n != n_in
+            kept_parts.append((offset, n_in, good))
+        cols = sink.columns()
+        passthrough = [
+            name for name in self.exit_schema.field_names
+            if self.exit_src[name] == "input"
+        ]
+        if passthrough:
+            src = table.select(passthrough)
+            if filtered:
+                src = src.take_rows(_kept_indices(kept_parts))
+            for name in passthrough:
+                cols[name] = src.col(name)
+        return Table.from_columns(self.exit_schema, cols)
+
+
+def _try_place(a, mesh, row_multiple: int):
+    """Best-effort async H2D on the producer thread; a transient placement
+    failure hands the host array through so the consumer's retried dispatch
+    (and, past that, the per-stage fallback) still gets its shot."""
+    import jax
+
+    from flink_ml_tpu.fault.retry import is_transient
+
+    if not isinstance(a, np.ndarray):
+        return a  # CsrBatch pytrees place at call time, as staged
+    try:
+        if row_multiple > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(a, NamedSharding(mesh, P("data")))
+        return jax.device_put(a)
+    except Exception as exc:  # noqa: BLE001 - transient-filtered
+        if not is_transient(exc):
+            raise
+        return a
+
+
+def _build_run(stages, start: int, schema: Schema,
+               batch_size) -> Tuple[Optional[FusedRun], tuple]:
+    """Assemble the maximal fused run starting at ``start``.
+
+    Returns ``(run, cache_key)``; ``run`` is None when fewer than two
+    stages fuse or no device kernel joins (a one-stage "run" is exactly
+    the staged path already).  The key captures every mapper's identity
+    (``mapper_uid`` — a reloaded model rebuilds its mapper and thereby the
+    plan) plus the schema/batch signature, so callers can reuse a
+    previously compiled run."""
+    infos = _stage_infos(stages, start, schema)
+    # host pre-kernels: only a PREFIX joins (a host lookup downstream of a
+    # device kernel would force a mid-run fetch — the plan splits instead)
+    n_host = 0
+    while n_host < len(infos) and infos[n_host][2].host:
+        n_host += 1
+    host_stages = infos[:n_host]
+
+    sch = schema
+    avail: Dict[str, object] = {
+        n.lower(): "input" for n in schema.field_names
+    }
+    for _stage, mapper, _k in host_stages:
+        outs = {n.lower() for n in mapper._helper.output_col_names}
+        sch = mapper.get_output_schema()
+        avail = {
+            n.lower(): ("batch" if n.lower() in outs else avail[n.lower()])
+            for n in sch.field_names
+        }
+    post_host_schema = sch
+
+    device_stages: List[_DeviceStage] = []
+    data_descs: List[tuple] = []
+    desc_index: Dict[tuple, int] = {}
+    model_args: List = []
+    validators: List = []
+    has_csr = False
+
+    def _arg(desc) -> int:
+        i = desc_index.get(desc)
+        if i is None:
+            i = desc_index[desc] = len(data_descs)
+            data_descs.append(desc)
+        return i
+
+    for j, (stage, mapper, kernel) in enumerate(infos[n_host:]):
+        if kernel.host:
+            break  # host kernel mid-run: the run ends here
+        ds = _DeviceStage(j, mapper, kernel)
+        ok = True
+        for inp in kernel.inputs:
+            if inp.vector_col is not None:
+                try:
+                    canon = sch.resolve(inp.vector_col)
+                except (KeyError, ValueError):
+                    ok = False
+                    break
+                src = avail.get(canon.lower())
+                if isinstance(src, tuple) and src[0] == "env":
+                    if src[1] != int(inp.dim):
+                        ok = False  # width mismatch: staged padding rules
+                        break       # don't hold on-device — split instead
+                    ds.input_refs.append(("env", canon.lower()))
+                elif src in ("input", "batch"):
+                    if sch.type_of(canon) == DataTypes.SPARSE_VECTOR:
+                        if kernel.csr_fn is None:
+                            ok = False
+                            break
+                        ds.input_refs.append(
+                            ("arg", _arg(("csr", canon, int(inp.dim))))
+                        )
+                        ds.call_fn = kernel.csr_fn
+                        has_csr = True
+                    else:
+                        ds.input_refs.append(
+                            ("arg", _arg(("dense", canon, int(inp.dim))))
+                        )
+                    ds.validates = True
+                else:
+                    ok = False  # opaque device output (a prediction col)
+                    break
+            else:
+                canon_cols = []
+                for c in inp.feature_cols or ():
+                    try:
+                        cc = sch.resolve(c)
+                    except (KeyError, ValueError):
+                        ok = False
+                        break
+                    if avail.get(cc.lower()) not in ("input", "batch"):
+                        ok = False
+                        break
+                    canon_cols.append(cc)
+                if not ok:
+                    break
+                ds.input_refs.append(
+                    ("arg", _arg(("matrix", tuple(canon_cols),
+                                  int(inp.dim))))
+                )
+                ds.validates = True
+        if not ok:
+            break
+        ds.marg_lo = len(model_args)
+        model_args.extend(kernel.model_args)
+        ds.marg_hi = len(model_args)
+        device_stages.append(ds)
+        if ds.validates:
+            validators.append(mapper)
+        outs = {n.lower() for n in mapper._helper.output_col_names}
+        env_cols = {
+            col.lower(): int(width)
+            for _key, (col, width) in kernel.env_outputs.items()
+        }
+        sch = mapper.get_output_schema()
+        new_avail: Dict[str, object] = {}
+        for n in sch.field_names:
+            low = n.lower()
+            if low in outs:
+                new_avail[low] = (
+                    ("env", env_cols[low], j) if low in env_cols
+                    else ("dev", j)
+                )
+            else:
+                new_avail[low] = avail[low]
+        avail = new_avail
+
+    if not device_stages or len(host_stages) + len(device_stages) < 2:
+        return None, ()
+
+    exit_schema = sch
+    exit_src: Dict[str, object] = {}
+    for n in exit_schema.field_names:
+        src = avail[n.lower()]
+        # ('env', width, j) and ('dev', j) both resolve to producing stage j
+        exit_src[n] = src[-1] if isinstance(src, tuple) else src
+    for ds in device_stages:
+        ds.fetch = any(
+            isinstance(s, int) and s == ds.index for s in exit_src.values()
+        )
+
+    names = [m.serve_name() for _s, m, _k in host_stages]
+    names += [ds.mapper.serve_name() for ds in device_stages]
+    serve_name = "FusedPlan[" + "+".join(names) + "]"
+    key = (
+        start,
+        tuple(m.mapper_uid
+              for _s, m, _k in host_stages) + tuple(
+            ds.mapper.mapper_uid for ds in device_stages),
+        tuple(schema.field_names), tuple(schema.field_types),
+        batch_size,
+    )
+    run = FusedRun(
+        host_stages, device_stages, data_descs, model_args, validators,
+        exit_schema, exit_src, schema, post_host_schema, batch_size,
+        has_csr, serve_name,
+    )
+    return run, key
+
+
+_RUN_CACHE_CAPACITY = 8
+
+
+def _run_for(model, stages, start: int, schema: Schema, batch_size):
+    """The (cached) fused run starting at ``start``, or None.
+
+    Assembly is cheap dict-walking and re-runs every transform; the
+    expensive compiled state (the per-mesh jitted fused program) lives on
+    the cached FusedRun, keyed by the mapper identities — a reloaded model
+    builds a fresh mapper, which keys a fresh plan."""
+    run, key = _build_run(stages, start, schema, batch_size)
+    if run is None:
+        return None
+    cache = model.__dict__.setdefault("_fused_run_cache", OrderedDict())
+    cached = cache.get(key)
+    if cached is not None:
+        cache.move_to_end(key)
+        return cached
+    cache[key] = run
+    while len(cache) > _RUN_CACHE_CAPACITY:
+        cache.popitem(last=False)
+    return run
+
+
+def transform_fused(model, inputs: Tuple[Table, ...]) -> Tuple[Table, ...]:
+    """``PipelineModel.transform`` with fused-run grouping: maximal runs of
+    kernel-capable stages execute as one dispatch per batch; everything
+    else (kernel-less mappers, AlgoOperators, multi-table hops) serves
+    through the stage-at-a-time path in place."""
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    stages = model.stages
+    batch_size = MLEnvironmentFactory.get_default().default_batch_size
+    last = inputs
+    n_fused = 0
+    i = 0
+    while i < len(stages):
+        run = None
+        if len(last) == 1 and last[0].num_rows() > 0:
+            run = _run_for(model, stages, i, last[0].schema, batch_size)
+        if run is not None:
+            last = (run.execute(last[0]),)
+            n_fused += run.n_stages
+            i += run.n_stages
+        else:
+            last = stages[i].transform(*last)
+            i += 1
+    if stages:
+        obs.gauge_set("pipeline.fusion_ratio", n_fused / len(stages))
+    return last

@@ -19,11 +19,15 @@ math runs as batched XLA on the MXU instead of scalar Java.
 
 from __future__ import annotations
 
+import itertools
+import threading
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from flink_ml_tpu import obs
+from flink_ml_tpu.ops.batch import CsrRows
 from flink_ml_tpu.params.params import Params
 from flink_ml_tpu.serve.errors import MapperOutputMisalignedError
 from flink_ml_tpu.table.output_cols import OutputColsHelper
@@ -31,6 +35,49 @@ from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
 
 from flink_ml_tpu.common.model_source import ModelSource
+
+#: process-wide mapper identity counter — fused-plan caches key on it, so a
+#: reloaded model (fresh mapper over new model data) can never hit a plan
+#: compiled against the old mapper's device state
+_MAPPER_UID = itertools.count()
+
+
+# -- slab-pool reap scoping ---------------------------------------------------
+#
+# Each Mapper.apply reaps GC-queued dead slab-pool entries so a serve-only
+# process cannot pin dropped training tables' device slabs indefinitely.
+# A PipelineModel.transform would pay that reap once PER STAGE; the scope
+# below hoists it to once per transform (and once per fused-plan entry).
+
+_REAP_STATE = threading.local()
+
+
+@contextmanager
+def pipeline_reap_scope():
+    """Reap the slab pool ONCE for a whole multi-stage transform; stage
+    applies inside the scope skip their own reap."""
+    if getattr(_REAP_STATE, "suppressed", False):
+        yield
+        return
+    from flink_ml_tpu.table import slab_pool
+
+    slab_pool.pool().reap()
+    _REAP_STATE.suppressed = True
+    try:
+        yield
+    finally:
+        _REAP_STATE.suppressed = False
+
+
+def _maybe_reap(n_rows: int) -> None:
+    """Per-apply reap unless hoisted by a pipeline scope; the zero-row /
+    empty-table path skips it entirely (nothing was placed, nothing to
+    free on its behalf)."""
+    if n_rows == 0 or getattr(_REAP_STATE, "suppressed", False):
+        return
+    from flink_ml_tpu.table import slab_pool
+
+    slab_pool.pool().reap()
 
 
 class Mapper:
@@ -40,6 +87,9 @@ class Mapper:
     def __init__(self, data_schema: Schema, params: Optional[Params] = None):
         self.data_schema = data_schema
         self.params = params if params is not None else Params()
+        # plan-cache identity: fused plans compiled against this mapper's
+        # device state key on the uid, so a rebuilt mapper is a new plan
+        self.mapper_uid = next(_MAPPER_UID)
         names, types = self.output_cols()
         self._helper = OutputColsHelper(
             data_schema, names, types, reserved_col_names=self.reserved_cols()
@@ -91,6 +141,17 @@ class Mapper:
         circuit breaker, fallback counters) is keyed by."""
         return type(self).__name__
 
+    def fused_kernel(self):
+        """``None`` (the default — this mapper only serves through the
+        per-stage path), or a :class:`~flink_ml_tpu.common.fused.FusedKernel`
+        declaring the mapper's pure device computation (jnp-in/jnp-out, no
+        host materialization) so a :class:`~flink_ml_tpu.api.pipeline.
+        PipelineModel` can fuse it with adjacent kernel-capable stages into
+        ONE device dispatch per batch.  Host-lookup mappers (StringIndexer,
+        OneHotEncoder) return a host-marked kernel instead: they join a
+        fused run without forcing a device round-trip of their own."""
+        return None
+
     # -- provided machinery --------------------------------------------------
 
     def get_output_schema(self) -> Schema:
@@ -98,41 +159,75 @@ class Mapper:
         return self._helper.get_result_schema()
 
     def apply(self, table: Table, batch_size: Optional[int] = None) -> Table:
-        """Map a whole table, batch by batch, and merge columns."""
-        from flink_ml_tpu.table import slab_pool
+        """Map a whole table, batch by batch, and merge columns.
 
-        # reap GC-queued dead slab-pool entries (O(queued), usually a
-        # no-op): a serve-only process whose training tables were dropped
-        # must not pin their device slabs until the next fit
-        slab_pool.pool().reap()
+        Multi-batch applies write per-batch results into output columns
+        preallocated from the output schema (no ``parts`` accumulation, no
+        final ``Table.concat`` re-copy — the old path held ~2x the output
+        resident); reserved input columns are never copied per batch at
+        all — they come straight off the input table's buffers at the end
+        (gathered only when quarantine dropped rows)."""
+        _maybe_reap(table.num_rows())
         obs.counter_add("inference.rows", table.num_rows())
         if batch_size is None or table.num_rows() <= batch_size:
             return self._apply_batch(table, row_offset=0)
-        parts = []
+        sink = ColumnSink(
+            self._helper.output_col_names, self._helper.output_col_types,
+            table.num_rows(),
+        )
         offset = 0
+        kept_parts: List[Tuple[int, int, Optional[np.ndarray]]] = []
+        filtered = False
         for batch in table.iter_batches(batch_size):
-            parts.append(self._apply_batch(batch, row_offset=offset))
-            offset += batch.num_rows()
-        return Table.concat(parts)
+            n_in = batch.num_rows()
+            fb, good = self._quarantine_batch(batch, row_offset=offset)
+            out = self._map_checked(fb, validated=good is not None)
+            sink.append(out, fb.num_rows())
+            filtered = filtered or fb.num_rows() != n_in
+            kept_parts.append((offset, n_in, good))
+            offset += n_in
+        out_cols = sink.columns()
+        schema = self._helper.get_result_schema()
+        cols = {}
+        for name in schema.field_names:
+            if name in out_cols:
+                cols[name] = out_cols[name]
+        reserved = [n for n in schema.field_names if n not in cols]
+        if reserved:
+            src = table.select(reserved)
+            if filtered:
+                src = src.take_rows(_kept_indices(kept_parts))
+            for name in reserved:
+                cols[name] = src.col(name)
+        return Table.from_columns(schema, cols)
 
-    def _apply_batch(self, batch: Table, row_offset: int = 0) -> Table:
-        """One batch through the hardened serving boundary: validate ->
-        quarantine bad rows (they leave the jitted computation entirely and
-        land in the reason-coded side-table) -> map the good rows ->
-        row-alignment check -> OutputColsHelper merge."""
+    def _quarantine_batch(
+        self, batch: Table, row_offset: int = 0, validate: bool = True
+    ) -> Tuple[Table, Optional[np.ndarray]]:
+        """The serving-boundary validation half of a batch apply: validate
+        -> quarantine bad rows (they leave the jitted computation entirely
+        and land in the reason-coded side-table).  Returns the surviving
+        batch plus the good-row mask (``None`` when every row was servable
+        and the original batch object passed through untouched)."""
         from flink_ml_tpu.serve import quarantine
 
-        verdict = (
-            self.validate_batch(batch) if quarantine.enabled() else None
-        )
-        if verdict is not None:
-            good_mask, reasons = verdict
-            quarantine.emit(self.serve_name(), batch, good_mask, reasons,
-                            row_offset=row_offset)
-            batch = batch.filter_rows(good_mask)
-        if batch.num_rows() == 0 and verdict is not None:
-            # every row quarantined: synthesize empty output columns of the
-            # declared types rather than asking the mapper to map nothing
+        if not validate or not quarantine.enabled():
+            return batch, None
+        verdict = self.validate_batch(batch)
+        if verdict is None:
+            return batch, None
+        good_mask, reasons = verdict
+        quarantine.emit(self.serve_name(), batch, good_mask, reasons,
+                        row_offset=row_offset)
+        return batch.filter_rows(good_mask), np.asarray(good_mask, bool)
+
+    def _map_checked(self, batch: Table, validated: bool) -> Dict:
+        """The compute half: map the (surviving) rows and row-align-check
+        the produced columns.  ``validated`` marks a batch that went
+        through quarantine filtering — when it emptied the batch, output
+        columns are synthesized at their declared types rather than asking
+        the mapper to map nothing."""
+        if batch.num_rows() == 0 and validated:
             out = {
                 name: np.zeros(0, dtype=DataTypes.numpy_dtype(typ))
                 for name, typ in zip(self._helper.output_col_names,
@@ -143,7 +238,17 @@ class Mapper:
                 out = self.map_batch(batch)
         obs.counter_add("inference.batches")
         self._check_output_alignment(out, batch)
-        return self._helper.get_result_table(batch, out)
+        return out
+
+    def _apply_batch(self, batch: Table, row_offset: int = 0,
+                     validate: bool = True) -> Table:
+        """One batch through the hardened serving boundary: validate ->
+        quarantine -> map the good rows -> row-alignment check ->
+        OutputColsHelper merge."""
+        fb, good = self._quarantine_batch(batch, row_offset=row_offset,
+                                          validate=validate)
+        out = self._map_checked(fb, validated=good is not None)
+        return self._helper.get_result_table(fb, out)
 
     def _check_output_alignment(self, out: Dict[str, Sequence],
                                 batch: Table) -> None:
@@ -161,6 +266,82 @@ class Mapper:
                 raise MapperOutputMisalignedError(
                     self.serve_name(), name, len(values), n
                 )
+
+
+def _kept_indices(
+    parts: Sequence[Tuple[int, int, Optional[np.ndarray]]]
+) -> np.ndarray:
+    """Global surviving-row indices from per-batch (offset, n_in, good_mask)
+    records — materialized only on the (rare) quarantine-filtered path."""
+    return np.concatenate([
+        (np.nonzero(good)[0] + offset) if good is not None
+        else np.arange(offset, offset + n_in)
+        for offset, n_in, good in parts
+    ]) if parts else np.zeros(0, dtype=np.int64)
+
+
+class ColumnSink:
+    """Preallocated assembly of batched mapper output columns.
+
+    Storage per column is committed on the first batch that carries rows:
+    scalar numpy columns land in one preallocated 1-D array, matrix-backed
+    vector columns in one preallocated ``(rows, dim)`` array (both written
+    compactly and trimmed to the kept-row count), CSR columns accumulate
+    parts for one ``CsrRows.concat``, and anything row-object-shaped falls
+    back to a preallocated object array filled element-wise.  Shared by
+    ``Mapper.apply`` and the fused pipeline plan."""
+
+    def __init__(self, col_names: Sequence[str], col_types: Sequence[str],
+                 total_rows: int):
+        self._names = list(col_names)
+        self._types = list(col_types)
+        self._total = int(total_rows)
+        self._store: Dict[str, object] = {}
+        self._cursor = 0
+
+    def append(self, out: Dict[str, Sequence], n: int) -> None:
+        for name in self._names:
+            values = out.get(name)
+            if values is None:
+                raise ValueError(f"operator did not produce output col {name!r}")
+            store = self._store.get(name)
+            if store is None and n > 0:
+                store = self._store[name] = self._make_store(values)
+            if store is None or n == 0:
+                continue
+            if isinstance(store, list):
+                store.append(values)
+            elif isinstance(store, np.ndarray) and store.dtype != object:
+                store[self._cursor : self._cursor + n] = values
+            else:  # object storage: element-wise (never trust np broadcast
+                # rules on rows that are themselves sequences, e.g. vectors)
+                for i in range(n):
+                    store[self._cursor + i] = values[i]
+        self._cursor += n
+
+    def _make_store(self, values):
+        if isinstance(values, CsrRows):
+            return []  # parts -> one CsrRows.concat (ragged nnz, no prealloc)
+        arr = values if isinstance(values, np.ndarray) else None
+        if arr is not None and arr.dtype != object and arr.ndim in (1, 2):
+            shape = (self._total,) + arr.shape[1:]
+            return np.empty(shape, dtype=arr.dtype)
+        return np.empty(self._total, dtype=object)
+
+    def columns(self) -> Dict[str, Sequence]:
+        """The assembled columns, trimmed to the rows actually appended."""
+        out: Dict[str, Sequence] = {}
+        for name, typ in zip(self._names, self._types):
+            store = self._store.get(name)
+            if store is None:  # zero rows ever appended
+                out[name] = np.zeros(0, dtype=DataTypes.numpy_dtype(typ))
+            elif isinstance(store, list):
+                out[name] = (
+                    CsrRows.concat(store) if len(store) > 1 else store[0]
+                )
+            else:
+                out[name] = store[: self._cursor]
+        return out
 
 
 class ModelMapper(Mapper):

@@ -50,10 +50,19 @@ class LogisticRegressionModel(GlmModelBase):
 
             def map_batch(self, batch):
                 scores = self._scores(batch)
+                return self._score_cols(scores)
+
+            def _score_cols(self, scores):
                 out = {model.get_prediction_col(): (scores > 0).astype(np.float64)}
                 if detail is not None:
                     out[detail] = _stable_sigmoid(scores)
                 return out
+
+            def _fused_finalize(self, fetched, n):
+                # fused-plan host tail: identical to the map_batch tail —
+                # (scores > 0) is bit-stable under the f32->f64 fetch cast,
+                # so fused discrete predictions match the staged path
+                return self._score_cols(fetched["scores"])
 
         return _Mapper(self, data_schema)
 

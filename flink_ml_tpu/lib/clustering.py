@@ -271,11 +271,38 @@ class KMeansModelMapper(ModelMapper):
             device=lambda: apply_sharded(_assign_apply, X, self._centroids),
             fallback=lambda: self._assign_cpu(X),
         )
-        out = {model.get_prediction_col(): both[:n, 0].astype(np.int64)}
+        return self._assign_cols(both[:n])
+
+    def _assign_cols(self, both):
+        model = self._model_stage
+        out = {model.get_prediction_col(): both[:, 0].astype(np.int64)}
         detail = model.get_prediction_detail_col()
         if detail is not None:
-            out[detail] = np.sqrt(both[:n, 1])
+            out[detail] = np.sqrt(both[:, 1])
         return out
+
+    def fused_kernel(self):
+        from flink_ml_tpu.common.fused import FusedInput, FusedKernel
+
+        model = self._model_stage
+        feature_cols = model.get_feature_cols()
+
+        def fn(x, cents):
+            return {"assign": _assign_fn(x, cents)}
+
+        return FusedKernel(
+            inputs=[FusedInput(
+                dim=int(self._centroids.shape[1]),
+                vector_col=model.get_vector_col(),
+                feature_cols=tuple(feature_cols) if feature_cols else None,
+            )],
+            fn=fn,
+            out_keys=("assign",),
+            model_args=(self._centroids,),
+            finalize=lambda fetched, n: self._assign_cols(
+                fetched["assign"]
+            ),
+        )
 
     def _assign_cpu(self, X: np.ndarray) -> np.ndarray:
         """NumPy nearest-centroid fallback (same distance formula and

@@ -173,6 +173,14 @@ class StringIndexerModelMapper(ModelMapper):
             order = np.argsort(vals)
             self._lookup[str(c)] = (vals[order], indices[mask][order])
 
+    def fused_kernel(self):
+        # pure host lookup (vectorized searchsorted — there is no device
+        # dispatch to fuse away): joins a fused run as a pre-kernel so an
+        # indexer -> encoder -> model chain still compiles to one dispatch
+        from flink_ml_tpu.common.fused import FusedKernel
+
+        return FusedKernel(host=True)
+
     def map_batch(self, batch: Table):
         model = self._model_stage
         invalid = model.get_handle_invalid()
@@ -310,6 +318,13 @@ class OneHotEncoderModelMapper(ModelMapper):
             [[0], np.cumsum(self._sizes)[:-1]]
         )
         self._dim = int(self._sizes.sum())
+
+    def fused_kernel(self):
+        # host pre-kernel: the offset-stacked CSR build is integer numpy
+        # with no device call of its own (see StringIndexerModelMapper)
+        from flink_ml_tpu.common.fused import FusedKernel
+
+        return FusedKernel(host=True)
 
     def map_batch(self, batch: Table):
         model = self._model_stage

@@ -176,6 +176,29 @@ class StandardScalerModelMapper(ModelMapper):
         )
         return {model.resolved_output_col(): out}
 
+    def fused_kernel(self):
+        from flink_ml_tpu.common.fused import FusedInput, FusedKernel
+
+        model = self._model_stage
+        out_col = model.resolved_output_col()
+
+        def fn(x, shift, inv_scale):
+            return {"out": (x - shift) * inv_scale}
+
+        return FusedKernel(
+            inputs=[FusedInput(dim=self._dim,
+                               vector_col=model.get_selected_col())],
+            fn=fn,
+            out_keys=("out",),
+            model_args=(self._shift, self._inv_scale),
+            # cast back to the staged output dtype (the bundled fetch may
+            # ride an f64 lane on x64 hosts; f32->f64->f32 is value-exact)
+            finalize=lambda fetched, n, _c=out_col: {
+                _c: np.asarray(fetched["out"], dtype=np.float32)
+            },
+            env_outputs={"out": (out_col, self._dim)},
+        )
+
 
 class StandardScalerModel(TableModelBase, StandardScalerParams):
     """Normalizes the selected vector column with the fitted statistics."""
@@ -296,6 +319,27 @@ class MinMaxScalerModelMapper(ModelMapper):
             fallback=lambda: Xf * self._a_np + self._b_np,
         )
         return {model.resolved_output_col(): out}
+
+    def fused_kernel(self):
+        from flink_ml_tpu.common.fused import FusedInput, FusedKernel
+
+        model = self._model_stage
+        out_col = model.resolved_output_col()
+
+        def fn(x, a, b):
+            return {"out": x * a + b}
+
+        return FusedKernel(
+            inputs=[FusedInput(dim=self._dim,
+                               vector_col=model.get_selected_col())],
+            fn=fn,
+            out_keys=("out",),
+            model_args=(self._a, self._b),
+            finalize=lambda fetched, n, _c=out_col: {
+                _c: np.asarray(fetched["out"], dtype=np.float32)
+            },
+            env_outputs={"out": (out_col, self._dim)},
+        )
 
 
 class MinMaxScalerModel(TableModelBase, MinMaxScalerParams):

@@ -175,6 +175,39 @@ class LinearScoreMapper(ModelMapper):
             "feature_cols": model.get_feature_cols(),
         }
 
+    #: subclasses turn fetched fused scores into their output columns
+    #: (mirroring their map_batch tail); None keeps the mapper out of
+    #: fused plans — a custom LinearScoreMapper subclass with its own
+    #: map_batch but no finalize must split the plan, never be mis-served
+    _fused_finalize = None
+
+    def fused_kernel(self):
+        if type(self)._fused_finalize is None:
+            return None
+        from flink_ml_tpu.common.fused import FusedInput, FusedKernel
+
+        model = self._model_stage
+        feature_cols = model.get_feature_cols()
+
+        def dense_fn(x, w, b):
+            return {"scores": _score_fn(x, w, b)}
+
+        def csr_fn(csr, w, b):
+            return {"scores": csr.matvec(w.astype(jnp.float32)) + b}
+
+        return FusedKernel(
+            inputs=[FusedInput(
+                dim=int(self._w.shape[0]),
+                vector_col=model.get_vector_col(),
+                feature_cols=tuple(feature_cols) if feature_cols else None,
+            )],
+            fn=dense_fn,
+            csr_fn=csr_fn,
+            out_keys=("scores",),
+            model_args=(self._w, self._b),
+            finalize=self._fused_finalize,
+        )
+
     def _scores(self, batch: Table) -> np.ndarray:
         model = self._model_stage
         vector_col = model.get_vector_col()

@@ -336,12 +336,51 @@ class KnnModelMapper(ModelMapper):
             ),
             fallback=lambda: self._map_cpu(X, k),
         )
-        pred_ids = out[:n, 0].astype(np.int64)
+        return self._vote_cols(out[:n])
+
+    def _vote_cols(self, out):
+        model = self._model_stage
+        pred_ids = out[:, 0].astype(np.int64)
         result = {model.get_prediction_col(): self._classes[pred_ids]}
         detail = model.get_prediction_detail_col()
         if detail is not None:
-            result[detail] = np.sqrt(np.maximum(out[:n, 1], 0.0))  # nearest distance
+            result[detail] = np.sqrt(np.maximum(out[:, 1], 0.0))  # nearest distance
         return result
+
+    def fused_kernel(self):
+        if self._sharded:
+            # a data-axis-sharded reference set computes under its own
+            # collective-bearing apply; it cannot ride a replicated-args
+            # fused program — the plan splits and serves as today
+            return None
+        from flink_ml_tpu.common.fused import FusedInput, FusedKernel
+
+        model = self._model_stage
+        k = model.get_k()
+        chunk = self._chunk
+        n_classes = len(self._classes)
+        bf16 = bool(model.get_bf16_distances())
+        feature_cols = model.get_feature_cols()
+
+        def fn(xq, xt, yt):
+            labels, dists = _knn_chunked(xq, xt, yt, k, chunk, bf16)
+            pred = _majority_vote(labels.astype(jnp.int32), dists, n_classes)
+            return {"knn": jnp.concatenate(
+                [pred[:, None].astype(xq.dtype), dists.astype(xq.dtype)],
+                axis=1,
+            )}
+
+        return FusedKernel(
+            inputs=[FusedInput(
+                dim=int(self._xt.shape[1]),
+                vector_col=model.get_vector_col(),
+                feature_cols=tuple(feature_cols) if feature_cols else None,
+            )],
+            fn=fn,
+            out_keys=("knn",),
+            model_args=(self._xt, self._yt),
+            finalize=lambda fetched, n: self._vote_cols(fetched["knn"]),
+        )
 
     #: reference rows per CPU-fallback chunk — bounds the fallback's
     #: distance-matrix slice to O(batch x chunk), mirroring the device scan

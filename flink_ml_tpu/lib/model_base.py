@@ -65,25 +65,34 @@ class TableModelBase(Model):
     def _make_mapper(self, data_schema: Schema) -> ModelMapper:
         raise NotImplementedError
 
-    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
-        (table,) = inputs
-        # the loaded mapper holds the model packed on DEVICE (the
-        # broadcast-variable analog); reloading it per transform would
-        # re-transfer the whole model — for Knn that is the training set
-        # itself.  Cache it, keyed by everything the mapper captures — the
-        # mesh included: load-time placement can be mesh-committed
-        # (shardModelData), so a mesh change must rebuild the mapper.
+    def loaded_mapper(self, data_schema: Schema) -> ModelMapper:
+        """The model's mapper for ``data_schema``, with model data already
+        materialized on device.
+
+        The loaded mapper holds the model packed on DEVICE (the
+        broadcast-variable analog); reloading it per transform would
+        re-transfer the whole model — for Knn that is the training set
+        itself.  Cached, keyed by everything the mapper captures — the
+        mesh included: load-time placement can be mesh-committed
+        (shardModelData), so a mesh change must rebuild the mapper.  The
+        fused pipeline planner calls this too: plan build needs each
+        stage's device state without running a transform."""
         key = (
-            tuple(table.schema.field_names),
-            tuple(table.schema.field_types),
+            tuple(data_schema.field_names),
+            tuple(data_schema.field_types),
             self.get_params().to_json(),
             MLEnvironmentFactory.get_default().get_mesh(),
         )
         if self._mapper_cache is None or self._mapper_cache_key != key:
-            mapper = self._make_mapper(table.schema)
+            mapper = self._make_mapper(data_schema)
             mapper.load_model(*self.get_model_data())
             self._mapper_cache = mapper
             self._mapper_cache_key = key
+        return self._mapper_cache
+
+    def transform(self, *inputs: Table) -> Tuple[Table, ...]:
+        (table,) = inputs
+        mapper = self.loaded_mapper(table.schema)
         batch = MLEnvironmentFactory.get_default().default_batch_size
         # per-transform serve accounting: the serve.* counter delta across
         # this apply (quarantined rows, fallbacks, dispatch retries) lands
@@ -93,7 +102,7 @@ class TableModelBase(Model):
         from flink_ml_tpu.serve import serve_counter_snapshot
 
         serve0 = serve_counter_snapshot() if _obs.enabled() else None
-        out = self._mapper_cache.apply(table, batch_size=batch)
+        out = mapper.apply(table, batch_size=batch)
         if serve0 is not None:
             from flink_ml_tpu.obs.report import transform_report
             from flink_ml_tpu.serve import serve_counter_delta

@@ -36,6 +36,11 @@ class LinearRegressionModel(GlmModelBase):
                 scores = np.asarray(self._scores(batch), dtype=np.float64)
                 return {model.get_prediction_col(): scores}
 
+            def _fused_finalize(self, fetched, n):
+                return {model.get_prediction_col(): np.asarray(
+                    fetched["scores"], dtype=np.float64
+                )}
+
         return _Mapper(self, data_schema)
 
 

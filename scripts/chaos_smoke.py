@@ -351,6 +351,51 @@ def serve_main() -> int:
         print(f"  {name}: quarantine + breaker fallback + integrity OK "
               f"(fallbacks={counters.get('serve.fallbacks'):g})")
 
+    # -- leg 4: breaker trips INSIDE a fused plan -> per-stage fallback ------
+    # (ISSUE 6): a 3-stage fused pipeline under a sticky dispatch fault
+    # must open the per-PLAN breaker, split to the per-stage path, and —
+    # since the fault stays sticky there too — bottom out in each mapper's
+    # CPU fallback with exact discrete parity
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import (
+        LogisticRegression,
+        MinMaxScaler,
+        StandardScaler,
+    )
+
+    pipe = Pipeline([
+        StandardScaler().set_selected_col("features").set_output_col("s1"),
+        MinMaxScaler().set_selected_col("s1").set_output_col("s2"),
+        LogisticRegression().set_vector_col("s2").set_label_col("label")
+        .set_prediction_col("p").set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+    os.environ["FMT_FUSE_TRANSFORM"] = "1"
+    (ref_t,) = pipe.transform(table)
+    serve.reset_breakers()
+    obs.reset()
+    fault.configure("serve.dispatch@1+", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            pipe.transform(table)            # plan breaker absorbs failures
+            (fb_t,) = pipe.transform(table)  # now fully open
+    finally:
+        fault.configure(None)
+    np.testing.assert_array_equal(
+        _col_matrix(fb_t, "p"), _col_matrix(ref_t, "p"),
+        err_msg="fused plan: per-stage fallback predictions diverge",
+    )
+    counters = obs.registry().snapshot()["counters"]
+    plan_keys = [k for k in counters
+                 if k.startswith("serve.fallbacks.FusedPlan[")]
+    assert plan_keys, counters
+    plan_name = plan_keys[0][len("serve.fallbacks."):]
+    assert serve.breaker(plan_name).state == 1.0, f"{plan_name}: not open"
+    assert counters.get("pipeline.plan_fallback_batches", 0) >= 1, counters
+    print(f"  fused plan: breaker open -> per-stage fallback parity OK "
+          f"({plan_name}, "
+          f"fallback_batches={counters.get('pipeline.plan_fallback_batches'):g})")
+
     # -- RunReport accounting: fallback-only transforms are SERVE-DEGRADED ---
     from flink_ml_tpu.obs.report import load_reports, serve_degraded_runs
 

@@ -1,0 +1,535 @@
+"""Fused pipeline inference (common/fused.py) — plan grouping, parity,
+fallback, and the batched-apply output sink.
+
+The fusion contract under test: a PipelineModel transform over kernel-
+capable stages issues exactly ONE device dispatch per batch per fused run
+(`pipeline.fused_dispatches`), with bit-identical discrete predictions and
+float scores inside accumulation tolerance of the per-stage path; anything
+the planner cannot fuse — a kernel-less mapper, an incompatible column
+flow, a tripped per-plan breaker — transparently splits the plan and
+serves exactly as the staged path.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import fault, obs, serve
+from flink_ml_tpu.api.core import Transformer
+from flink_ml_tpu.api.pipeline import Pipeline, PipelineModel
+from flink_ml_tpu.common import fused
+from flink_ml_tpu.common.mapper import ColumnSink
+from flink_ml_tpu.lib import (
+    KMeans,
+    Knn,
+    LinearRegression,
+    LogisticRegression,
+)
+from flink_ml_tpu.lib.encoding import OneHotEncoder, StringIndexer
+from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+from flink_ml_tpu.serve import quarantine
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+N, D = 1024, 6
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+
+
+@pytest.fixture
+def dense_table():
+    rng = np.random.RandomState(7)
+    X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+    w = rng.randn(D).astype(np.float32)
+    y = ((X - 1.0) @ w > 0).astype(np.float64)
+    return Table.from_columns(SCHEMA, {"features": X, "label": y})
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+@pytest.fixture
+def batch_size():
+    """Force multi-batch transforms (N=1024 -> 4 batches of 256)."""
+    env = MLEnvironmentFactory.get_default()
+    old = env.default_batch_size
+    env.default_batch_size = 256
+    yield 256
+    env.default_batch_size = old
+
+
+def _transform(model, table, fuse, monkeypatch):
+    monkeypatch.setenv("FMT_FUSE_TRANSFORM", "1" if fuse else "0")
+    (out,) = model.transform(table)
+    return out
+
+
+def _assert_parity(staged, fused_t, discrete_cols=(), float_cols=()):
+    assert staged.schema == fused_t.schema
+    for col in discrete_cols:
+        np.testing.assert_array_equal(
+            np.asarray(staged.col(col), dtype=np.float64),
+            np.asarray(fused_t.col(col), dtype=np.float64),
+            err_msg=col,
+        )
+    for col in float_cols:
+        np.testing.assert_allclose(
+            np.asarray(staged.features_dense(col), dtype=np.float64)
+            if DataTypes.is_vector(staged.schema.type_of(col))
+            else np.asarray(staged.col(col), dtype=np.float64),
+            np.asarray(fused_t.features_dense(col), dtype=np.float64)
+            if DataTypes.is_vector(fused_t.schema.type_of(col))
+            else np.asarray(fused_t.col(col), dtype=np.float64),
+            rtol=1e-5, atol=1e-7, err_msg=col,
+        )
+
+
+class TestFusionParity:
+    def test_scaler_scaler_logreg_one_dispatch_per_batch(
+        self, dense_table, obs_on, batch_size, monkeypatch
+    ):
+        """The acceptance shape: a >=3-stage pipeline fuses to exactly one
+        dispatch per batch, discrete predictions bit-identical."""
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_prediction_detail_col("proba").set_max_iter(3)
+            .set_learning_rate(0.5),
+        ]).fit(dense_table)
+        staged = _transform(model, dense_table, False, monkeypatch)
+        obs.reset()
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        c = obs.registry().snapshot()["counters"]
+        n_batches = -(-N // batch_size)
+        assert c.get("pipeline.fused_dispatches") == n_batches
+        assert c.get("pipeline.fused_rows") == N
+        assert obs.registry().snapshot()["gauges"][
+            "pipeline.fusion_ratio"] == 1.0
+        _assert_parity(staged, fused_t,
+                       discrete_cols=["pred"],
+                       float_cols=["proba", "features", "label"])
+
+    def test_linreg_kmeans_family_parity(self, dense_table, monkeypatch):
+        model = Pipeline([
+            StandardScaler().set_selected_col("features")
+            .set_output_col("scaled"),
+            LinearRegression().set_vector_col("scaled")
+            .set_label_col("label").set_prediction_col("reg")
+            .set_reserved_cols(["scaled", "label"]).set_max_iter(3),
+        ]).fit(dense_table)
+        staged = _transform(model, dense_table, False, monkeypatch)
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        _assert_parity(staged, fused_t, float_cols=["reg", "scaled"])
+
+        km = Pipeline([
+            StandardScaler().set_selected_col("features")
+            .set_output_col("scaled"),
+            KMeans().set_vector_col("scaled").set_k(4)
+            .set_prediction_col("cluster").set_prediction_detail_col("dist")
+            .set_max_iter(3),
+        ]).fit(dense_table)
+        staged = _transform(km, dense_table, False, monkeypatch)
+        fused_t = _transform(km, dense_table, True, monkeypatch)
+        _assert_parity(staged, fused_t, discrete_cols=["cluster"],
+                       float_cols=["dist"])
+
+    def test_knn_after_scaler_parity(self, dense_table, obs_on, monkeypatch):
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            Knn().set_vector_col("features").set_label_col("label")
+            .set_k(3).set_prediction_col("p"),
+        ]).fit(dense_table)
+        staged = _transform(model, dense_table, False, monkeypatch)
+        obs.reset()
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        assert obs.registry().snapshot()["counters"][
+            "pipeline.fused_dispatches"] == 1
+        _assert_parity(staged, fused_t, discrete_cols=["p"])
+
+    def test_categorical_chain_host_kernels_fuse(self, obs_on, monkeypatch):
+        """indexer -> encoder -> sparse LR: the host lookups join the run
+        as pre-kernels; the whole 3-stage chain is one dispatch."""
+        rng = np.random.RandomState(3)
+        cats = np.array(["a", "b", "c", "d"])
+        schema = Schema.of(("c1", DataTypes.STRING),
+                           ("c2", DataTypes.STRING), ("label", "double"))
+        t = Table.from_columns(schema, {
+            "c1": cats[rng.randint(0, 4, N)],
+            "c2": cats[rng.randint(0, 3, N)],
+            "label": (rng.rand(N) > 0.5).astype(np.float64),
+        })
+        model = Pipeline([
+            StringIndexer().set_selected_cols(["c1", "c2"])
+            .set_output_cols(["i1", "i2"]),
+            OneHotEncoder().set_selected_cols(["i1", "i2"])
+            .set_output_col("feat"),
+            LogisticRegression().set_vector_col("feat")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_num_features(7).set_max_iter(3),
+        ]).fit(t)
+        staged = _transform(model, t, False, monkeypatch)
+        obs.reset()
+        fused_t = _transform(model, t, True, monkeypatch)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("pipeline.fused_dispatches") == 1
+        _assert_parity(staged, fused_t,
+                       discrete_cols=["pred", "i1", "i2"])
+
+    def test_inplace_overwrite_skips_dead_fetch(self, dense_table,
+                                                monkeypatch):
+        """scaler -> scaler both writing 'features' in place: the first
+        scaler's matrix is overwritten mid-run and must not be fetched."""
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+        ]).fit(dense_table)
+        monkeypatch.setenv("FMT_FUSE_TRANSFORM", "1")
+        run = fused._run_for(
+            model, model.stages, 0, dense_table.schema, None
+        )
+        assert run is not None
+        assert [ds.fetch for ds in run.device_stages] == [False, True]
+        staged = _transform(model, dense_table, False, monkeypatch)
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        _assert_parity(staged, fused_t, float_cols=["features"])
+
+
+class TestPlanSplitting:
+    def test_kernel_less_stage_splits_plan(self, dense_table, obs_on,
+                                           monkeypatch):
+        class Doubler(Transformer):
+            def transform(self, *inputs):
+                (t,) = inputs
+                X = np.asarray(t.features_dense("features"),
+                               np.float32) * 2.0
+                return (t.with_column(
+                    "features", DataTypes.DENSE_VECTOR, X),)
+
+        sc1 = StandardScaler().set_selected_col("features").fit(dense_table)
+        sc2 = MinMaxScaler().set_selected_col("features").fit(dense_table)
+        lr = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_max_iter(3).fit(dense_table)
+        )
+        model = PipelineModel([sc1, sc2, Doubler(), sc2, lr])
+        staged = _transform(model, dense_table, False, monkeypatch)
+        obs.reset()
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        c = obs.registry().snapshot()["counters"]
+        # [sc1, sc2] fuse, Doubler serves staged, [sc2, lr] fuse -> 2 runs
+        assert c.get("pipeline.fused_dispatches") == 2
+        assert obs.registry().snapshot()["gauges"][
+            "pipeline.fusion_ratio"] == pytest.approx(4 / 5)
+        _assert_parity(staged, fused_t, discrete_cols=["pred"],
+                       float_cols=["features"])
+
+    def test_custom_scorer_without_finalize_never_fuses(self, dense_table,
+                                                        obs_on, monkeypatch):
+        """A LinearScoreMapper subclass overriding map_batch but not the
+        fused finalize must stay on the per-stage path (fusing it would
+        silently serve the base scorer's columns)."""
+        from flink_ml_tpu.lib.glm import LinearScoreMapper
+        from flink_ml_tpu.lib.regression import LinearRegressionModel
+
+        class OddModel(LinearRegressionModel):
+            def _make_mapper(self, data_schema):
+                model = self
+
+                class _Odd(LinearScoreMapper):
+                    def output_cols(self):
+                        return [model.get_prediction_col()], ["double"]
+
+                    def map_batch(self, batch):
+                        s = self._scores(batch)
+                        return {model.get_prediction_col(): np.asarray(
+                            s * 3.0, dtype=np.float64)}
+
+                return _Odd(self, data_schema)
+
+        base = (
+            LinearRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("odd")
+            .set_max_iter(2).fit(dense_table)
+        )
+        odd = OddModel()
+        odd.get_params().merge(base.get_params())
+        odd.set_model_data(*base.get_model_data())
+        sc = StandardScaler().set_selected_col("features").fit(dense_table)
+        model = PipelineModel([sc, odd])
+        staged = _transform(model, dense_table, False, monkeypatch)
+        obs.reset()
+        fused_t = _transform(model, dense_table, True, monkeypatch)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("pipeline.fused_dispatches") is None  # no fusable run
+        _assert_parity(staged, fused_t, float_cols=["odd"])
+
+    def test_single_stage_and_knob_off_stay_staged(self, dense_table,
+                                                   obs_on, monkeypatch):
+        sc = StandardScaler().set_selected_col("features").fit(dense_table)
+        lr = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_max_iter(2).fit(dense_table)
+        )
+        PipelineModel([sc]).transform(dense_table)
+        assert "pipeline.fused_dispatches" not in (
+            obs.registry().snapshot()["counters"]
+        )
+        monkeypatch.setenv("FMT_FUSE_TRANSFORM", "0")
+        PipelineModel([sc, lr]).transform(dense_table)
+        assert "pipeline.fused_dispatches" not in (
+            obs.registry().snapshot()["counters"]
+        )
+
+
+class TestFusedQuarantine:
+    def test_offsets_survive_fused_batching(self, dense_table, obs_on,
+                                            batch_size, monkeypatch):
+        """Bad rows quarantined at plan entry carry their ORIGINAL feed
+        offsets (here: rows 5 and 700, landing in different batches) and
+        the survivors serve exactly as a staged transform's survivors."""
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            KMeans().set_vector_col("features").set_k(4)
+            .set_prediction_col("cluster").set_max_iter(2),
+        ]).fit(dense_table)
+        X = np.asarray(dense_table.features_dense("features")).copy()
+        X[5, 0] = np.nan
+        X[700, 2] = np.inf
+        bad = Table.from_columns(SCHEMA, {
+            "features": X, "label": dense_table.col("label")})
+        quarantine.reset()
+        fused_t = _transform(model, bad, True, monkeypatch)
+        assert fused_t.num_rows() == N - 2
+        qt = quarantine.quarantine_table("StandardScalerModel")
+        assert qt is not None
+        rows = sorted(int(r) for r in qt.col(quarantine.QUARANTINE_ROW_COL))
+        assert rows == [5, 700]
+        assert set(qt.col(quarantine.QUARANTINE_REASON_COL)) == {"nan_inf"}
+        quarantine.reset()
+        staged = _transform(model, bad, False, monkeypatch)
+        quarantine.reset()
+        _assert_parity(staged, fused_t, discrete_cols=["cluster"],
+                       float_cols=["features"])
+
+    def test_second_validator_offsets_map_to_original_feed(self, obs_on,
+                                                           monkeypatch):
+        """Two device stages validating DIFFERENT host columns: rows the
+        second validator flags were renumbered by the first validator's
+        filtering — its side-table must still carry original feed rows."""
+        rng = np.random.RandomState(9)
+        f = rng.randn(N, 4).astype(np.float32)
+        g = rng.randn(N, 4).astype(np.float32)
+        schema = Schema.of(("f", DataTypes.DENSE_VECTOR),
+                           ("g", DataTypes.DENSE_VECTOR),
+                           ("label", "double"))
+        y = (g[:, 0] > 0).astype(np.float64)
+        clean = Table.from_columns(schema, {"f": f, "g": g, "label": y})
+        model = Pipeline([
+            KMeans().set_vector_col("f").set_k(3)
+            .set_prediction_col("cluster").set_max_iter(2),
+            LogisticRegression().set_vector_col("g").set_label_col("label")
+            .set_prediction_col("pred").set_max_iter(2),
+        ]).fit(clean)
+        fbad, gbad = f.copy(), g.copy()
+        fbad[3, 0] = np.nan   # validator 1 (KMeans on 'f') flags row 3
+        gbad[4, 1] = np.inf   # validator 2 (LR on 'g') flags feed row 4 —
+        bad = Table.from_columns(schema, {  # local index 3 after filtering
+            "f": fbad, "g": gbad, "label": y})
+        quarantine.reset()
+        out = _transform(model, bad, True, monkeypatch)
+        assert out.num_rows() == N - 2
+        km = quarantine.quarantine_table("KMeansModel")
+        lr = quarantine.quarantine_table("LogisticRegressionModel")
+        assert [int(r) for r in km.col(quarantine.QUARANTINE_ROW_COL)] == [3]
+        assert [int(r) for r in lr.col(quarantine.QUARANTINE_ROW_COL)] == [4]
+        quarantine.reset()
+
+    def test_all_rows_quarantined_batch_serves_empty(self, dense_table,
+                                                     batch_size,
+                                                     monkeypatch):
+        X = np.asarray(dense_table.features_dense("features")).copy()
+        X[:batch_size] = np.nan  # the whole first batch
+        bad = Table.from_columns(SCHEMA, {
+            "features": X, "label": dense_table.col("label")})
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+        ]).fit(dense_table)
+        quarantine.reset()
+        fused_t = _transform(model, bad, True, monkeypatch)
+        assert fused_t.num_rows() == N - batch_size
+        quarantine.reset()
+
+
+class TestFusedBreaker:
+    def test_breaker_open_degrades_to_per_stage(self, dense_table, obs_on,
+                                                monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "2")
+        monkeypatch.setenv("FMT_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("FMT_RETRY_BASE_S", "0.001")
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            KMeans().set_vector_col("features").set_k(4)
+            .set_prediction_col("cluster").set_max_iter(2),
+        ]).fit(dense_table)
+        ref = _transform(model, dense_table, False, monkeypatch)
+        serve.reset_breakers()
+        obs.reset()
+        fault.configure("serve.dispatch@1+", seed=0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                _transform(model, dense_table, True, monkeypatch)
+                out = _transform(model, dense_table, True, monkeypatch)
+        finally:
+            fault.configure(None)
+        c = obs.registry().snapshot()["counters"]
+        plan_names = [k for k in c if k.startswith(
+            "serve.fallbacks.FusedPlan[")]
+        assert plan_names, c
+        assert c.get("pipeline.plan_fallback_batches", 0) >= 1
+        assert serve.breaker(
+            plan_names[0][len("serve.fallbacks."):]).state == 1.0
+        # the degraded plan's per-stage path bottomed out in each mapper's
+        # CPU fallback (the fault is sticky): discrete predictions exact
+        _assert_parity(ref, out, discrete_cols=["cluster"],
+                       float_cols=["features"])
+        serve.reset_breakers()
+
+
+class TestBatchedApplySink:
+    """Satellite: Mapper.apply preallocates output columns and reuses the
+    input table's buffers for reserved cols instead of parts+concat."""
+
+    def test_batched_apply_matches_single_batch(self, dense_table):
+        model = (
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_prediction_detail_col("proba").set_max_iter(2)
+            .fit(dense_table)
+        )
+        mapper = model.loaded_mapper(dense_table.schema)
+        whole = mapper.apply(dense_table)
+        batched = mapper.apply(dense_table, batch_size=100)
+        assert whole.schema == batched.schema
+        np.testing.assert_array_equal(
+            np.asarray(whole.col("pred")), np.asarray(batched.col("pred")))
+        np.testing.assert_allclose(
+            np.asarray(whole.col("proba")),
+            np.asarray(batched.col("proba")), rtol=1e-6)
+        # reserved columns ride the INPUT buffers — no per-batch copies
+        assert batched.col("label") is dense_table.col("label")
+
+    def test_batched_apply_with_quarantined_rows(self, dense_table):
+        model = (
+            KMeans().set_vector_col("features").set_k(3)
+            .set_prediction_col("cluster").set_max_iter(2)
+            .fit(dense_table)
+        )
+        X = np.asarray(dense_table.features_dense("features")).copy()
+        X[17, 0] = np.nan
+        X[400, 1] = np.inf
+        bad = Table.from_columns(SCHEMA, {
+            "features": X, "label": dense_table.col("label")})
+        mapper = model.loaded_mapper(bad.schema)
+        quarantine.reset()
+        batched = mapper.apply(bad, batch_size=128)
+        quarantine.reset()
+        whole = mapper.apply(bad)
+        quarantine.reset()
+        assert batched.num_rows() == N - 2 == whole.num_rows()
+        np.testing.assert_array_equal(
+            np.asarray(whole.col("cluster")),
+            np.asarray(batched.col("cluster")))
+        np.testing.assert_array_equal(
+            np.asarray(whole.col("label")), np.asarray(batched.col("label")))
+
+    def test_batched_csr_output_column(self):
+        """OneHotEncoder's CSR output concatenates across batches."""
+        rng = np.random.RandomState(5)
+        schema = Schema.of(("i1", "double"), ("label", "double"))
+        t = Table.from_columns(schema, {
+            "i1": rng.randint(0, 4, 500).astype(np.float64),
+            "label": np.zeros(500),
+        })
+        model = (
+            OneHotEncoder().set_selected_cols(["i1"])
+            .set_output_col("feat").fit(t)
+        )
+        mapper = model.loaded_mapper(t.schema)
+        whole = mapper.apply(t)
+        batched = mapper.apply(t, batch_size=64)
+        a = whole.features_dense("feat")
+        b = batched.features_dense("feat")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_column_sink_object_rows(self):
+        sink = ColumnSink(["v"], [DataTypes.STRING], 5)
+        sink.append({"v": ["a", "b"]}, 2)
+        sink.append({"v": ["c"]}, 1)
+        out = sink.columns()["v"]
+        assert list(out) == ["a", "b", "c"]
+
+    def test_column_sink_missing_col_raises(self):
+        sink = ColumnSink(["v"], ["double"], 3)
+        with pytest.raises(ValueError, match="did not produce"):
+            sink.append({}, 2)
+
+
+class TestReapHoisting:
+    """Satellite: one slab-pool reap per PipelineModel.transform (and per
+    plan entry), not one per stage; none at all on empty tables."""
+
+    def _count_reaps(self, monkeypatch):
+        from flink_ml_tpu.table import slab_pool
+
+        calls = []
+        orig = slab_pool.SlabPool.reap
+        monkeypatch.setattr(
+            slab_pool.SlabPool, "reap",
+            lambda self: calls.append(1) or orig(self),
+        )
+        return calls
+
+    def test_pipeline_transform_reaps_once(self, dense_table, monkeypatch):
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_max_iter(2),
+        ]).fit(dense_table)
+        for fuse in ("0", "1"):
+            monkeypatch.setenv("FMT_FUSE_TRANSFORM", fuse)
+            calls = self._count_reaps(monkeypatch)
+            model.transform(dense_table)
+            assert len(calls) == 1, (fuse, len(calls))
+
+    def test_standalone_apply_still_reaps(self, dense_table, monkeypatch):
+        model = (
+            StandardScaler().set_selected_col("features").fit(dense_table)
+        )
+        calls = self._count_reaps(monkeypatch)
+        model.transform(dense_table)
+        assert len(calls) == 1
+
+    def test_empty_table_apply_skips_reap(self, dense_table, monkeypatch):
+        model = (
+            StandardScaler().set_selected_col("features").fit(dense_table)
+        )
+        empty = dense_table.slice_rows(0, 0)
+        calls = self._count_reaps(monkeypatch)
+        mapper = model.loaded_mapper(dense_table.schema)
+        mapper.apply(empty)
+        assert len(calls) == 0
