@@ -264,6 +264,9 @@ class FusedRun:
             # staged sparse scoring buckets without a row-multiple (plain
             # jit); the whole run follows so every input shares one bucket
             return bucket_rows(max(n, 1))
+        # dense inputs ride the shared batch-shape ladder
+        # (utils/compile_cache.bucket_batch_rows, via _bucket_for): fused
+        # plans, staged applies, and serving micro-batches pad identically
         return _bucket_for(n, 256, row_multiple)
 
     def _extract(self, batch: Table, b: int, mesh, row_multiple: int):

@@ -2503,14 +2503,22 @@ def bucket_rows(n: int, minimum: int = 256) -> int:
 
 
 def _bucket_for(n: int, bucket_minimum: int, row_multiple: int) -> int:
-    """The ONE copy of the inference bucket rule (power-of-two rows,
-    rounded up to the data-axis multiple) — the pooled and unpooled apply
-    paths must choose identical padded shapes or pool_key callers would
-    compile different programs than plain callers."""
-    b = bucket_rows(max(n, 1), bucket_minimum)
-    if row_multiple > 1:
-        b = -(-b // row_multiple) * row_multiple
-    return b
+    """The ONE copy of the inference bucket rule — the pooled and unpooled
+    apply paths must choose identical padded shapes or pool_key callers
+    would compile different programs than plain callers.
+
+    Delegates to the shared batch-shape ladder
+    (:func:`~flink_ml_tpu.utils.compile_cache.bucket_batch_rows`), which
+    the fused pipeline plans and the serving runtime's coalesced
+    micro-batches also pad to: a 3-row serving request and a 3-row staged
+    apply dispatch the same compiled program.  ``bucket_minimum`` is
+    retained for signature stability but the ladder (whose bottom rungs
+    sit below the old 256-row floor exactly so single-row serving requests
+    stop padding to training-shaped buckets) owns the rule now."""
+    del bucket_minimum  # the shared ladder owns the rung choice
+    from flink_ml_tpu.utils.compile_cache import bucket_batch_rows
+
+    return bucket_batch_rows(n, row_multiple)
 
 
 def _pad_rows_to(X: np.ndarray, b: int) -> np.ndarray:

@@ -50,22 +50,51 @@ def disable() -> None:
     enable(False)
 
 
-class TimingStat:
-    """count/total/min/max of one named duration (seconds)."""
+def sample_quantile(sorted_samples, q: float) -> float:
+    """Nearest-rank ``q``-quantile (0..1) over already-sorted samples —
+    the ONE copy of the rule, shared by :class:`TimingStat` and the
+    serving runtime's per-server latency reservoir so the two can never
+    disagree about what a p99 means.  Empty input -> 0.0."""
+    if not sorted_samples:
+        return 0.0
+    i = min(int(round(q * (len(sorted_samples) - 1))),
+            len(sorted_samples) - 1)
+    return sorted_samples[i]
 
-    __slots__ = ("count", "total", "min", "max")
+
+class TimingStat:
+    """count/total/min/max + tail quantiles of one named duration (seconds).
+
+    Quantiles come from a bounded ring of the most recent ``RESERVOIR``
+    samples (overwritten round-robin): exact for short runs, a sliding
+    recent-window estimate for long ones — the shape a serving p99 wants
+    anyway (the p99 of last week's requests is not an alert signal).
+    Mutation happens only under the owning registry's lock."""
+
+    __slots__ = ("count", "total", "min", "max", "samples")
+
+    RESERVOIR = 512
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
+        self.samples: list = []
 
     def observe(self, seconds: float) -> None:
+        if len(self.samples) < self.RESERVOIR:
+            self.samples.append(seconds)
+        else:
+            self.samples[self.count % self.RESERVOIR] = seconds
         self.count += 1
         self.total += seconds
         self.min = min(self.min, seconds)
         self.max = max(self.max, seconds)
+
+    def quantile(self, q: float) -> float:
+        """The ``q``-quantile (0..1) over the retained sample window."""
+        return sample_quantile(sorted(self.samples), q)
 
     def to_dict(self) -> Dict[str, float]:
         return {
@@ -74,6 +103,8 @@ class TimingStat:
             "min_s": self.min if self.count else 0.0,
             "max_s": self.max,
             "mean_s": self.total / self.count if self.count else 0.0,
+            "p50_s": self.quantile(0.50),
+            "p99_s": self.quantile(0.99),
         }
 
 
@@ -108,6 +139,12 @@ class MetricsRegistry:
     def gauge(self, name: str) -> Optional[float]:
         with self._lock:
             return self._gauges.get(name)
+
+    def timing(self, name: str) -> Optional[Dict[str, float]]:
+        """One timing stat as its dict form (None when never observed)."""
+        with self._lock:
+            stat = self._timings.get(name)
+            return stat.to_dict() if stat is not None else None
 
     def snapshot(self) -> dict:
         """Plain-dict view of everything recorded (JSON-serializable)."""

@@ -259,6 +259,31 @@ def transform_report(name: str, rows: int, serve_delta: dict,
         return None
 
 
+def serving_report(name: str, extra: Optional[dict] = None,
+                   directory: Optional[str] = None) -> Optional[str]:
+    """Write a ``serving`` RunReport (no-op when obs is disabled).
+
+    Emitted by ``ModelServer.shutdown``: the server's lifetime counters —
+    requests/batches/shed (per reason)/swaps/deploy failures — plus the
+    request-latency p50/p99 from the registry's timing quantiles.  Like
+    ``transform_report`` the full registry snapshot is omitted; the
+    serving delta IS the signal."""
+    if not _obs_enabled():
+        return None
+    try:
+        report = RunReport(
+            kind="serving",
+            name=name,
+            ts=time.time(),
+            git_sha=git_sha(),
+            device=device_topology(),
+            extra=dict(extra or {}),
+        )
+        return write_run_report(report, directory)
+    except Exception:  # noqa: BLE001 - telemetry must never fail serving
+        return None
+
+
 def serve_degraded_runs(reports: List[dict]) -> List[dict]:
     """Transform reports that only completed via the CPU fallback.
 

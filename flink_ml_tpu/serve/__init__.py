@@ -36,6 +36,7 @@ from flink_ml_tpu.serve.breaker import (  # noqa: F401
     CircuitBreaker,
     breaker,
     dispatch,
+    open_breaker_names,
     reset_breakers,
     serve_counter_delta,
     serve_counter_snapshot,
@@ -59,6 +60,7 @@ __all__ = [
     "atomic_json_dump",
     "breaker",
     "dispatch",
+    "open_breaker_names",
     "quarantine",
     "reset_breakers",
     "serve_counter_delta",

@@ -57,6 +57,7 @@ __all__ = [
     "CircuitBreaker",
     "breaker",
     "dispatch",
+    "open_breaker_names",
     "reset_breakers",
     "serve_counter_snapshot",
     "serve_counter_delta",
@@ -92,8 +93,16 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._state = _CLOSED
+        # half-open probe bookkeeping: exactly ONE caller owns the probe
+        # (concurrent serving callers hammering an open breaker must not
+        # all ride through the cooldown edge at once — that was a probe
+        # stampede against a device the breaker just declared dead)
+        self._probing = False
+        self._probe_started: Optional[float] = None
 
     def _publish(self) -> None:
+        global _STATE_GEN
+        _STATE_GEN += 1  # invalidates cross-breaker state memos (serving)
         obs.gauge_set(f"serve.breaker_state.{self.name}", self._state)
 
     @property
@@ -102,14 +111,42 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def blocking(self) -> bool:
+        """Is the breaker open with its cooldown still running?  The
+        shed-on-breaker admission signal: once the cooldown elapses the
+        next dispatch may probe, so requests should flow again."""
+        with self._lock:
+            return (
+                self._state == _OPEN
+                and time.monotonic() - self._opened_at < _cooldown_s()
+            )
+
     def _allow_local(self) -> bool:
         with self._lock:
             if self._state == _CLOSED:
                 return True
-            if time.monotonic() - self._opened_at >= _cooldown_s():
-                # one probe rides through; concurrent callers in the same
-                # window also probe — harmless (each failure re-opens)
+            now = time.monotonic()
+            if self._state == _HALF_OPEN:
+                # a probe is in flight: everyone else stays on the
+                # fallback until it resolves.  If the prober died without
+                # ever recording an outcome (a wedged dispatch), a full
+                # cooldown past the probe's start hands the probe to the
+                # next caller instead of wedging half-open forever.
+                if self._probing and (
+                    self._probe_started is None
+                    or now - self._probe_started < _cooldown_s()
+                ):
+                    return False
+                self._probing = True
+                self._probe_started = now
+                return True
+            if now - self._opened_at >= _cooldown_s():
+                # cooldown elapsed: exactly one caller takes the probe —
+                # the first through this lock flips to half-open and owns
+                # it; the rest see HALF_OPEN + probing above and fall back
                 self._state = _HALF_OPEN
+                self._probing = True
+                self._probe_started = now
                 self._publish()
                 return True
             return False
@@ -136,6 +173,8 @@ class CircuitBreaker:
             if self._state == _HALF_OPEN or self._failures >= _threshold():
                 self._state = _OPEN
                 self._opened_at = time.monotonic()
+            self._probing = False
+            self._probe_started = None
             self._publish()
 
     def record_success(self) -> None:
@@ -144,11 +183,23 @@ class CircuitBreaker:
                 self._failures = 0
                 self._opened_at = None
                 self._state = _CLOSED
+                self._probing = False
+                self._probe_started = None
                 self._publish()
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}
 _BREAKERS_LOCK = threading.Lock()
+
+#: bumped on every breaker state transition (and registry reset) — lets a
+#: consumer memoize "which breakers are open" and revalidate only when
+#: something actually changed, instead of scanning every breaker per call
+_STATE_GEN = 0
+
+
+def state_generation() -> int:
+    """Monotonic counter of breaker state transitions process-wide."""
+    return _STATE_GEN
 
 
 def breaker(name: str) -> CircuitBreaker:
@@ -163,8 +214,22 @@ def breaker(name: str) -> CircuitBreaker:
 
 def reset_breakers() -> None:
     """Drop every breaker (tests; per-run scoping)."""
+    global _STATE_GEN
     with _BREAKERS_LOCK:
         _BREAKERS.clear()
+        _STATE_GEN += 1
+
+
+def open_breaker_names() -> list:
+    """Names of every breaker currently OPEN (cooldown not yet elapsed).
+
+    The serving runtime's shed-on-breaker admission check: a request-level
+    server queueing work onto a dispatch surface whose breaker is open
+    would just grow a backlog against a dead device — it sheds at the door
+    instead (``flink_ml_tpu/serving/server.py``)."""
+    with _BREAKERS_LOCK:
+        breakers = list(_BREAKERS.values())
+    return [b.name for b in breakers if b.blocking()]
 
 
 def dispatch(name: str, device: Callable, fallback: Optional[Callable] = None,

@@ -48,6 +48,7 @@ __all__ = [
     "QUARANTINE_REASON_COL",
     "QUARANTINE_ROW_COL",
     "agreed_bad_mask",
+    "capture",
     "drain",
     "emit",
     "enabled",
@@ -251,6 +252,46 @@ _STORE: Dict[str, List[Table]] = {}
 _STORED_ROWS: Dict[str, int] = {}
 _DROPPED: Dict[str, int] = {}
 
+#: thread-local capture sink (the serving demux path)
+_CAPTURE = threading.local()
+
+
+class capture:
+    """Divert this thread's :func:`emit` side-tables to a local sink.
+
+    The serving runtime transforms a COALESCED batch of many callers'
+    rows; its demux needs exactly the side-tables that transform emitted,
+    keyed to the coalesced row offsets, without racing other threads'
+    traffic or leaking request rows into the process-wide store.  Inside
+    the context, emissions from THIS thread append ``(mapper name,
+    side-table, emitting batch rows)`` triples to the yielded list
+    instead of the global store (counters still record the true totals);
+    other threads are untouched.  The third element is the row count of
+    the batch the emitter validated — a STAGED pipeline's later stages
+    see a table already reduced by earlier quarantines, so their offsets
+    are relative to that smaller table, and the consumer needs the row
+    count to tell which coordinate space each emission lives in (see
+    ``serving/batcher.demux``).  Nests (the inner capture wins until it
+    exits).
+
+    Thread-local by design: the transform must run single-batch on the
+    capturing thread (the server caps coalesced rows well below the
+    environment batch size, so the fused prefetch producer never starts).
+    """
+
+    def __init__(self):
+        self.sink: List[Tuple[str, Table, int]] = []
+        self._prev = None
+
+    def __enter__(self) -> List[Tuple[str, Table, int]]:
+        self._prev = getattr(_CAPTURE, "sink", None)
+        _CAPTURE.sink = self.sink
+        return self.sink
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _CAPTURE.sink = self._prev
+        return False
+
 
 def emit(name: str, batch: Table, good_mask: np.ndarray,
          reasons: np.ndarray, row_offset: int = 0) -> int:
@@ -280,6 +321,15 @@ def emit(name: str, batch: Table, good_mask: np.ndarray,
                      list(bad_reasons))
         .with_column(QUARANTINE_ROW_COL, DataTypes.LONG, rows)
     )
+    sink = getattr(_CAPTURE, "sink", None)
+    if sink is not None:
+        # captured (serving demux): the caller owns these rows — they go
+        # back to the requester, not into the process-wide store.  The
+        # emitting batch's row count rides along so the consumer can tell
+        # which (possibly already-reduced) coordinate space the offsets
+        # live in.
+        sink.append((name, side, batch.num_rows()))
+        return n_bad
     with _LOCK:
         stored = _STORED_ROWS.get(name, 0)
         room = max(_cap() - stored, 0)

@@ -1,0 +1,53 @@
+"""Online serving runtime: dynamic micro-batching model server.
+
+The request-level layer over the inference stack (PR 7): a
+:class:`~flink_ml_tpu.serving.server.ModelServer` hosts loaded
+``PipelineModel``s and turns streams of single-row/small-batch requests
+into full fused dispatches —
+
+* **micro-batching** — ``submit`` returns a future; a dispatcher thread
+  coalesces queued requests into one ``transform`` per batch (flush on
+  ``FMT_SERVING_MAX_BATCH`` rows or ``FMT_SERVING_MAX_WAIT_MS``), padded
+  to the shared batch-shape ladder so the compile cache is reused across
+  request sizes, then demultiplexes outputs — and quarantine side-tables
+  — back to callers with request-local row offsets;
+* **admission control** — bounded queue, per-request deadlines,
+  shed-oldest-past-deadline-first, reason-coded
+  :class:`~flink_ml_tpu.serving.errors.ServerOverloadedError` rejection,
+  breaker-open shedding: overload degrades predictably instead of
+  queueing unboundedly;
+* **hot swap** — ``deploy(path, version)`` loads + integrity-verifies +
+  pre-warms off the hot path, then swaps atomically between batches;
+  in-flight requests finish on the old version and a corrupt deploy
+  leaves the old version serving.
+
+Entry points: ``bench_all.py serving`` (the >=3x dynamic-batching gate),
+``python scripts/chaos_smoke.py --serving`` (shed / hot-swap / corrupt-
+deploy legs), ``examples/online_serving.py``.
+"""
+
+from flink_ml_tpu.serving.admission import ServingConfig  # noqa: F401
+from flink_ml_tpu.serving.batcher import (  # noqa: F401
+    ServeRequest,
+    ServeResult,
+)
+from flink_ml_tpu.serving.errors import (  # noqa: F401
+    ServerClosedError,
+    ServerOverloadedError,
+)
+from flink_ml_tpu.serving.server import ModelServer  # noqa: F401
+from flink_ml_tpu.serving.versioning import (  # noqa: F401
+    ModelVersion,
+    VersionManager,
+)
+
+__all__ = [
+    "ModelServer",
+    "ModelVersion",
+    "ServeRequest",
+    "ServeResult",
+    "ServerClosedError",
+    "ServerOverloadedError",
+    "ServingConfig",
+    "VersionManager",
+]

@@ -1,0 +1,148 @@
+"""Admission control and load shedding for the model server.
+
+The policy half of the request path, separated from the queue mechanics
+(``server.py``) so it reads as policy:
+
+* **knobs** — :class:`ServingConfig` resolves the four environment knobs
+  (``FMT_SERVING_MAX_BATCH`` / ``FMT_SERVING_MAX_WAIT_MS`` /
+  ``FMT_SERVING_QUEUE_CAP`` / ``FMT_SERVING_DEADLINE_MS``) with
+  constructor overrides winning;
+* **deadlines** — every request carries an absolute deadline (per-request
+  override, else the config default, else none); a request past its
+  deadline is undeliverable by definition and is shed, never served late;
+* **shedding order** — when the queue is at its row cap, the
+  oldest-past-deadline queued requests are shed FIRST to make room (they
+  were dead weight anyway); only if the queue is still full does the new
+  request get a ``queue_full`` rejection.  Overload therefore degrades in
+  the order an operator wants: expired work first, then new arrivals,
+  while everything already admitted and deliverable keeps its slot.
+
+Every shed lands in ``serving.shed`` plus ``serving.shed.<reason>`` so
+backpressure is visible before it becomes an outage.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import InvalidStateError
+from dataclasses import dataclass
+from typing import Optional
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.serving.errors import ServerOverloadedError
+
+__all__ = ["ServingConfig", "now_s", "overloaded", "shed"]
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Resolved serving knobs (environment defaults, overrides win).
+
+    ``max_batch``   rows per coalesced dispatch (flush trigger 1)
+    ``max_wait_ms`` oldest-request age that forces a flush (trigger 2)
+    ``queue_cap``   max queued rows before admission sheds
+    ``deadline_ms`` default per-request deadline (0 = none)
+    ``shed_on_breaker`` refuse at the door while a circuit breaker is
+                    open instead of queueing onto a dead device
+    """
+
+    max_batch: int = 512
+    max_wait_ms: float = 2.0
+    queue_cap: int = 4096
+    deadline_ms: float = 0.0
+    shed_on_breaker: bool = True
+
+    @classmethod
+    def from_env(
+        cls,
+        max_batch: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        shed_on_breaker: Optional[bool] = None,
+    ) -> "ServingConfig":
+        if shed_on_breaker is None:
+            shed_on_breaker = os.environ.get(
+                "FMT_SERVING_SHED_ON_BREAKER", "1"
+            ).lower() not in ("0", "false", "no", "off")
+        cfg = cls(
+            max_batch=int(
+                max_batch if max_batch is not None
+                else _env_float("FMT_SERVING_MAX_BATCH", 512)
+            ),
+            max_wait_ms=float(
+                max_wait_ms if max_wait_ms is not None
+                else _env_float("FMT_SERVING_MAX_WAIT_MS", 2.0)
+            ),
+            queue_cap=int(
+                queue_cap if queue_cap is not None
+                else _env_float("FMT_SERVING_QUEUE_CAP", 4096)
+            ),
+            deadline_ms=float(
+                deadline_ms if deadline_ms is not None
+                else _env_float("FMT_SERVING_DEADLINE_MS", 0.0)
+            ),
+            shed_on_breaker=bool(shed_on_breaker),
+        )
+        if cfg.max_batch < 1 or cfg.queue_cap < 1:
+            raise ValueError(
+                f"max_batch and queue_cap must be >= 1 "
+                f"(got {cfg.max_batch}, {cfg.queue_cap})"
+            )
+        return cfg
+
+    @property
+    def max_wait_s(self) -> float:
+        return self.max_wait_ms / 1e3
+
+    def deadline_at(self, enqueued_at: float,
+                    deadline_ms: Optional[float]) -> Optional[float]:
+        """Absolute (monotonic) deadline for a request enqueued now:
+        per-request override first, config default second, None for no
+        deadline (0 or negative disables)."""
+        ms = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        if ms <= 0:
+            return None
+        return enqueued_at + ms / 1e3
+
+
+def overloaded(reason: str, detail: str = "") -> ServerOverloadedError:
+    """Count one shed and build its reason-coded error.  EVERY shed —
+    synchronous rejection at submit, queued-future sheds, no-drain
+    shutdown — goes through here or :func:`shed`, so the
+    ``serving.shed.<reason>`` counters can never drift from the errors
+    callers actually see."""
+    obs.counter_add("serving.shed")
+    obs.counter_add(f"serving.shed.{reason}")
+    return ServerOverloadedError(reason, detail)
+
+
+def shed(request, reason: str, detail: str = "") -> None:
+    """Fail one queued request's future with a counted, reason-coded
+    rejection.  A future the caller already cancelled is left alone
+    (``set_exception`` on a cancelled future raises, and a dead
+    dispatcher is the one failure mode a server must never have).
+
+    Callers must NOT hold the server's queue lock: completing a future
+    runs its done-callbacks synchronously, and a callback that touches
+    the server (a shed-retry ``submit``) would re-enter under the lock
+    mid-queue-iteration."""
+    exc = overloaded(reason, detail)
+    try:
+        request.future.set_exception(exc)
+    except InvalidStateError:
+        pass  # caller cancelled while queued: nothing left to deliver
+
+
+def now_s() -> float:
+    """The serving clock (monotonic seconds) — one place to stub in tests."""
+    return time.monotonic()

@@ -1,0 +1,169 @@
+"""Request coalescing and result demultiplexing.
+
+The data-plane half of dynamic micro-batching: many small requests become
+ONE table for the fused plan (``coalesce``), and the plan's outputs — the
+served table plus any quarantine side-tables — route back to the right
+callers with request-local row offsets (``demux``).
+
+Offset contract: the coalesced table concatenates requests in queue
+order, so request ``r`` owns the half-open global row span
+``[lo_r, hi_r)``.  Quarantine emissions during the transform do NOT all
+share one coordinate space: a fused run validates every stage at plan
+entry and stamps run-input offsets for all of them, but a STAGED chain
+(``FMT_FUSE_TRANSFORM=0``, or a split around a kernel-less stage)
+quarantines per stage, and a later stage's offsets are relative to the
+table ALREADY REDUCED by earlier quarantines.  Each captured emission
+therefore carries the row count of the batch its emitter validated
+(``quarantine.capture``), and ``demux`` tracks the space as it walks the
+emissions in order: an emission whose batch row count matches the
+current space maps through it directly (the fused entry-validator case —
+several validators against the same entry table); one whose batch is
+smaller first advances the space by dropping every row already
+quarantined (the staged case).  After the remap every offset is a global
+coalesced index, rewritten to each request's LOCAL row index — a caller
+who sent 3 rows and got ``nan_inf@1`` reads exactly what a solo
+``transform`` of those 3 rows would have said.  Served rows demux by the
+same mask: the output table drops quarantined rows in order, so request
+``r``'s slice is the kept-row prefix sums over its span.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_ml_tpu.serve.quarantine import QUARANTINE_ROW_COL
+from flink_ml_tpu.table.schema import DataTypes
+from flink_ml_tpu.table.table import Table
+
+__all__ = ["ServeRequest", "ServeResult", "coalesce", "demux"]
+
+
+@dataclass
+class ServeRequest:
+    """One caller's rows plus the future that will carry them back."""
+
+    table: Table
+    future: Future
+    enqueued_at: float
+    deadline_at: Optional[float] = None  # absolute monotonic; None = none
+    n_rows: int = field(init=False)
+
+    def __post_init__(self):
+        self.n_rows = self.table.num_rows()
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_at is not None and now > self.deadline_at
+
+
+@dataclass
+class ServeResult:
+    """What a request's future resolves to.
+
+    ``table``      the served output rows (quarantined rows dropped),
+                   bit-identical to a solo ``transform`` of the request;
+    ``quarantine`` per-mapper side-tables for THIS request's bad rows,
+                   ``_quarantine_row`` rewritten to request-local indices;
+    ``version``    the model version that served the batch.
+    """
+
+    table: Table
+    quarantine: Dict[str, Table]
+    version: str
+
+    @property
+    def num_rows(self) -> int:
+        return self.table.num_rows()
+
+    @property
+    def num_quarantined(self) -> int:
+        return sum(t.num_rows() for t in self.quarantine.values())
+
+
+def coalesce(requests: Sequence[ServeRequest]) -> Tuple[Table, List[Tuple[int, int]]]:
+    """One batch table from many requests, plus each request's global row
+    span ``[lo, hi)`` in queue order."""
+    spans: List[Tuple[int, int]] = []
+    offset = 0
+    for r in requests:
+        spans.append((offset, offset + r.n_rows))
+        offset += r.n_rows
+    tables = [r.table for r in requests]
+    return (Table.concat(tables) if len(tables) > 1 else tables[0]), spans
+
+
+def demux(
+    out: Table,
+    captured: Sequence[Tuple[str, Table, int]],
+    spans: Sequence[Tuple[int, int]],
+    version: str,
+) -> List[ServeResult]:
+    """Split a coalesced transform's outputs back per request.
+
+    ``captured`` is the quarantine capture sink from the transform —
+    ``(mapper name, side-table, emitting batch rows)`` triples, walked in
+    emission order with the space-tracking remap documented on the
+    module, so staged and fused emission coordinates both resolve to
+    global coalesced offsets.  Raises ``RuntimeError`` on row
+    misalignment (served + quarantined must account for every input row —
+    a demux that guessed would hand callers other callers' rows).
+    """
+    total = spans[-1][1] if spans else 0
+    kept = np.ones(total, dtype=bool)
+    side_rows: List[Tuple[str, Table, np.ndarray]] = []
+    # the current coordinate space: global index of each row the NEXT
+    # same-sized emission's offsets refer to
+    space = np.arange(total, dtype=np.int64)
+    for name, side, batch_rows in captured:
+        if batch_rows != len(space):
+            # the emitter validated an already-reduced table (a staged
+            # stage downstream of earlier quarantines, or a later fused
+            # run): advance the space past everything quarantined so far
+            space = space[kept[space]]
+            if batch_rows != len(space):
+                raise RuntimeError(
+                    f"quarantine emission for {name!r} validated "
+                    f"{batch_rows} rows but the surviving space holds "
+                    f"{len(space)} — demux cannot attribute its offsets"
+                )
+        rows = np.asarray(side.col(QUARANTINE_ROW_COL), dtype=np.int64)
+        if rows.size and (rows.min() < 0 or rows.max() >= len(space)):
+            raise RuntimeError(
+                f"quarantine offsets for {name!r} fall outside its "
+                f"emission space (rows {rows.min()}..{rows.max()} of "
+                f"{len(space)}) — demux cannot attribute them to a request"
+            )
+        rows = space[rows]  # -> global coalesced offsets
+        kept[rows] = False
+        side_rows.append((name, side, rows))
+    n_kept = int(kept.sum())
+    if out.num_rows() != n_kept:
+        raise RuntimeError(
+            f"served batch returned {out.num_rows()} rows but "
+            f"{n_kept} of {total} coalesced rows survived quarantine — "
+            "output is misaligned with the request spans"
+        )
+    # output position of each kept input row: exclusive prefix sum
+    out_pos = np.cumsum(kept) - kept.astype(np.int64)
+    results: List[ServeResult] = []
+    for lo, hi in spans:
+        span_kept = int(kept[lo:hi].sum())
+        start = int(out_pos[lo]) if hi > lo else 0
+        table = out.slice_rows(start, start + span_kept)
+        quarantine: Dict[str, Table] = {}
+        for name, side, rows in side_rows:
+            mask = (rows >= lo) & (rows < hi)
+            if not mask.any():
+                continue
+            part = side.filter_rows(mask).with_column(
+                QUARANTINE_ROW_COL, DataTypes.LONG, rows[mask] - lo
+            )
+            if name in quarantine:
+                part = Table.concat([quarantine[name], part])
+            quarantine[name] = part
+        results.append(ServeResult(table=table, quarantine=quarantine,
+                                   version=version))
+    return results

@@ -1,0 +1,48 @@
+"""Serving-runtime error vocabulary.
+
+Dependency-free like :mod:`flink_ml_tpu.serve.errors`: these types cross
+thread boundaries inside futures, so they must be importable anywhere
+without dragging the server (or jax) along.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "SHED_BREAKER_OPEN",
+    "SHED_DEADLINE",
+    "SHED_QUEUE_FULL",
+    "SHED_SHUTDOWN",
+    "ServerClosedError",
+    "ServerOverloadedError",
+]
+
+#: reason codes (the shed vocabulary — mirrored in ``serving.shed.<reason>``
+#: counters so dashboards and errors speak the same words)
+SHED_QUEUE_FULL = "queue_full"
+SHED_DEADLINE = "deadline_expired"
+SHED_BREAKER_OPEN = "breaker_open"
+SHED_SHUTDOWN = "shutdown"
+
+
+class ServerOverloadedError(RuntimeError):
+    """A request was shed instead of served, with a reason code.
+
+    Load shedding is the contract, not a failure mode: when the server
+    cannot answer in time it says so immediately — a bounded queue plus a
+    reason-coded rejection degrades predictably where unbounded queueing
+    melts down.  ``reason`` is one of the ``SHED_*`` codes
+    (``queue_full`` / ``deadline_expired`` / ``breaker_open`` /
+    ``shutdown``); the matching ``serving.shed.<reason>`` counter moved by
+    one.
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(
+            f"request shed ({reason})" + (f": {detail}" if detail else "")
+        )
+        self.reason = reason
+
+
+class ServerClosedError(RuntimeError):
+    """submit() on a server that is not running (never started, shutting
+    down, or already shut down)."""

@@ -1,0 +1,578 @@
+"""ModelServer — the dynamic micro-batching request runtime.
+
+Every inference path below this layer is table-at-a-time: PR 6 made one
+fused dispatch per batch nearly optimal, but a production feed is not a
+batch — it is thousands of concurrent single-row requests, each of which
+would pay its own dispatch through ``transform``.  This server is the
+layer that FILLS those fused batches from small requests (the Clipper-
+style adaptive-batching frontend, specialized to our fused plans,
+circuit breakers, and integrity-checked model files):
+
+* ``submit(table)`` returns a ``concurrent.futures.Future`` immediately;
+  requests land in a bounded queue and a dispatcher thread coalesces them
+  into ONE ``PipelineModel.transform`` call — flushed when
+  ``FMT_SERVING_MAX_BATCH`` rows are queued or the oldest request has
+  waited ``FMT_SERVING_MAX_WAIT_MS``, whichever first.  The transform
+  pads to the shared batch-shape ladder
+  (``utils/compile_cache.bucket_batch_rows``), so mixed request sizes
+  reuse a handful of compiled programs instead of compiling per size;
+* outputs — and quarantine side-tables — demultiplex back to each caller
+  with request-local row offsets (``batcher.demux``): a caller's result
+  is bit-identical to a solo ``transform`` of its rows;
+* admission control sheds instead of melting: queue at its row cap ->
+  expired requests shed first, then ``queue_full`` rejection; a request
+  past its deadline is shed, never served late; an OPEN circuit breaker
+  sheds at the door (``breaker_open``) rather than queueing onto a dead
+  device (``serve.open_breaker_names``);
+* ``deploy(path, version)`` hot-swaps the model with zero downtime
+  (``versioning.VersionManager``): integrity-verified load, pre-warm off
+  the hot path, atomic pointer swap — in-flight batches finish on the old
+  version, and a corrupt deploy leaves the old version serving;
+* ``shutdown(drain=True)`` serves everything already queued, then joins
+  the dispatcher; ``drain=False`` fails queued futures with a
+  ``shutdown`` shed code.
+
+Telemetry: ``serving.requests`` / ``request_rows`` / ``batches`` /
+``served_rows`` / ``shed`` (+ per-reason) / ``failed_requests`` /
+``swaps`` / ``deploy_failures`` counters, ``serving.queue_depth`` and
+``serving.batch_occupancy`` gauges, and the ``serving.request_latency_ms``
+histogram (p50/p99 via the registry's timing quantiles) — all landing in
+a ``serving`` RunReport at shutdown.
+
+Knobs (BASELINE.md round-10 table): ``FMT_SERVING_MAX_BATCH``,
+``FMT_SERVING_MAX_WAIT_MS``, ``FMT_SERVING_QUEUE_CAP``,
+``FMT_SERVING_DEADLINE_MS``, ``FMT_SERVING_SHED_ON_BREAKER``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter, deque
+from concurrent.futures import Future
+from typing import Deque, List, Optional
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.serving.admission import (
+    ServingConfig,
+    now_s,
+    overloaded,
+    shed,
+)
+from flink_ml_tpu.serving.batcher import (
+    ServeRequest,
+    ServeResult,
+    coalesce,
+    demux,
+)
+from flink_ml_tpu.serving.errors import (
+    SHED_BREAKER_OPEN,
+    SHED_DEADLINE,
+    SHED_QUEUE_FULL,
+    SHED_SHUTDOWN,
+    ServerClosedError,
+)
+from flink_ml_tpu.serving.versioning import VersionManager
+from flink_ml_tpu.table.table import Table
+
+__all__ = ["ModelServer"]
+
+#: rows retained from the newest coalesced batch as the default warmup
+#: sample for the next deploy (enough to exercise the plan, cheap to hold)
+_WARMUP_SAMPLE_ROWS = 8
+
+
+def _breaker_scope_names(model) -> frozenset:
+    """The breaker names this model's transforms can dispatch through:
+    its stages' serving telemetry keys (mapper ``serve_name`` defaults to
+    the model stage's class name).  Scopes the shed-on-breaker admission
+    check so an unrelated pipeline's open breaker — another server in the
+    same process, a batch job's mapper — never sheds THIS server's
+    traffic.  A custom mapper overriding ``serve_name`` beyond its class
+    name falls outside the scope and simply never sheds at admission
+    (fail-open: the transform path's own breaker/fallback still applies).
+    """
+    stages = getattr(model, "stages", None)
+    if stages is None:
+        stages = [model]
+    return frozenset(type(s).__name__ for s in stages)
+
+
+def _breaker_in_scope(name: str, scope: frozenset) -> bool:
+    """Does an open breaker belong to one of this server's dispatch
+    surfaces?  Per-mapper breakers match by name; per-plan breakers
+    (``FusedPlan[A+B+...]``) match when every fused member is one of the
+    server's stages."""
+    if name in scope:
+        return True
+    if name.startswith("FusedPlan[") and name.endswith("]"):
+        members = name[len("FusedPlan["):-1].split("+")
+        return all(m in scope for m in members)
+    return False
+
+
+class ModelServer:
+    """Request-level model server over a deployed pipeline.
+
+    ``ModelServer(model)`` (or ``ModelServer(path=...)``) deploys version
+    ``v1`` and starts the dispatcher; use as a context manager or call
+    :meth:`shutdown` explicitly.  ``start=False`` builds the server
+    paused — submissions queue (admission rules apply) until
+    :meth:`start`, which tests and pre-loading setups use.
+    """
+
+    def __init__(self, model=None, *, path: Optional[str] = None,
+                 version: str = "v1", warmup: Optional[Table] = None,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 shed_on_breaker: Optional[bool] = None,
+                 start: bool = True):
+        if (model is None) == (path is None):
+            raise ValueError("pass exactly one of model / path")
+        self.config = ServingConfig.from_env(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            queue_cap=queue_cap, deadline_ms=deadline_ms,
+            shed_on_breaker=shed_on_breaker,
+        )
+        # a coalesced dispatch must stay a SINGLE internal transform batch:
+        # past the environment batch size the fused path switches to its
+        # prefetch-producer thread, which the dispatcher's thread-local
+        # quarantine capture cannot see — demux would lose side-tables.
+        # Clamp rather than fail: the operator asked for bigger batches
+        # than the pipeline will form anyway.
+        limit = self._single_batch_rows()
+        if limit and self.config.max_batch > limit:
+            import dataclasses
+            import warnings
+
+            warnings.warn(
+                f"FMT_SERVING_MAX_BATCH={self.config.max_batch} exceeds "
+                f"the environment batch size ({limit}); clamping — a "
+                "coalesced dispatch must stay one internal transform "
+                "batch for quarantine demux to see its side-tables",
+                stacklevel=2,
+            )
+            self.config = dataclasses.replace(self.config, max_batch=limit)
+        self._versions = VersionManager()
+        deployed = self._versions.deploy(
+            model if model is not None else path, version, warmup=warmup
+        )
+        self._breaker_scope = _breaker_scope_names(deployed.model)
+        self._warmup_sample: Optional[Table] = warmup
+        self._cond = threading.Condition()
+        self._queue: Deque[ServeRequest] = deque()
+        self._queued_rows = 0
+        self._stopping = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # per-server accounting: stats()/the shutdown report must describe
+        # THIS server's traffic — the process-global serving.* counters
+        # and latency histogram aggregate across every server (and test)
+        # in the process, so each server tallies its own events alongside
+        self._counts: Counter = Counter()
+        self._counts_lock = threading.Lock()
+        self._latencies: Deque[float] = deque(maxlen=512)
+        # open-breaker admission memo (the scan locks every breaker in
+        # the process): revalidated on any breaker state TRANSITION (the
+        # generation counter — an opening breaker sheds immediately) or
+        # after ~50 ms (a cooldown EXPIRING fires no transition)
+        self._breaker_memo = (float("-inf"), -1, [])
+        if start:
+            self.start()
+
+    def _tally(self, name: str, n: float = 1) -> None:
+        """Per-server tally only — the matching global counter is bumped
+        where the event happens (obs.counter_add here, or the admission
+        shed helpers), so neither side double-counts.  Own lock: submit
+        threads and the dispatcher tally concurrently, and a lost
+        increment would fail the exact-count assertions reports rely on."""
+        with self._counts_lock:
+            self._counts[name] += n
+
+    def _shed(self, request: ServeRequest, reason: str,
+              detail: str = "") -> None:
+        """Shed one queued request: per-server tally + the counted,
+        reason-coded future rejection (admission.shed).  Never call while
+        holding ``self._cond``."""
+        self._tally("serving.shed")
+        self._tally(f"serving.shed.{reason}")
+        shed(request, reason, detail)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelServer":
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("server already shut down")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="fmt-serving-dispatcher",
+                    daemon=True,
+                )
+                self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.shutdown()
+        return False
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the server.  ``drain=True`` serves every queued request
+        first (their futures resolve normally); ``drain=False`` sheds the
+        queue with the ``shutdown`` reason code.  Idempotent."""
+        dropped: List[ServeRequest] = []
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._stopping = True
+            if not drain:
+                dropped = list(self._queue)
+                self._queue.clear()
+                self._queued_rows = 0
+            self._cond.notify_all()
+        for r in dropped:  # complete futures outside the lock
+            self._shed(r, SHED_SHUTDOWN, "server shut down without draining")
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        elif drain:
+            # never started: drain inline on the calling thread so queued
+            # futures still resolve (submit-before-start is supported)
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    break
+                self._serve_batch(batch)
+        self._write_report()
+
+    # -- the request path ----------------------------------------------------
+
+    def submit(self, table: Table,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to a
+        :class:`~flink_ml_tpu.serving.batcher.ServeResult`.
+
+        Raises :class:`ServerClosedError` when the server is shut down and
+        :class:`ServerOverloadedError` (reason-coded) when the request is
+        shed at admission: the queue is at ``queue_cap`` rows even after
+        shedding expired entries, or a circuit breaker is open and
+        ``shed_on_breaker`` is on.
+        """
+        n = table.num_rows()
+        if n == 0:
+            raise ValueError("empty request: submit at least one row")
+        limit = self._single_batch_rows()
+        if limit and n > limit:
+            raise ValueError(
+                f"request of {n} rows exceeds the environment batch size "
+                f"({limit}); a request that large is a table, not a "
+                "request — call transform directly"
+            )
+        # breaker admission reads no queue state: check it OUTSIDE the
+        # condition lock so every submit doesn't serialize a scan of all
+        # breakers against the dispatcher's wakeups.  Only breakers on
+        # THIS server's dispatch surfaces count — another pipeline's dead
+        # device must not shed a healthy server's traffic.
+        if self.config.shed_on_breaker:
+            open_names = self._open_scoped_breakers()
+            if open_names:
+                self._tally("serving.shed")
+                self._tally(f"serving.shed.{SHED_BREAKER_OPEN}")
+                raise overloaded(
+                    SHED_BREAKER_OPEN,
+                    f"circuit breaker open for {open_names[0]!r} — "
+                    "refusing to queue onto a degraded dispatch path",
+                )
+        now = now_s()
+        request = ServeRequest(
+            table=table, future=Future(), enqueued_at=now,
+            deadline_at=self.config.deadline_at(now, deadline_ms),
+        )
+        expired: List[ServeRequest] = []
+        rejected = None
+        try:
+            with self._cond:
+                if self._closed or self._stopping:
+                    raise ServerClosedError("server is shut down")
+                if self._queued_rows + n > self.config.queue_cap:
+                    # make room by shedding what can no longer be served
+                    # in time — oldest first (FIFO order IS age order)
+                    expired = self._collect_expired_locked(now)
+                if self._queued_rows + n > self.config.queue_cap:
+                    rejected = (
+                        f"{self._queued_rows} rows queued against a cap "
+                        f"of {self.config.queue_cap} (request adds {n})"
+                    )
+                else:
+                    self._queue.append(request)
+                    self._queued_rows += n
+                    obs.gauge_set("serving.queue_depth", self._queued_rows)
+                    self._cond.notify()
+        finally:
+            # futures complete OUTSIDE the lock: done-callbacks may touch
+            # the server (shed-retry submits) and must not re-enter
+            for r in expired:
+                self._shed(r, SHED_DEADLINE, "deadline passed while queued")
+        if rejected is not None:
+            self._tally("serving.shed")
+            self._tally(f"serving.shed.{SHED_QUEUE_FULL}")
+            raise overloaded(SHED_QUEUE_FULL, rejected)
+        self._tally("serving.requests")
+        self._tally("serving.request_rows", n)
+        obs.counter_add("serving.requests")
+        obs.counter_add("serving.request_rows", n)
+        return request.future
+
+    def predict(self, table: Table, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> ServeResult:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(table, deadline_ms=deadline_ms).result(timeout)
+
+    def _open_scoped_breakers(self) -> List[str]:
+        """Open breakers on THIS server's dispatch surfaces, memoized:
+        the registry scan locks every breaker in the process, so the
+        admission hot path reuses the last answer until a breaker state
+        TRANSITION bumps the generation counter (a breaker opening sheds
+        the very next submit) or ~50 ms pass (a cooldown expiring fires
+        no transition, so traffic resumes within the window)."""
+        from flink_ml_tpu.serve import open_breaker_names
+        from flink_ml_tpu.serve.breaker import state_generation
+
+        now = now_s()
+        gen = state_generation()
+        stamp, memo_gen, names = self._breaker_memo
+        if gen == memo_gen and now - stamp < 0.05:
+            return names
+        names = [
+            b for b in open_breaker_names()
+            if _breaker_in_scope(b, self._breaker_scope)
+        ]
+        self._breaker_memo = (now, gen, names)
+        return names
+
+    # -- hot swap ------------------------------------------------------------
+
+    def deploy(self, model_or_path, version: str,
+               warmup: Optional[Table] = None):
+        """Hot-swap to a new model version with zero downtime.
+
+        Runs on the CALLING thread: load + integrity verification + plan
+        pre-warm happen while the dispatcher keeps serving the old
+        version; only the final pointer swap is shared state.  ``warmup``
+        defaults to a sample retained from live traffic (the last batch's
+        head) so mid-traffic deploys warm the exact request schema.
+        Raises on a failed deploy (corrupt artifact, broken transform) —
+        the old version never stops serving.
+        """
+        if warmup is None:
+            warmup = self._warmup_sample
+        try:
+            deployed = self._versions.deploy(model_or_path, version,
+                                             warmup=warmup)
+        except BaseException:
+            self._tally("serving.deploy_failures")
+            raise
+        self._tally("serving.swaps")
+        self._breaker_scope = _breaker_scope_names(deployed.model)
+        return deployed
+
+    @property
+    def active_version(self) -> Optional[str]:
+        return self._versions.active_version
+
+    @property
+    def versions(self) -> List[str]:
+        return self._versions.history
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._serve_batch(batch)
+
+    def _next_batch(self) -> Optional[List[ServeRequest]]:
+        """Block until a flush condition holds, then take one batch.
+
+        Flush when: queued rows >= ``max_batch``; OR the oldest request
+        has waited ``max_wait_ms``; OR the server is draining.  Expired
+        requests shed here too — a request that died waiting must not
+        consume device time.  Their futures complete OUTSIDE the lock
+        (the ``try``'s ``finally`` runs after the ``with`` releases it):
+        a caller's done-callback may touch the server and must not
+        re-enter under the lock mid-queue-iteration."""
+        cfg = self.config
+        while True:
+            expired: List[ServeRequest] = []
+            try:
+                with self._cond:
+                    while True:
+                        now = now_s()
+                        expired.extend(self._collect_expired_locked(now))
+                        if self._queue:
+                            flush_at = (
+                                self._queue[0].enqueued_at + cfg.max_wait_s
+                            )
+                            if (
+                                self._queued_rows >= cfg.max_batch
+                                or now >= flush_at
+                                or self._stopping
+                            ):
+                                return self._take_locked()
+                            if expired:
+                                break  # shed first, then come back
+                            self._cond.wait(timeout=flush_at - now)
+                        elif self._stopping:
+                            return None
+                        else:
+                            if expired:
+                                break
+                            self._cond.wait()
+            finally:
+                for r in expired:
+                    self._shed(r, SHED_DEADLINE,
+                               "deadline passed while waiting in queue")
+
+    def _take_locked(self) -> List[ServeRequest]:
+        """Pop whole requests up to ``max_batch`` rows (an oversized
+        request serves alone; a schema change cuts the batch so coalesce
+        never mixes schemas).  Each taken request transitions its future
+        to RUNNING — a request whose caller cancelled it while queued is
+        dropped here, and a RUNNING future can no longer be cancelled, so
+        result delivery cannot race a cancellation."""
+        taken: List[ServeRequest] = []
+        rows = 0
+        dropped = 0
+        schema = None
+        while self._queue:
+            r = self._queue[0]
+            if taken and (
+                rows + r.n_rows > self.config.max_batch
+                or r.table.schema != schema
+            ):
+                break
+            self._queue.popleft()
+            if not r.future.set_running_or_notify_cancel():
+                dropped += r.n_rows  # cancelled while queued
+                continue
+            schema = r.table.schema
+            taken.append(r)
+            rows += r.n_rows
+        self._queued_rows -= rows + dropped
+        obs.gauge_set("serving.queue_depth", self._queued_rows)
+        if dropped:
+            self._tally("serving.cancelled_rows", dropped)
+            obs.counter_add("serving.cancelled_rows", dropped)
+        return taken
+
+    def _collect_expired_locked(self, now: float) -> List[ServeRequest]:
+        """Remove every expired request from the queue and return them
+        for the CALLER to shed once the lock is released (completing a
+        future under the lock would run caller callbacks re-entrantly)."""
+        if not any(r.expired(now) for r in self._queue):
+            return []
+        expired: List[ServeRequest] = []
+        kept: Deque[ServeRequest] = deque()
+        for r in self._queue:
+            if r.expired(now):
+                self._queued_rows -= r.n_rows
+                expired.append(r)
+            else:
+                kept.append(r)
+        self._queue = kept
+        obs.gauge_set("serving.queue_depth", self._queued_rows)
+        return expired
+
+    def _serve_batch(self, requests: List[ServeRequest]) -> None:
+        """One coalesced dispatch: snapshot the active version, transform
+        under quarantine capture, demux, resolve futures."""
+        from flink_ml_tpu.serve import quarantine
+
+        if not requests:
+            return  # every taken request was cancelled while queued
+        version = self._versions.active()  # in-flight pins the old version
+        table, spans = coalesce(requests)
+        n_rows = table.num_rows()
+        try:
+            with obs.phase("serving.batch"):
+                with quarantine.capture() as captured:
+                    out = version.transform(table)
+            results = demux(out, captured, spans, version.version)
+        except BaseException as exc:  # noqa: BLE001 - futures carry it
+            self._tally("serving.failed_batches")
+            self._tally("serving.failed_requests", len(requests))
+            obs.counter_add("serving.failed_batches")
+            obs.counter_add("serving.failed_requests", len(requests))
+            for r in requests:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            return
+        now = now_s()
+        for r, res in zip(requests, results):
+            r.future.set_result(res)
+            latency_ms = (now - r.enqueued_at) * 1e3
+            self._latencies.append(latency_ms)
+            obs.observe("serving.request_latency_ms", latency_ms)
+        self._tally("serving.batches")
+        self._tally("serving.served_rows", n_rows)
+        self._tally("serving.coalesced_requests", len(requests))
+        obs.counter_add("serving.batches")
+        obs.counter_add("serving.served_rows", n_rows)
+        obs.counter_add("serving.coalesced_requests", len(requests))
+        obs.gauge_set("serving.batch_occupancy",
+                      min(n_rows / self.config.max_batch, 1.0))
+        # retain a live-schema head as the default warmup for hot swaps
+        self._warmup_sample = table.slice_rows(
+            0, min(n_rows, _WARMUP_SAMPLE_ROWS)
+        )
+
+    # -- accounting ----------------------------------------------------------
+
+    @staticmethod
+    def _single_batch_rows() -> int:
+        """The environment's internal transform batch size — the row bound
+        under which a coalesced dispatch is guaranteed to run as ONE batch
+        on the dispatcher thread (0 = unbounded)."""
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        return int(
+            MLEnvironmentFactory.get_default().default_batch_size or 0
+        )
+
+    def stats(self) -> dict:
+        """THIS server's own tallies (requests, batches, shed per reason,
+        swaps, ...) plus latency quantiles over its own requests — the
+        shutdown report's payload, readable live.  Per-server by
+        construction: the process-global ``serving.*`` counters and the
+        ``serving.request_latency_ms`` histogram aggregate across every
+        server in the process, so reports read the local ledger instead."""
+        from flink_ml_tpu.obs.registry import sample_quantile
+
+        delta = {k: v for k, v in sorted(self._counts.items()) if v}
+        samples = sorted(self._latencies)
+        if samples:
+            delta["latency_p50_ms"] = round(
+                sample_quantile(samples, 0.50), 3)
+            delta["latency_p99_ms"] = round(
+                sample_quantile(samples, 0.99), 3)
+            delta["latency_mean_ms"] = round(
+                sum(samples) / len(samples), 3)
+        delta["active_version"] = self.active_version
+        return delta
+
+    def _write_report(self) -> None:
+        if not obs.enabled():
+            return
+        from flink_ml_tpu.obs.report import serving_report
+
+        serving_report("ModelServer", extra=self.stats())

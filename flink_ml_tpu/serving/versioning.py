@@ -1,0 +1,138 @@
+"""Zero-downtime model versioning: load -> verify -> pre-warm -> swap.
+
+A serving process must be able to take a new model without dropping a
+request.  The sequence here makes a deploy boring:
+
+1. **load + verify** — a path deploy goes through the standard loaders
+   (``PipelineModel.load`` / ``load_stage``), which verify the
+   length+CRC32 commit sidecars and parse-level checks from
+   ``serve/integrity``: a truncated or bit-rotted artifact raises
+   :class:`~flink_ml_tpu.serve.errors.ModelIntegrityError` here, on the
+   deploy thread, never as garbage predictions on the hot path;
+2. **pre-warm** — the new version transforms a small warmup batch OFF the
+   hot path, so its mappers load model data onto the device and its fused
+   plan compiles at a ladder bucket before any caller's rows touch it
+   (the shared bucket ladder means the warmed program is the same one
+   live batches will hit);
+3. **atomic swap** — the active-version pointer flips under a lock; the
+   dispatcher snapshots it once per batch, so in-flight batches finish on
+   the version they started with and the next batch serves the new one.
+
+A deploy that fails at ANY step (integrity, warmup compile, a broken
+transform) leaves the previous version serving, counted in
+``serving.deploy_failures``; a successful swap counts in
+``serving.swaps``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.table.table import Table
+
+__all__ = ["ModelVersion", "VersionManager"]
+
+
+class ModelVersion:
+    """One deployed model: the stage (anything with ``transform``), its
+    version label, and where it came from."""
+
+    def __init__(self, version: str, model, source_path: Optional[str] = None):
+        self.version = str(version)
+        self.model = model
+        self.source_path = source_path
+        self.deployed_at = time.time()
+
+    def transform(self, table: Table) -> Table:
+        out = self.model.transform(table)
+        # Stage.transform returns a tuple of tables; serving is 1-in/1-out
+        (result,) = out if isinstance(out, tuple) else (out,)
+        return result
+
+
+def _load_model(path: str):
+    """Load a saved pipeline (or a single saved stage) with integrity
+    verification — the standard loaders already check commit sidecars."""
+    from flink_ml_tpu.api.core import load_stage
+    from flink_ml_tpu.api.pipeline import PipelineModel
+
+    if os.path.exists(os.path.join(path, "pipeline.json")):
+        return PipelineModel.load(path)
+    return load_stage(path)
+
+
+class VersionManager:
+    """The server's model registry: one active version, swap under lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: Optional[ModelVersion] = None
+        self._history: List[str] = []  # version labels in deploy order
+
+    def active(self) -> ModelVersion:
+        with self._lock:
+            if self._active is None:
+                raise RuntimeError("no model deployed")
+            return self._active
+
+    @property
+    def active_version(self) -> Optional[str]:
+        with self._lock:
+            return self._active.version if self._active else None
+
+    @property
+    def history(self) -> List[str]:
+        with self._lock:
+            return list(self._history)
+
+    def deploy(self, model_or_path, version: str,
+               warmup: Optional[Table] = None) -> ModelVersion:
+        """Load, verify, pre-warm, and atomically activate a version.
+
+        ``model_or_path`` is a directory produced by ``save`` (integrity-
+        verified at load) or an already-constructed model object.
+        ``warmup`` is a small input-schema batch transformed BEFORE the
+        swap so compiles and device model loads happen off the hot path;
+        without one the first live batch pays them (logged as a counter,
+        not an error).  Any failure leaves the previous version active.
+        """
+        try:
+            model = (
+                _load_model(model_or_path)
+                if isinstance(model_or_path, (str, os.PathLike))
+                else model_or_path
+            )
+            source = (
+                str(model_or_path)
+                if isinstance(model_or_path, (str, os.PathLike)) else None
+            )
+            candidate = ModelVersion(version, model, source)
+            if warmup is not None and warmup.num_rows() > 0:
+                with obs.phase("serving.warmup"):
+                    candidate.transform(warmup)
+            else:
+                obs.counter_add("serving.cold_deploys")
+        except BaseException:
+            # the old version never stopped serving; the operator gets the
+            # loader's diagnostic (ModelIntegrityError names the artifact)
+            obs.counter_add("serving.deploy_failures")
+            raise
+        with self._lock:
+            swapped = self._active is not None
+            self._active = candidate
+            self._history.append(candidate.version)
+        if swapped:
+            obs.counter_add("serving.swaps")
+        obs.gauge_set("serving.versions_deployed", len(self.history))
+        return candidate
+
+    def snapshot(self) -> Dict[str, Optional[str]]:
+        with self._lock:
+            return {
+                "active": self._active.version if self._active else None,
+                "history": list(self._history),
+            }

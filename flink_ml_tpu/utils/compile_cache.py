@@ -33,10 +33,80 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import warnings
 from pathlib import Path
 
 _enabled_dir: str | None = None
+
+
+# -- batch-shape bucketing ----------------------------------------------------
+#
+# The persistent cache above replays compiled executables across PROCESSES;
+# the ladder below bounds how many executables exist WITHIN a process when
+# batch sizes vary.  Inference pads every batch's row count up to a bucket
+# before dispatch, so the jit cache keys on a small fixed set of shapes
+# instead of one shape per unique request size.  One ladder is shared by
+# the staged mapper applies, the fused pipeline plans, and the serving
+# runtime's coalesced micro-batches (``flink_ml_tpu/serving/``) — a row
+# count the server has already warmed can never recompile when the same
+# count arrives through a plain ``transform``.
+#
+# The rungs start at 1 (a single-row serving request pads to 1 row, not to
+# a 256-row training-shaped bucket) and double past the top so arbitrarily
+# large batches stay power-of-two bounded.  256 is a rung on purpose: the
+# pre-ladder rule padded every <=256-row batch to 256, so keeping it makes
+# the ladder exactly the old rule for n > 128 (no padded-compute
+# regression on existing batch sizes) and strictly cheaper below.
+
+#: the fixed bucket rungs; sizes beyond the top double from 512
+BATCH_BUCKET_LADDER = (1, 8, 32, 128, 256, 512)
+
+_BUCKETS_SEEN: set = set()
+_BUCKETS_LOCK = threading.Lock()
+
+
+def bucket_batch_rows(n: int, row_multiple: int = 1) -> int:
+    """The padded row count for an ``n``-row batch: the smallest ladder
+    bucket >= n (doubling past the top rung), rounded up to
+    ``row_multiple`` (the data-axis size for mesh-sharded applies).
+
+    First use of a (bucket, row_multiple) shape in the process bumps the
+    ``compile_cache.bucket_new`` counter (the compile-bearing event —
+    a fresh padded shape means a fresh XLA program for whatever function
+    consumes it); repeats bump ``compile_cache.bucket_reuse``.  Across any
+    mix of request sizes, ``bucket_new`` is bounded by the ladder length
+    plus the doublings the largest batch needed — the recompile-flatness
+    contract the serving bench asserts.
+    """
+    n = max(int(n), 1)
+    b = 0
+    for rung in BATCH_BUCKET_LADDER:
+        if rung >= n:
+            b = rung
+            break
+    if not b:
+        b = BATCH_BUCKET_LADDER[-1]
+        while b < n:
+            b *= 2
+    if row_multiple > 1:
+        b = -(-b // row_multiple) * row_multiple
+    with _BUCKETS_LOCK:
+        new = (b, row_multiple) not in _BUCKETS_SEEN
+        if new:
+            _BUCKETS_SEEN.add((b, row_multiple))
+    from flink_ml_tpu import obs
+
+    obs.counter_add(
+        "compile_cache.bucket_new" if new else "compile_cache.bucket_reuse"
+    )
+    return b
+
+
+def reset_bucket_stats() -> None:
+    """Forget which buckets this process has seen (tests)."""
+    with _BUCKETS_LOCK:
+        _BUCKETS_SEEN.clear()
 
 
 def enable_compilation_cache(directory: str | None = None, *,

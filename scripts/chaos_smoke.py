@@ -37,6 +37,26 @@ For each estimator family (GLM, KMeans, Knn, StandardScaler):
 plus the RunReport accounting: transform reports carry the serve deltas
 and ``serve_degraded_runs`` flags the fallback-only transforms (the
 ``obs --check`` SERVE-DEGRADED line).
+
+**Serving-runtime mode** (``--serving``, ISSUE 7): the request-path
+counterpart, against the dynamic micro-batching ``ModelServer``:
+
+  1. **shed under overload** — a paused server with a tiny queue cap must
+     reject past-cap submissions with reason-coded
+     ``ServerOverloadedError`` (expired-oldest shed first, then
+     ``queue_full``), then serve every ADMITTED request correctly once it
+     drains — overload loses the rejected requests and nothing else;
+  2. **hot swap under load** — a mid-traffic ``deploy`` of a new version
+     must serve ZERO failed requests; results span both versions and
+     every row matches its version's solo transform;
+  3. **corrupt deploy rollback** — deploying a bit-flipped model artifact
+     raises ``ModelIntegrityError`` and the previous version keeps
+     serving;
+  4. **breaker-open shed** — an open circuit breaker sheds at admission
+     (``breaker_open``) instead of queueing onto a dead device;
+
+plus the ``serving`` RunReport from shutdown carrying the shed/swap
+counters and the request-latency p50/p99.
 """
 
 import json
@@ -409,12 +429,177 @@ def serve_main() -> int:
     return 0
 
 
+def serving_main() -> int:
+    """The serving-runtime chaos matrix (``--serving``)."""
+    import threading
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_serving_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    import numpy as np
+
+    from flink_ml_tpu import obs, serve
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.serve import ModelIntegrityError
+    from flink_ml_tpu.serving import ModelServer, ServerOverloadedError
+
+    table = dense_table()
+
+    def fit(max_iter):
+        return Pipeline([
+            StandardScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(0.5).set_max_iter(max_iter),
+        ]).fit(table)
+
+    m1, m2 = fit(3), fit(5)
+    solo = {}
+    for version, model in (("v1", m1), ("v2", m2)):
+        (out,) = model.transform(table)
+        solo[version] = np.asarray(out.col("p"))
+
+    # -- leg 1: shed under overload ------------------------------------------
+    # a paused server IS an overloaded server: the dispatcher cannot keep
+    # up, the queue hits its row cap, and admission must shed predictably
+    server = ModelServer(m1, version="v1", queue_cap=40, max_batch=16,
+                         max_wait_ms=1, start=False)
+    admitted = [server.submit(table.slice_rows(i * 8, (i + 1) * 8))
+                for i in range(4)]  # 32 of the 40-row cap
+    doomed = server.submit(table.slice_rows(32, 40), deadline_ms=1)  # 40/40
+    shed_kinds = set()
+    try:
+        server.submit(table.slice_rows(40, 56))  # cap + nothing expired yet
+        raise AssertionError("past-cap submit was admitted")
+    except ServerOverloadedError as exc:
+        shed_kinds.add(exc.reason)
+    time.sleep(0.01)  # the deadline_ms=1 request expires in the queue
+    late = server.submit(table.slice_rows(40, 48))  # expired-oldest shed
+    try:
+        doomed.result(1)
+        raise AssertionError("expired request was served")
+    except ServerOverloadedError as exc:
+        shed_kinds.add(exc.reason)
+    assert shed_kinds == {"queue_full", "deadline_expired"}, shed_kinds
+    server.start()  # overload clears: every admitted request serves right
+    for i, fut in enumerate(admitted):
+        got = np.asarray(fut.result(60).table.col("p"))
+        np.testing.assert_array_equal(got, solo["v1"][i * 8:(i + 1) * 8])
+    np.testing.assert_array_equal(
+        np.asarray(late.result(60).table.col("p")), solo["v1"][40:48])
+    server.shutdown()
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("serving.shed.queue_full", 0) >= 1, c
+    assert c.get("serving.shed.deadline_expired", 0) >= 1, c
+    print(f"  overload: reason-coded shed {sorted(shed_kinds)}, admitted "
+          "requests exact")
+
+    # -- leg 2: hot swap under sustained load --------------------------------
+    obs.reset()
+    server = ModelServer(m1, version="v1", max_batch=64, max_wait_ms=1)
+    results, failures = [], []
+    n_req, swap_at = 60, 30
+    swap_done = threading.Event()
+
+    def traffic():
+        for i in range(n_req):
+            lo = (i * 4) % (N - 4)
+            try:
+                res = server.predict(table.slice_rows(lo, lo + 4),
+                                     timeout=60)
+                results.append((lo, res))
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                failures.append(exc)
+            if i == swap_at:
+                swap_done.wait(30)
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    while len(results) < swap_at:
+        time.sleep(0.002)
+    server.deploy(m2, "v2")  # mid-traffic, warmed from the live sample
+    swap_done.set()
+    t.join(120)
+    server.shutdown()
+    assert not failures, f"hot swap failed {len(failures)} requests: " \
+                         f"{failures[0]!r}"
+    versions = {res.version for _lo, res in results}
+    assert versions == {"v1", "v2"}, versions
+    for lo, res in results:
+        np.testing.assert_array_equal(
+            np.asarray(res.table.col("p")),
+            solo[res.version][lo:lo + 4],
+            err_msg=f"rows {lo}..{lo + 4} diverge from solo {res.version}",
+        )
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("serving.swaps", 0) == 1, c
+    print(f"  hot swap: {len(results)} requests across {sorted(versions)}, "
+          "zero failures, per-version parity exact")
+
+    # -- leg 3: corrupt deploy -> rollback ------------------------------------
+    server = ModelServer(m1, version="v1", max_wait_ms=1,
+                         warmup=table.slice_rows(0, 4))
+    bad_dir = os.path.join(tempfile.mkdtemp(prefix="chaos_serving_m_"), "v2")
+    m2.save(bad_dir)
+    mdf = os.path.join(bad_dir, "stage_001", "model_data.jsonl")
+    blob = bytearray(open(mdf, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(mdf, "wb") as f:
+        f.write(bytes(blob))
+    try:
+        server.deploy(bad_dir, "v2")
+        raise AssertionError("corrupt deploy was accepted")
+    except ModelIntegrityError:
+        pass
+    assert server.active_version == "v1"
+    res = server.predict(table.slice_rows(0, 8), timeout=60)
+    assert res.version == "v1"
+    np.testing.assert_array_equal(np.asarray(res.table.col("p")),
+                                  solo["v1"][:8])
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("serving.deploy_failures", 0) >= 1, c
+    print("  corrupt deploy: ModelIntegrityError raised, v1 kept serving")
+
+    # -- leg 4: breaker open -> shed at admission -----------------------------
+    serve.reset_breakers()
+    os.environ["FMT_SERVE_BREAKER_THRESHOLD"] = "1"
+    serve.breaker("LogisticRegressionModel").record_failure()
+    try:
+        server.submit(table.slice_rows(0, 4))
+        raise AssertionError("submit queued onto an open breaker")
+    except ServerOverloadedError as exc:
+        assert exc.reason == "breaker_open", exc.reason
+    finally:
+        serve.reset_breakers()
+        os.environ.pop("FMT_SERVE_BREAKER_THRESHOLD", None)
+    server.shutdown()
+    print("  breaker open: shed at admission (breaker_open), no queueing")
+
+    # -- the serving RunReport from shutdown ----------------------------------
+    from flink_ml_tpu.obs.report import load_reports
+
+    serving_reports = [r for r in load_reports(reports_dir)
+                       if r.get("kind") == "serving"]
+    assert serving_reports, "no serving RunReport written at shutdown"
+    last = serving_reports[-2]["extra"]  # the hot-swap server's report
+    assert last.get("serving.swaps") == 1, last
+    assert last.get("latency_p99_ms", 0) > 0, last
+    print(f"  RunReports: {len(serving_reports)} serving report(s), "
+          f"swap + p99 recorded")
+    print("serving chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
         return 0
     if "--serve" in sys.argv:
         return serve_main()
+    if "--serving" in sys.argv:
+        return serving_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -56,6 +56,22 @@ class TestIncrementalLearningExample:
         assert m and float(m.group(1)) > 0.9
 
 
+class TestOnlineServingExample:
+    def test_concurrent_traffic_with_hot_swap(self):
+        from examples import online_serving
+
+        out = run_main(online_serving, ["--requests", "40", "--threads", "4"])
+        m = re.search(r"served (\d+) requests \((\d+) rows\)", out)
+        assert m and int(m.group(1)) == 40, out[:400]
+        m = re.search(r"versions served: \['v1', 'v2'\]; failed requests: (\d+)", out)
+        assert m, out
+        assert int(m.group(1)) == 0  # hot swap drops nothing
+        m = re.search(r"into (\d+) dispatch batches \(swaps: 1\)", out)
+        assert m, out
+        assert int(m.group(1)) < 40  # genuinely coalesced
+        assert re.search(r"p99 [\d.]+ ms", out)
+
+
 class TestOutOfCoreExample:
     def test_streams_part_files_and_recovers_direction(self):
         from examples import out_of_core_training

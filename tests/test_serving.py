@@ -1,0 +1,843 @@
+"""Online serving runtime (flink_ml_tpu/serving/) — dynamic micro-batching,
+admission control, demux, hot swap — plus the PR's satellites (breaker
+probe concurrency, registry thread-safety, the shared batch-shape ladder).
+
+The serving contract under test: a request served through the
+micro-batching server is BIT-IDENTICAL to a solo ``transform`` of the
+same rows — coalescing, ladder padding, and demux are invisible to the
+caller — while overload degrades by reason-coded shedding instead of
+unbounded queueing, and a hot swap or corrupt deploy never fails a
+request.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import obs, serve
+from flink_ml_tpu.api.pipeline import Pipeline
+from flink_ml_tpu.lib import LogisticRegression
+from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+from flink_ml_tpu.serve import quarantine
+from flink_ml_tpu.serving import (
+    ModelServer,
+    ServerClosedError,
+    ServerOverloadedError,
+    ServingConfig,
+)
+from flink_ml_tpu.serving.batcher import ServeRequest, coalesce, demux
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import compile_cache
+
+N, D = 256, 5
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+WAIT = 30  # generous future timeout: a hang fails loudly, not flakily
+
+
+@pytest.fixture(scope="module")
+def dense_table():
+    rng = np.random.RandomState(7)
+    X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+    w = rng.randn(D).astype(np.float32)
+    y = ((X - 1.0) @ w > 0).astype(np.float64)
+    return Table.from_columns(SCHEMA, {"features": X, "label": y})
+
+
+@pytest.fixture(scope="module")
+def model(dense_table):
+    return Pipeline([
+        StandardScaler().set_selected_col("features"),
+        MinMaxScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_prediction_detail_col("proba").set_max_iter(3)
+        .set_learning_rate(0.5),
+    ]).fit(dense_table)
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+def _requests(table, sizes, start=0):
+    """Consecutive row slices of the given sizes."""
+    out, lo = [], start
+    for s in sizes:
+        out.append(table.slice_rows(lo, lo + s))
+        lo += s
+    return out
+
+
+def _assert_rows_equal(a: Table, b: Table, cols=("pred", "label")):
+    for col in cols:
+        np.testing.assert_array_equal(
+            np.asarray(a.col(col), dtype=np.float64),
+            np.asarray(b.col(col), dtype=np.float64), err_msg=col,
+        )
+
+
+# -- the shared batch-shape ladder (satellite) --------------------------------
+
+
+class TestBucketLadder:
+    def test_ladder_rungs(self):
+        rows = [1, 2, 8, 9, 32, 33, 128, 129, 256, 257, 512, 513, 3000]
+        got = [compile_cache.bucket_batch_rows(n) for n in rows]
+        assert got == [1, 8, 8, 32, 32, 128, 128, 256, 256, 512, 512,
+                       1024, 4096]
+
+    def test_ladder_never_pads_wider_than_the_old_rule(self):
+        """No padded-compute regression vs the pre-ladder power-of-two
+        rule (min 256): the ladder must never choose a LARGER bucket."""
+        from flink_ml_tpu.lib.common import bucket_rows
+
+        for n in range(1, 2049):
+            assert compile_cache.bucket_batch_rows(n) <= bucket_rows(n), n
+
+    def test_row_multiple_rounding(self):
+        assert compile_cache.bucket_batch_rows(1, row_multiple=8) == 8
+        assert compile_cache.bucket_batch_rows(128, row_multiple=8) == 128
+        assert compile_cache.bucket_batch_rows(130, row_multiple=8) == 256
+        assert compile_cache.bucket_batch_rows(5, row_multiple=3) == 9
+
+    def test_bucket_counter_flat_across_100_mixed_sizes(self, obs_on):
+        """100 requests of mixed sizes land on <= len(ladder) fresh shapes
+        — the recompile-flatness contract dynamic batching relies on."""
+        compile_cache.reset_bucket_stats()
+        rng = np.random.RandomState(3)
+        for n in rng.randint(1, 513, size=100):
+            compile_cache.bucket_batch_rows(int(n))
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("compile_cache.bucket_new", 0) <= len(
+            compile_cache.BATCH_BUCKET_LADDER
+        )
+        assert (
+            c.get("compile_cache.bucket_new", 0)
+            + c.get("compile_cache.bucket_reuse", 0)
+        ) == 100
+
+    def test_staged_transform_shares_the_ladder(self, obs_on, model,
+                                                dense_table, monkeypatch):
+        """A staged (unfused) transform pads through the same ladder as
+        serving: transforming a 3-row slice must log ladder activity."""
+        monkeypatch.setenv("FMT_FUSE_TRANSFORM", "0")
+        compile_cache.reset_bucket_stats()
+        model.transform(dense_table.slice_rows(0, 3))
+        c = obs.registry().snapshot()["counters"]
+        assert (
+            c.get("compile_cache.bucket_new", 0)
+            + c.get("compile_cache.bucket_reuse", 0)
+        ) >= 1
+
+    def test_bucket_padding_parity(self, model, dense_table, monkeypatch):
+        """Different request sizes hit different buckets; every row's
+        prediction is bit-identical to the whole-table transform's."""
+        monkeypatch.setenv("FMT_FUSE_TRANSFORM", "1")
+        (whole,) = model.transform(dense_table)
+        for lo, hi in ((0, 3), (10, 210)):
+            (part,) = model.transform(dense_table.slice_rows(lo, hi))
+            np.testing.assert_array_equal(
+                np.asarray(part.col("pred")),
+                np.asarray(whole.col("pred"))[lo:hi],
+            )
+
+
+# -- batcher: coalesce + demux ------------------------------------------------
+
+
+def _req(table):
+    from concurrent.futures import Future
+
+    return ServeRequest(table=table, future=Future(), enqueued_at=0.0)
+
+
+class TestBatcher:
+    def test_coalesce_spans(self, dense_table):
+        reqs = [_req(t) for t in _requests(dense_table, [3, 5, 2])]
+        batch, spans = coalesce(reqs)
+        assert batch.num_rows() == 10
+        assert spans == [(0, 3), (3, 8), (8, 10)]
+
+    def test_demux_splits_rows_per_request(self, dense_table):
+        reqs = [_req(t) for t in _requests(dense_table, [4, 6])]
+        batch, spans = coalesce(reqs)
+        results = demux(batch, [], spans, "v1")
+        assert [r.num_rows for r in results] == [4, 6]
+        _assert_rows_equal(results[1].table, reqs[1].table, cols=("label",))
+
+    def test_demux_quarantine_offsets_become_request_local(self,
+                                                           dense_table):
+        spans = [(0, 3), (3, 6)]
+        batch = dense_table.slice_rows(0, 6)
+        # mapper flagged global rows 1 and 4 (request A row 1, B row 1)
+        side = batch.take_rows([1, 4]).with_column(
+            quarantine.QUARANTINE_REASON_COL, DataTypes.STRING,
+            ["nan_inf", "nan_inf"],
+        ).with_column(
+            quarantine.QUARANTINE_ROW_COL, DataTypes.LONG, [1, 4],
+        )
+        out = batch.take_rows([0, 2, 3, 5])  # survivors in order
+        results = demux(out, [("M", side, 6)], spans, "v1")
+        for res in results:
+            assert res.num_rows == 2
+            q = res.quarantine["M"]
+            assert [int(r) for r in q.col(quarantine.QUARANTINE_ROW_COL)] \
+                == [1]
+        _assert_rows_equal(results[1].table, batch.take_rows([3, 5]),
+                           cols=("label",))
+
+    def test_demux_misalignment_raises(self, dense_table):
+        reqs = [_req(t) for t in _requests(dense_table, [4])]
+        batch, spans = coalesce(reqs)
+        short = batch.slice_rows(0, 3)  # one row vanished, no quarantine
+        with pytest.raises(RuntimeError, match="misaligned"):
+            demux(short, [], spans, "v1")
+
+    def test_demux_remaps_staged_reduced_space_emissions(self,
+                                                        dense_table):
+        """A staged chain's stage 2 validates a table ALREADY reduced by
+        stage 1's quarantine, so its offsets are local to that smaller
+        table: stage 1 flags global row 2, stage 2 flags its local row 5
+        — which is global row 6.  The space-tracking remap must attribute
+        both correctly instead of marking global row 5 dead."""
+        spans = [(0, 5), (5, 10)]
+        batch = dense_table.slice_rows(0, 10)
+
+        def side_of(src, rows_local, n_emit):
+            return src.take_rows(rows_local).with_column(
+                quarantine.QUARANTINE_REASON_COL, DataTypes.STRING,
+                ["nan_inf"] * len(rows_local),
+            ).with_column(
+                quarantine.QUARANTINE_ROW_COL, DataTypes.LONG, rows_local,
+            ), n_emit
+
+        s1, b1 = side_of(batch, [2], 10)          # stage 1: global coords
+        reduced = batch.take_rows([0, 1, 3, 4, 5, 6, 7, 8, 9])
+        s2, b2 = side_of(reduced, [5], 9)         # stage 2: reduced coords
+        out = batch.take_rows([0, 1, 3, 4, 5, 7, 8, 9])  # minus 2 and 6
+        results = demux(out, [("S1", s1, b1), ("S2", s2, b2)], spans, "v1")
+        a, b = results
+        assert a.num_rows == 4 and b.num_rows == 4
+        assert [int(r) for r in
+                a.quarantine["S1"].col(quarantine.QUARANTINE_ROW_COL)] == [2]
+        # stage 2's flag lands on request B's local row 1 (global 6)
+        assert [int(r) for r in
+                b.quarantine["S2"].col(quarantine.QUARANTINE_ROW_COL)] == [1]
+        _assert_rows_equal(b.table, batch.take_rows([5, 7, 8, 9]),
+                           cols=("label",))
+
+    def test_staged_transform_quarantine_demux_end_to_end(self, obs_on,
+                                                          monkeypatch):
+        """The live staged path (FMT_FUSE_TRANSFORM=0): two validating
+        stages on DIFFERENT columns; the second stage's emission happens
+        in post-filter coordinates and must still reach the right caller
+        with the right request-local offset."""
+        from flink_ml_tpu.lib import KMeans
+
+        rng = np.random.RandomState(11)
+        f = rng.randn(64, 3).astype(np.float32)
+        g = rng.randn(64, 3).astype(np.float32)
+        schema = Schema.of(("f", DataTypes.DENSE_VECTOR),
+                           ("g", DataTypes.DENSE_VECTOR),
+                           ("label", "double"))
+        y = (g[:, 0] > 0).astype(np.float64)
+        clean = Table.from_columns(schema, {"f": f, "g": g, "label": y})
+        chain = Pipeline([
+            KMeans().set_vector_col("f").set_k(3)
+            .set_prediction_col("cluster").set_max_iter(2),
+            LogisticRegression().set_vector_col("g").set_label_col("label")
+            .set_prediction_col("pred").set_max_iter(2),
+        ]).fit(clean)
+        fbad, gbad = f.copy(), g.copy()
+        fbad[2, 0] = np.nan   # stage 1 (KMeans on 'f') flags global row 2
+        gbad[6, 1] = np.inf   # stage 2 (LR on 'g') flags feed row 6 —
+        bad = Table.from_columns(schema, {  # local row 5 after filtering
+            "f": fbad, "g": gbad, "label": y})
+        monkeypatch.setenv("FMT_FUSE_TRANSFORM", "0")
+        quarantine.reset()
+        server = ModelServer(chain, max_batch=64, max_wait_ms=20,
+                             start=False)
+        fa = server.submit(bad.slice_rows(0, 5))   # owns global rows 0-4
+        fb = server.submit(bad.slice_rows(5, 10))  # owns global rows 5-9
+        server.start()
+        ra, rb = fa.result(WAIT), fb.result(WAIT)
+        server.shutdown()
+        assert ra.num_rows == 4 and rb.num_rows == 4
+        (qa,) = ra.quarantine.values()   # KMeans flag: A's local row 2
+        assert [int(r) for r in
+                qa.col(quarantine.QUARANTINE_ROW_COL)] == [2]
+        (qb,) = rb.quarantine.values()   # LR flag: B's local row 1
+        assert [int(r) for r in
+                qb.col(quarantine.QUARANTINE_ROW_COL)] == [1]
+        quarantine.reset()
+
+
+# -- coalescing / flush timing ------------------------------------------------
+
+
+class TestCoalesceFlush:
+    def test_concurrent_requests_coalesce_into_one_batch(self, model,
+                                                         dense_table,
+                                                         obs_on):
+        server = ModelServer(model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        futs = [server.submit(r)
+                for r in _requests(dense_table, [4, 4, 4, 4])]
+        server.start()
+        for f in futs:
+            f.result(WAIT)
+        server.shutdown()
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.batches") == 1
+        assert c.get("serving.coalesced_requests") == 4
+        assert c.get("serving.served_rows") == 16
+
+    def test_flush_on_max_batch_rows_not_wait(self, model, dense_table):
+        """max_wait is huge; hitting max_batch rows must flush anyway."""
+        server = ModelServer(model, max_batch=8, max_wait_ms=60_000)
+        t0 = time.perf_counter()
+        futs = [server.submit(r) for r in _requests(dense_table, [4, 4])]
+        for f in futs:
+            f.result(WAIT)
+        assert time.perf_counter() - t0 < 20  # nowhere near max_wait
+        server.shutdown()
+
+    def test_flush_on_max_wait_partial_batch(self, model, dense_table,
+                                             obs_on):
+        """One small request must be served after ~max_wait even though
+        the batch is nowhere near full."""
+        server = ModelServer(model, max_batch=512, max_wait_ms=10)
+        res = server.predict(dense_table.slice_rows(0, 2), timeout=WAIT)
+        assert res.num_rows == 2
+        server.shutdown()
+        g = obs.registry().snapshot()["gauges"]
+        assert g.get("serving.batch_occupancy", 1.0) < 0.5
+
+    def test_oversized_request_serves_alone(self, model, dense_table):
+        server = ModelServer(model, max_batch=8, queue_cap=128)
+        res = server.predict(dense_table.slice_rows(0, 32), timeout=WAIT)
+        assert res.num_rows == 32
+        server.shutdown()
+
+    def test_mixed_schema_requests_never_share_a_batch(self, model,
+                                                       dense_table,
+                                                       obs_on):
+        unlabeled = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR)),
+            {"features": dense_table.features_dense("features")[:4]},
+        )
+        server = ModelServer(model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        fa = server.submit(dense_table.slice_rows(0, 4))
+        fb = server.submit(unlabeled)
+        server.start()
+        ra, rb = fa.result(WAIT), fb.result(WAIT)
+        server.shutdown()
+        assert ra.table.schema.contains("label")
+        assert not rb.table.schema.contains("label")
+        assert obs.registry().snapshot()["counters"]["serving.batches"] == 2
+
+
+# -- admission control + shedding ---------------------------------------------
+
+
+class TestAdmission:
+    def test_queue_cap_rejection_is_reason_coded(self, model, dense_table,
+                                                 obs_on):
+        server = ModelServer(model, queue_cap=8, start=False)
+        server.submit(dense_table.slice_rows(0, 8))
+        with pytest.raises(ServerOverloadedError) as err:
+            server.submit(dense_table.slice_rows(8, 10))
+        assert err.value.reason == "queue_full"
+        server.shutdown()  # drains the admitted request
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.shed.queue_full") == 1
+
+    def test_full_queue_sheds_oldest_past_deadline_first(self, model,
+                                                         dense_table):
+        server = ModelServer(model, queue_cap=8, start=False)
+        doomed = server.submit(dense_table.slice_rows(0, 4), deadline_ms=1)
+        alive = server.submit(dense_table.slice_rows(4, 8))  # no deadline
+        time.sleep(0.01)  # doomed's deadline passes in the queue
+        admitted = server.submit(dense_table.slice_rows(8, 12))
+        with pytest.raises(ServerOverloadedError) as err:
+            doomed.result(WAIT)
+        assert err.value.reason == "deadline_expired"
+        server.start()
+        assert alive.result(WAIT).num_rows == 4
+        assert admitted.result(WAIT).num_rows == 4
+        server.shutdown()
+
+    def test_expired_request_sheds_at_dispatch(self, model, dense_table):
+        server = ModelServer(model, start=False)
+        doomed = server.submit(dense_table.slice_rows(0, 4), deadline_ms=1)
+        served = server.submit(dense_table.slice_rows(4, 8))
+        time.sleep(0.01)
+        server.start()
+        with pytest.raises(ServerOverloadedError) as err:
+            doomed.result(WAIT)
+        assert err.value.reason == "deadline_expired"
+        assert served.result(WAIT).num_rows == 4
+        server.shutdown()
+
+    def test_breaker_open_sheds_instead_of_queueing(self, model,
+                                                    dense_table, obs_on,
+                                                    monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        serve.reset_breakers()
+        # one of THIS pipeline's dispatch surfaces (the LR stage's mapper)
+        serve.breaker("LogisticRegressionModel").record_failure()
+        server = ModelServer(model, start=False)
+        with pytest.raises(ServerOverloadedError) as err:
+            server.submit(dense_table.slice_rows(0, 4))
+        assert err.value.reason == "breaker_open"
+        assert "LogisticRegressionModel" in str(err.value)
+        server.shutdown()
+        serve.reset_breakers()
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.shed.breaker_open") == 1
+
+    def test_unrelated_open_breaker_does_not_shed(self, model, dense_table,
+                                                  monkeypatch):
+        """Another pipeline's dead device must not reject THIS server's
+        traffic: only breakers on the served model's own dispatch
+        surfaces (stage mappers / its fused plans) shed at admission."""
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        serve.reset_breakers()
+        serve.breaker("SomeOtherModel").record_failure()
+        serve.breaker("FusedPlan[SomeOtherModel+KnnModel]").record_failure()
+        server = ModelServer(model, max_wait_ms=5)
+        assert server.predict(dense_table.slice_rows(0, 4),
+                              timeout=WAIT).num_rows == 4
+        server.shutdown()
+        serve.reset_breakers()
+
+    def test_own_fused_plan_breaker_sheds(self, model, dense_table,
+                                          monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        serve.reset_breakers()
+        serve.breaker(
+            "FusedPlan[StandardScalerModel+MinMaxScalerModel"
+            "+LogisticRegressionModel]"
+        ).record_failure()
+        server = ModelServer(model, start=False)
+        with pytest.raises(ServerOverloadedError) as err:
+            server.submit(dense_table.slice_rows(0, 4))
+        assert err.value.reason == "breaker_open"
+        server.shutdown()
+        serve.reset_breakers()
+
+    def test_shed_on_breaker_off_keeps_serving(self, model, dense_table,
+                                               monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        serve.reset_breakers()
+        serve.breaker("LogisticRegressionModel").record_failure()
+        server = ModelServer(model, shed_on_breaker=False, max_wait_ms=5)
+        assert server.predict(dense_table.slice_rows(0, 4),
+                              timeout=WAIT).num_rows == 4
+        server.shutdown()
+        serve.reset_breakers()
+
+    def test_empty_request_rejected(self, model, dense_table):
+        server = ModelServer(model, start=False)
+        with pytest.raises(ValueError, match="empty request"):
+            server.submit(dense_table.slice_rows(0, 0))
+        server.shutdown()
+
+    def test_config_env_knobs_with_overrides(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVING_MAX_BATCH", "64")
+        monkeypatch.setenv("FMT_SERVING_MAX_WAIT_MS", "7.5")
+        monkeypatch.setenv("FMT_SERVING_QUEUE_CAP", "100")
+        monkeypatch.setenv("FMT_SERVING_DEADLINE_MS", "250")
+        cfg = ServingConfig.from_env()
+        assert (cfg.max_batch, cfg.max_wait_ms, cfg.queue_cap,
+                cfg.deadline_ms) == (64, 7.5, 100, 250.0)
+        cfg = ServingConfig.from_env(max_batch=8, deadline_ms=0)
+        assert cfg.max_batch == 8 and cfg.deadline_ms == 0.0
+        assert cfg.deadline_at(10.0, None) is None
+        assert cfg.deadline_at(10.0, 500) == pytest.approx(10.5)
+
+
+# -- server lifecycle ---------------------------------------------------------
+
+
+class TestServerLifecycle:
+    def test_predict_parity_vs_solo_transform(self, model, dense_table):
+        server = ModelServer(model, max_wait_ms=5)
+        req = dense_table.slice_rows(32, 40)
+        res = server.predict(req, timeout=WAIT)
+        server.shutdown()
+        (solo,) = model.transform(req)
+        assert res.table.schema == solo.schema
+        _assert_rows_equal(res.table, solo, cols=("pred", "label"))
+        np.testing.assert_allclose(
+            np.asarray(res.table.col("proba")),
+            np.asarray(solo.col("proba")), rtol=1e-6,
+        )
+
+    def test_coalesced_callers_each_get_their_own_rows(self, model,
+                                                       dense_table):
+        server = ModelServer(model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        reqs = _requests(dense_table, [3, 5, 7])
+        futs = [server.submit(r) for r in reqs]
+        server.start()
+        results = [f.result(WAIT) for f in futs]
+        server.shutdown()
+        for req, res in zip(reqs, results):
+            assert res.num_rows == req.num_rows()
+            _assert_rows_equal(res.table, req, cols=("label",))
+
+    def test_shutdown_drains_inflight_futures(self, model, dense_table):
+        server = ModelServer(model, max_batch=512, max_wait_ms=60_000,
+                             start=False)
+        futs = [server.submit(r) for r in _requests(dense_table, [4, 4])]
+        server.start()
+        # dispatcher is parked on the 60s flush window; shutdown must
+        # flush-and-serve, not abandon the futures
+        server.shutdown(drain=True)
+        assert all(f.result(WAIT).num_rows == 4 for f in futs)
+
+    def test_shutdown_without_drain_sheds_queue(self, model, dense_table,
+                                                obs_on):
+        server = ModelServer(model, start=False)
+        fut = server.submit(dense_table.slice_rows(0, 4))
+        server.shutdown(drain=False)
+        with pytest.raises(ServerOverloadedError) as err:
+            fut.result(WAIT)
+        assert err.value.reason == "shutdown"
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.shed.shutdown") == 1
+
+    def test_submit_after_shutdown_raises_closed(self, model, dense_table):
+        server = ModelServer(model)
+        server.shutdown()
+        with pytest.raises(ServerClosedError):
+            server.submit(dense_table.slice_rows(0, 4))
+
+    def test_context_manager(self, model, dense_table):
+        with ModelServer(model, max_wait_ms=5, start=False) as server:
+            assert server.predict(dense_table.slice_rows(0, 4),
+                                  timeout=WAIT).num_rows == 4
+        assert not server.running
+
+    def test_transform_exception_propagates_to_futures(self, dense_table,
+                                                       obs_on):
+        class Boom:
+            def transform(self, *_tables):
+                raise RuntimeError("kaput")
+
+        server = ModelServer(Boom(), max_batch=64, max_wait_ms=20,
+                             start=False)
+        futs = [server.submit(r) for r in _requests(dense_table, [4, 4])]
+        server.start()
+        for f in futs:
+            with pytest.raises(RuntimeError, match="kaput"):
+                f.result(WAIT)
+        server.shutdown()
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.failed_requests") == 2
+        assert c.get("serving.failed_batches") == 1
+
+    def test_cancelled_future_never_kills_the_dispatcher(self, model,
+                                                         dense_table):
+        """A caller cancelling its queued future (e.g. cleanup after a
+        timeout) must drop that request, not crash the dispatcher with
+        InvalidStateError and orphan everyone behind it."""
+        server = ModelServer(model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        doomed = server.submit(dense_table.slice_rows(0, 4))
+        alive = server.submit(dense_table.slice_rows(4, 8))
+        assert doomed.cancel()
+        server.start()
+        assert alive.result(WAIT).num_rows == 4
+        # the dispatcher survived: a fresh request still serves
+        assert server.predict(dense_table.slice_rows(8, 12),
+                              timeout=WAIT).num_rows == 4
+        server.shutdown()
+
+    def test_request_larger_than_env_batch_rejected(self, model,
+                                                    dense_table,
+                                                    monkeypatch):
+        """Past the environment batch size the fused path moves work onto
+        its prefetch thread, which the demux capture cannot see — such a
+        request is refused at submit with a pointer to transform."""
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        env = MLEnvironmentFactory.get_default()
+        monkeypatch.setattr(env, "default_batch_size", 64)
+        server = ModelServer(model, max_batch=32, start=False)
+        with pytest.raises(ValueError, match="transform directly"):
+            server.submit(dense_table.slice_rows(0, 100))
+        server.shutdown()
+
+    def test_max_batch_clamps_to_env_batch_size(self, model, monkeypatch):
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        env = MLEnvironmentFactory.get_default()
+        monkeypatch.setattr(env, "default_batch_size", 64)
+        with pytest.warns(UserWarning, match="clamping"):
+            server = ModelServer(model, max_batch=1024, start=False)
+        assert server.config.max_batch == 64
+        server.shutdown()
+
+    def test_latency_histogram_and_gauges_recorded(self, model,
+                                                   dense_table, obs_on):
+        server = ModelServer(model, max_wait_ms=5)
+        server.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+        server.shutdown()
+        t = obs.registry().timing("serving.request_latency_ms")
+        assert t and t["count"] == 1 and t["p99_s"] >= t["p50_s"] > 0
+        g = obs.registry().snapshot()["gauges"]
+        assert "serving.queue_depth" in g
+        stats = server.stats()
+        assert stats["serving.requests"] == 1
+        assert stats["latency_p99_ms"] > 0
+
+
+# -- quarantine demux through the live server (satellite red test) ------------
+
+
+class TestServingQuarantine:
+    def test_concurrent_bad_row_request_gets_local_offset(self, model,
+                                                          dense_table):
+        """Two coalesced 3-row requests; B's row 1 is NaN.  B must see
+        ``nan_inf@1`` (request-local), A must see clean rows, and both
+        must serve bit-identically to solo serving."""
+        a_req = dense_table.slice_rows(0, 3)
+        Xb = np.asarray(
+            dense_table.features_dense("features")[3:6]
+        ).copy()
+        Xb[1, 0] = np.nan
+        b_req = Table.from_columns(SCHEMA, {
+            "features": Xb,
+            "label": dense_table.col("label")[3:6],
+        })
+        quarantine.reset()
+        server = ModelServer(model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        fa, fb = server.submit(a_req), server.submit(b_req)
+        server.start()
+        ra, rb = fa.result(WAIT), fb.result(WAIT)
+        server.shutdown()
+        assert ra.num_rows == 3 and ra.num_quarantined == 0
+        assert rb.num_rows == 2 and rb.num_quarantined == 1
+        (q,) = rb.quarantine.values()
+        assert list(q.col(quarantine.QUARANTINE_REASON_COL)) == ["nan_inf"]
+        assert [int(r) for r in q.col(quarantine.QUARANTINE_ROW_COL)] == [1]
+        # bit-identical to solo serving of the same requests
+        quarantine.reset()
+        (solo_a,) = model.transform(a_req)
+        (solo_b,) = model.transform(b_req)
+        quarantine.reset()
+        _assert_rows_equal(ra.table, solo_a)
+        _assert_rows_equal(rb.table, solo_b)
+
+    def test_server_traffic_stays_out_of_global_side_tables(self, model,
+                                                            dense_table):
+        """Captured (served-back) quarantine rows must not ALSO pile up in
+        the process-wide store — callers own their bad rows."""
+        X = np.asarray(dense_table.features_dense("features")[:4]).copy()
+        X[2, 1] = np.inf
+        bad = Table.from_columns(SCHEMA, {
+            "features": X, "label": dense_table.col("label")[:4]})
+        quarantine.reset()
+        server = ModelServer(model, max_wait_ms=5)
+        res = server.predict(bad, timeout=WAIT)
+        server.shutdown()
+        assert res.num_quarantined == 1
+        assert quarantine.quarantined_counts() == {}
+        quarantine.reset()
+
+
+# -- hot swap -----------------------------------------------------------------
+
+
+class TestHotSwap:
+    def _fit(self, table, max_iter):
+        return Pipeline([
+            StandardScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_max_iter(max_iter).set_learning_rate(0.5),
+        ]).fit(table)
+
+    def test_deploy_swaps_versions_between_batches(self, dense_table,
+                                                   obs_on):
+        m1, m2 = self._fit(dense_table, 2), self._fit(dense_table, 3)
+        server = ModelServer(m1, version="v1", max_wait_ms=5)
+        assert server.predict(dense_table.slice_rows(0, 4),
+                              timeout=WAIT).version == "v1"
+        server.deploy(m2, "v2")
+        assert server.active_version == "v2"
+        assert server.predict(dense_table.slice_rows(0, 4),
+                              timeout=WAIT).version == "v2"
+        server.shutdown()
+        assert server.versions == ["v1", "v2"]
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.swaps") == 1
+
+    def test_deploy_prewarms_before_swap(self, dense_table):
+        m1, m2 = self._fit(dense_table, 2), self._fit(dense_table, 3)
+        warm_calls = []
+        orig = m2.transform
+        m2.transform = lambda *t: warm_calls.append(1) or orig(*t)
+        server = ModelServer(m1, version="v1", max_wait_ms=5)
+        server.predict(dense_table.slice_rows(0, 8), timeout=WAIT)
+        server.deploy(m2, "v2")  # warmup defaults to live-traffic sample
+        assert warm_calls, "deploy must pre-warm the new version"
+        server.shutdown()
+
+    def test_corrupt_deploy_leaves_old_version_serving(self, dense_table,
+                                                       tmp_path, obs_on):
+        from flink_ml_tpu.serve import ModelIntegrityError
+
+        m1, m2 = self._fit(dense_table, 2), self._fit(dense_table, 3)
+        bad_dir = str(tmp_path / "v2")
+        m2.save(bad_dir)
+        mdf = tmp_path / "v2" / "stage_001" / "model_data.jsonl"
+        blob = bytearray(mdf.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        mdf.write_bytes(bytes(blob))
+        server = ModelServer(m1, version="v1", max_wait_ms=5,
+                             warmup=dense_table.slice_rows(0, 4))
+        with pytest.raises(ModelIntegrityError):
+            server.deploy(bad_dir, "v2")
+        assert server.active_version == "v1"
+        assert server.predict(dense_table.slice_rows(0, 4),
+                              timeout=WAIT).version == "v1"
+        server.shutdown()
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.deploy_failures") == 1
+        assert "serving.swaps" not in c
+
+    def test_deploy_from_path_verifies_and_serves(self, dense_table,
+                                                  tmp_path):
+        m2 = self._fit(dense_table, 3)
+        path = str(tmp_path / "m2")
+        m2.save(path)
+        server = ModelServer(path=path, version="v1", max_wait_ms=5,
+                             warmup=dense_table.slice_rows(0, 4))
+        res = server.predict(dense_table.slice_rows(0, 8), timeout=WAIT)
+        server.shutdown()
+        (solo,) = m2.transform(dense_table.slice_rows(0, 8))
+        _assert_rows_equal(res.table, solo)
+
+    def test_queued_requests_serve_on_the_version_at_batch_start(
+        self, dense_table
+    ):
+        m1, m2 = self._fit(dense_table, 2), self._fit(dense_table, 3)
+        server = ModelServer(m1, version="v1", start=False)
+        fut = server.submit(dense_table.slice_rows(0, 4))
+        server.deploy(m2, "v2", warmup=dense_table.slice_rows(0, 4))
+        server.start()
+        # the batch had not started when the swap landed: it serves on v2
+        assert fut.result(WAIT).version == "v2"
+        server.shutdown()
+
+
+# -- satellite: breaker + registry thread-safety ------------------------------
+
+
+class TestBreakerProbeConcurrency:
+    def test_single_half_open_probe_under_concurrency(self, monkeypatch):
+        """RED on the pre-PR breaker: every thread arriving after the
+        cooldown flipped to half-open AND rode through as its own probe —
+        a probe stampede against a device the breaker had declared dead.
+        Exactly ONE caller may own the half-open probe."""
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_SERVE_BREAKER_COOLDOWN_S", "30")
+        b = serve.CircuitBreaker("probe-race")
+        b.record_failure()
+        assert b.state == 1.0
+        b._opened_at -= 60.0  # cooldown long since elapsed
+        n_threads = 8
+        barrier = threading.Barrier(n_threads)
+        allowed = []
+
+        def prober():
+            barrier.wait()
+            if b.allow_device():
+                allowed.append(threading.get_ident())
+
+        threads = [threading.Thread(target=prober) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(allowed) == 1, (
+            f"{len(allowed)} concurrent half-open probes rode through"
+        )
+        assert b.state == 0.5
+
+    def test_probe_resolution_reopens_or_closes_for_next_caller(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_SERVE_BREAKER_COOLDOWN_S", "30")
+        b = serve.CircuitBreaker("probe-seq")
+        b.record_failure()
+        b._opened_at -= 60.0
+        assert b.allow_device()       # the probe
+        assert not b.allow_device()   # a second caller: fallback
+        b.record_success()            # probe succeeded
+        assert b.state == 0.0 and b.allow_device()
+
+    def test_wedged_probe_hands_over_after_cooldown(self, monkeypatch):
+        monkeypatch.setenv("FMT_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("FMT_SERVE_BREAKER_COOLDOWN_S", "30")
+        b = serve.CircuitBreaker("probe-wedge")
+        b.record_failure()
+        b._opened_at -= 60.0
+        assert b.allow_device()      # probe taken... and its owner dies
+        b._probe_started -= 60.0     # a full cooldown passes
+        assert b.allow_device()      # the probe hands over, not wedged
+
+
+class TestRegistryThreadSafety:
+    def test_concurrent_counter_and_timing_updates_are_exact(self):
+        obs.enable()
+        obs.reset()
+        n_threads, per_thread = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(per_thread):
+                obs.counter_add("conc.c")
+                obs.observe("conc.t", 0.001)
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = obs.registry().snapshot()
+        total = n_threads * per_thread
+        assert snap["counters"]["conc.c"] == total
+        assert snap["timings"]["conc.t"]["count"] == total
+        assert snap["timings"]["conc.t"]["total_s"] == pytest.approx(
+            total * 0.001
+        )
+        obs.reset()
+        obs.disable()
+
+    def test_timing_quantiles_over_samples(self):
+        obs.enable()
+        obs.reset()
+        for v in range(1, 101):
+            obs.observe("q.t", float(v))
+        t = obs.registry().timing("q.t")
+        assert t["p50_s"] == pytest.approx(50.0, abs=1.0)
+        assert t["p99_s"] == pytest.approx(99.0, abs=1.0)
+        assert t["min_s"] == 1.0 and t["max_s"] == 100.0
+        obs.reset()
+        obs.disable()
