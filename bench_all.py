@@ -1507,6 +1507,142 @@ def bench_serving(n_rows=20_000, n_features=16, n_requests=160, sweeps=3,
     })
 
 
+def bench_trace_overhead(n_rows=16_384, n_features=256, n_requests=128,
+                         sweeps=7, max_batch=512, max_wait_ms=2.0):
+    """Disabled-tracing overhead on the serving path (ISSUE 8).
+
+    The round-11 contract: every trace hook planted in the serving hot
+    path (submit, dispatch, the fused plan, demux) reduces to one
+    module-bool check when ``FMT_TRACE`` is off, and head sampling at 1%
+    keeps the enabled path within the same envelope.  This sweep runs
+    the SAME mixed-size request load through ``ModelServer`` with
+    tracing disabled and enabled-at-1%-sampling, interleaved (off/on per
+    sweep so drift hits both arms), and emits ``trace_on_over_off`` =
+    enabled wall / disabled wall — the lower-is-better ratio
+    BASELINE.json gates at <= 1.02 (the <= 2% contract; ``--check``
+    fails beyond 1.122 with its +10% tolerance).
+
+    Asserted inside the bench, never just recorded: the disabled sweeps
+    record ZERO spans (the one-bool contract, structurally), and the
+    1%-sampled sweeps trace well under 10% of requests (head sampling
+    actually sheds the work, not just the output).
+    """
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import trace
+    from flink_ml_tpu.serving import ModelServer
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(29)
+    X = (2.0 * rng.randn(n_rows, n_features) + 1.0).astype(np.float32)
+    true_w = (rng.randn(n_features) / np.sqrt(n_features)).astype(np.float32)
+    y = ((X - 1.0) @ true_w > 0).astype(np.float64)
+    t = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(t)
+
+    # serving-realistic request sizes (8-64 rows): per-dispatch compute
+    # must dominate, or the 1%-sampled requests' REAL span work reads as
+    # hook overhead it isn't
+    sizes = rng.choice([8, 16, 32, 64], size=n_requests)
+    requests, lo = [], 0
+    for s in sizes:
+        requests.append(t.slice_rows(lo, lo + int(s)))
+        lo += int(s)
+
+    # global tracing state is mutated for the measurement: restore it on
+    # EVERY exit (a failed assert mid-sweep must not leave later
+    # workloads in the same bench_all invocation paying full tracing)
+    prev_trace_dir = os.environ.get("FMT_TRACE_DIR")
+    os.environ["FMT_TRACE_DIR"] = tempfile.mkdtemp(prefix="bench_trace_")
+    server = None
+    try:
+        trace.enable(False)
+        trace.reset()
+        server = ModelServer(model, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms,
+                             queue_cap=4 * sum(int(s) for s in sizes))
+        # warm both paths (ladder buckets + the traced branch's first
+        # file I/O)
+        for fut in [server.submit(r) for r in requests[:8]]:
+            fut.result(timeout=120)
+        trace.enable(True, sample=1.0)
+        for fut in [server.submit(r) for r in requests[:8]]:
+            fut.result(timeout=120)
+        trace.enable(False)
+        trace.reset()
+
+        def sweep():
+            t0 = time.perf_counter()
+            futs = [server.submit(r) for r in requests]
+            for f in futs:
+                f.result(timeout=120)
+            return time.perf_counter() - t0
+
+        walls_off, walls_on = [], []
+        for _ in range(sweeps):
+            # interleaved off/on: machine drift lands on both arms equally
+            trace.enable(False)
+            spans_before = len(trace.recent_spans())
+            walls_off.append(sweep())
+            assert len(trace.recent_spans()) == spans_before, (
+                "spans recorded while tracing was DISABLED — a hook is "
+                "not reducing to its one-bool check"
+            )
+            trace.enable(True, sample=0.01)
+            walls_on.append(sweep())
+            trace.enable(False)
+        sampled_requests = sum(
+            1 for s in trace.recent_spans()
+            if s["name"] == "serving.request"
+        )
+        stats = server.stats()
+    finally:
+        if server is not None:
+            server.shutdown()
+        trace.enable(False, sample=1.0)
+        trace.reset()
+        if prev_trace_dir is None:
+            os.environ.pop("FMT_TRACE_DIR", None)
+        else:
+            os.environ["FMT_TRACE_DIR"] = prev_trace_dir
+
+    timed_requests = sweeps * n_requests
+    assert sampled_requests < 0.1 * timed_requests, (
+        f"1% head sampling traced {sampled_requests} of "
+        f"{timed_requests} requests — sampling is not shedding the work"
+    )
+    # min-of-sweeps, not median: overhead noise (GC, a scheduler hiccup
+    # landing on one arm) is strictly ADDITIVE, so each arm's best sweep
+    # is its cleanest measurement of the code's own cost
+    off_s = float(np.min(walls_off))
+    on_s = float(np.min(walls_on))
+    return _emit({
+        "metric": "ModelServer.serve trace_on_over_off",
+        "value": round(on_s / off_s, 4),
+        "unit": "ratio (lower is better)",
+        "off_ms": round(off_s * 1e3, 1),
+        "on_1pct_ms": round(on_s * 1e3, 1),
+        "sampled_requests": int(sampled_requests),
+        "timed_requests": int(timed_requests),
+        "latency_p99_ms": stats.get("latency_p99_ms"),
+        "disabled_records_zero_spans": True,  # asserted above
+        "shape": f"{n_requests} mixed-size (8-64 row) requests x "
+                 f"{n_features} features x {sweeps} interleaved off/on "
+                 f"sweeps, max_batch={max_batch}, 1% head sampling, "
+                 "min-of-sweeps",
+    })
+
+
 def bench_sparse_file(n_rows, dim, nnz):
     """Create (once) the synthetic Criteo-shaped LibSVM file."""
     rng = np.random.RandomState(5)
@@ -1540,6 +1676,7 @@ WORKLOADS = {
     "warmfit": bench_warm_fit,
     "serve": bench_serve_fused,
     "serving": bench_serving,
+    "trace_overhead": bench_trace_overhead,
 }
 
 

@@ -139,13 +139,19 @@ class PipelineModel(Model):
                 from flink_ml_tpu.serve import serve_counter_snapshot
 
                 serve0 = serve_counter_snapshot()
-            if len(inputs) == 1 and isinstance(inputs[0], Table) \
-                    and len(self.stages) > 1 and fused.fusion_enabled():
-                out = fused.transform_fused(self, inputs)
-            else:
-                out = inputs
-                for stage in self.stages:
-                    out = stage.transform(*out)
+            # top-level transforms root a trace (FMT_TRACE); inside a
+            # served batch this degrades to a child span under the
+            # dispatcher's handed-off request context(s)
+            with obs.trace.root_span("pipeline", {
+                "stages": len(self.stages),
+            }):
+                if len(inputs) == 1 and isinstance(inputs[0], Table) \
+                        and len(self.stages) > 1 and fused.fusion_enabled():
+                    out = fused.transform_fused(self, inputs)
+                else:
+                    out = inputs
+                    for stage in self.stages:
+                        out = stage.transform(*out)
             if serve0 is not None and len(inputs) == 1 \
                     and isinstance(inputs[0], Table):
                 from flink_ml_tpu.obs.report import transform_report

@@ -363,11 +363,15 @@ class FusedRun:
                 t = mapper._helper.get_result_table(t, out)
             t, good = self._validate_entry(t, offset)
             n = t.num_rows()
-            args = (
-                self._extract(t, self._bucket(n, row_multiple), mesh,
-                              row_multiple)
-                if n else None
-            )
+            args = None
+            if n:
+                b = self._bucket(n, row_multiple)
+                # host prep + H2D staging — on the prefetch producer
+                # thread when batched, under the consumer's trace context
+                # (prefetch_iter hands it off explicitly)
+                with obs.trace.span("place_h2d",
+                                    {"rows": n, "bucket": b}):
+                    args = self._extract(t, b, mesh, row_multiple)
             yield offset, n_in, n, good, t, args
             offset += n_in
 
@@ -380,13 +384,21 @@ class FusedRun:
         from flink_ml_tpu.lib.common import fetch_flat
 
         t0 = time.perf_counter()
-        placed = [
-            a if isinstance(a, jax.Array) or not isinstance(a, np.ndarray)
-            else jnp.asarray(a)
-            for a in args
-        ]
-        res = self._apply_fn(mesh)(*placed, *self.model_args)
-        fetched = fetch_flat(*res)
+        with obs.trace.span("fused_dispatch", {
+            "rows": n, "plan": self.serve_name,
+            "stages": len(self.device_stages),
+        }):
+            placed = [
+                a if isinstance(a, jax.Array)
+                or not isinstance(a, np.ndarray)
+                else jnp.asarray(a)
+                for a in args
+            ]
+            res = self._apply_fn(mesh)(*placed, *self.model_args)
+            # the bundled fetch is the one sync point: its span IS the
+            # device-execution window of the fused program
+            with obs.trace.span("device_sync"):
+                fetched = fetch_flat(*res)
         out: Dict[str, Sequence] = {}
         i = 0
         for ds in self.device_stages:
@@ -419,8 +431,12 @@ class FusedRun:
         batch exactly as the unfused pipeline would.  Entry validation
         already ran, so per-stage re-validation is skipped (same rows in,
         same rows out: the sink's row accounting stays aligned)."""
-        for ds in self.device_stages:
-            t = ds.mapper._apply_batch(t, row_offset=offset, validate=False)
+        obs.flight.record("plan.fallback", plan=self.serve_name,
+                          rows=t.num_rows())
+        with obs.trace.span("plan_fallback", {"plan": self.serve_name}):
+            for ds in self.device_stages:
+                t = ds.mapper._apply_batch(t, row_offset=offset,
+                                           validate=False)
         obs.counter_add("pipeline.plan_fallback_batches")
         return {name: t.col(name) for name in self.device_cols}
 

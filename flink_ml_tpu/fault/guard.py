@@ -130,7 +130,19 @@ def run_guarded(attempt: Callable[[float], object], what: str = "fit",
     overrides the env budget: algorithms with NO learning rate to back
     off (KMeans) pass 0, because replaying a deterministic attempt with
     nothing varied would re-diverge identically — fail fast beats a
-    bit-identical rerun."""
+    bit-identical rerun.
+
+    Tracing: this is the top-level ``fit`` entry, so it roots the fit's
+    trace (``FMT_TRACE``) — the train drivers' dispatch/sync spans and
+    any rollback attempts nest under one ``fit`` waterfall.  Inside an
+    already-traced region (a fit issued by a traced caller) it degrades
+    to a child span instead of re-rooting."""
+    with obs.trace.root_span("fit", {"what": what}):
+        return _run_guarded(attempt, what, max_retries)
+
+
+def _run_guarded(attempt: Callable[[float], object], what: str,
+                 max_retries: Optional[int]):
     if not enabled():
         return attempt(1.0)
     if max_retries is None:
@@ -149,6 +161,12 @@ def run_guarded(attempt: Callable[[float], object], what: str = "fit",
                     f"learning-rate scales {tried}: {exc}"
                 ) from exc
             obs.counter_add("fault.rollbacks")
+            # a rollback is a black-box moment: dump the ring so the
+            # operator sees the retries/ faults that led up to divergence
+            obs.flight.record("guard.rollback", what=what,
+                              attempt=k + 1, lr_scale=scale * backoff,
+                              detail=str(exc))
+            obs.flight.dump("guard_rollback")
             scale *= backoff
             warnings.warn(
                 f"{what}: non-finite training state ({exc}); rolling back "
@@ -256,6 +274,7 @@ def emergency_save(save_fn: Callable[[], object]) -> None:
     save_fn()
     _PREEMPTED.clear()  # consumed: the scope exit must not re-deliver
     obs.counter_add("fault.emergency_checkpoints")
+    obs.flight.record("fault.emergency_checkpoint")
     warnings.warn(
         "preemption signal received: emergency checkpoint committed, "
         "exiting cleanly (resume continues the run bit-identically)",

@@ -127,8 +127,13 @@ def with_retry(fn: Callable, name: str,
                 if is_transient(exc):
                     obs.counter_add("fault.giveups")
                     obs.counter_add(f"fault.giveups.{name}")
+                    obs.flight.record("fault.giveup", surface=name,
+                                      attempts=attempt,
+                                      error=type(exc).__name__)
                 raise
             obs.counter_add("fault.retries")
             obs.counter_add(f"fault.retries.{name}")
+            obs.flight.record("fault.retry", surface=name, attempt=attempt,
+                              error=type(exc).__name__, detail=str(exc))
             time.sleep(policy.delay(attempt))
             attempt += 1

@@ -720,6 +720,21 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     obs.observe("train.sync", sync_s)
     if not batch_preplaced:
         obs.observe("train.place", place_s)
+    # the same split as spans under the fit's trace (FMT_TRACE): post-hoc
+    # records with the measured windows, so a guarded fit's waterfall
+    # shows place -> dispatch -> sync the way a served request shows
+    # place_h2d -> fused_dispatch -> device_sync
+    parents = obs.trace.current()
+    if parents:
+        obs.trace.record_span(parents, "train.sync", sync_s,
+                              {"epochs": n_epochs})
+        obs.trace.record_span(parents, "train.dispatch", dispatch_s,
+                              end_ts=_time.time() - sync_s)
+        if not batch_preplaced:
+            obs.trace.record_span(
+                parents, "train.place", place_s,
+                end_ts=_time.time() - sync_s - dispatch_s,
+            )
     obs.counter_add("train.fused_runs")
     obs.counter_add("train.epochs", n_epochs)
     obs.counter_add("train.rows", n_rows * n_epochs)

@@ -102,7 +102,13 @@ class TableModelBase(Model):
         from flink_ml_tpu.serve import serve_counter_snapshot
 
         serve0 = serve_counter_snapshot() if _obs.enabled() else None
-        out = mapper.apply(table, batch_size=batch)
+        # top-level transforms root a trace (FMT_TRACE); inside an
+        # already-traced region (a pipeline stage, a served batch) this
+        # degrades to a child span under the caller's context
+        with _obs.trace.root_span("stage", {
+            "stage": type(self).__name__, "rows": table.num_rows(),
+        }):
+            out = mapper.apply(table, batch_size=batch)
         if serve0 is not None:
             from flink_ml_tpu.obs.report import transform_report
             from flink_ml_tpu.serve import serve_counter_delta

@@ -21,8 +21,20 @@ measurement layer:
 (jax.profiler hooks) remain the per-run primitives; this package is where
 their outputs — and everything else worth keeping — get aggregated and
 persisted per run instead of dying in stdout.
+
+ISSUE 8 added the per-request layer on top of the aggregates:
+
+  * :mod:`flink_ml_tpu.obs.trace` — Dapper-style span tracing with
+    explicit cross-thread context handoff (``FMT_TRACE`` /
+    ``FMT_TRACE_SAMPLE``, off by default, one-bool hooks), a JSONL span
+    sink, and the ``python -m flink_ml_tpu.obs trace`` waterfall CLI.
+  * :mod:`flink_ml_tpu.obs.flight` — an always-on bounded ring of
+    structured events (swaps, sheds, breaker transitions, fault
+    retries/rollbacks, plan fallbacks) dumped as a redacted JSONL black
+    box on breaker-open, deploy failure, guard rollback, or crash.
 """
 
+from flink_ml_tpu.obs import flight, trace  # noqa: F401
 from flink_ml_tpu.obs.registry import (
     MetricsRegistry,
     counter_add,
@@ -56,6 +68,7 @@ __all__ = [
     "enable",
     "enabled",
     "fit_report",
+    "flight",
     "gauge_set",
     "git_sha",
     "load_reports",
@@ -66,5 +79,6 @@ __all__ = [
     "registry",
     "reports_dir",
     "reset",
+    "trace",
     "write_run_report",
 ]

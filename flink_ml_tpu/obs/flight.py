@@ -1,0 +1,255 @@
+"""Black-box flight recorder: the last N structured events, always on.
+
+Aggregate counters say a breaker opened; a trace says where one request's
+time went — neither says what the SYSTEM was doing in the seconds before
+the breaker opened.  This module does: a bounded ring buffer records
+every operationally-significant event (hot swaps, load sheds, breaker
+state transitions, fault retries and rollbacks, plan fallbacks, deploy
+failures) at near-zero cost — one dict build plus a locked deque append,
+no I/O, no gating on ``FMT_OBS`` — and dumps the whole ring as a
+redacted JSONL "black box" when something goes wrong:
+
+* a circuit breaker OPENS (``serve/breaker.py``),
+* a deploy fails (``serving/versioning.py``),
+* the numeric guard rolls a fit back (``fault/guard.py``),
+* the process crashes with an unhandled exception (``sys.excepthook`` /
+  ``threading.excepthook``, chained to the previous hooks, installed
+  lazily on the first recorded event).
+
+Each event carries a monotonic sequence number, wall/monotonic clocks,
+the recording thread, and the active ``trace_id`` (when tracing is on) —
+so a dump lines up causally with the request traces and the obs
+counters.  Dumps are rate-limited per reason (``FMT_FLIGHT_MIN_S``,
+default 30 s) and land in ``FMT_FLIGHT_DIR`` (default: ``flight/``
+under the reports dir) as ``flight-<utc>-<reason>.jsonl``.
+
+Redaction: events are metadata-only by construction (no row payloads are
+ever recorded); on top of that every string field is truncated and any
+key whose name smells like a secret (token/key/secret/password) is
+masked before it reaches disk.
+
+``FMT_FLIGHT_EVENTS`` sizes the ring (default 512; ``0`` disables both
+recording and dumps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "dump",
+    "events",
+    "last_dump_path",
+    "record",
+    "reset",
+]
+
+_DEFAULT_CAPACITY = 512
+_MAX_STR = 256
+
+_LOCK = threading.Lock()
+_SEQ = 0
+_EVENTS: deque = deque(maxlen=_DEFAULT_CAPACITY)
+_CAPACITY_FROM = None  # env value the deque was sized for
+_LAST_DUMP: Dict[str, float] = {}  # reason -> monotonic time of last dump
+_LAST_DUMP_PATH: Optional[str] = None
+_HOOKS_INSTALLED = False
+
+
+def _capacity() -> int:
+    try:
+        return int(os.environ.get("FMT_FLIGHT_EVENTS",
+                                  str(_DEFAULT_CAPACITY))
+                   or _DEFAULT_CAPACITY)
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def _min_interval_s() -> float:
+    try:
+        return float(os.environ.get("FMT_FLIGHT_MIN_S", "30") or 30)
+    except ValueError:
+        return 30.0
+
+
+def flight_dir() -> str:
+    """``FMT_FLIGHT_DIR``, else ``flight/`` under the reports dir."""
+    d = os.environ.get("FMT_FLIGHT_DIR")
+    if not d:
+        from flink_ml_tpu.obs.report import reports_dir
+
+        d = os.path.join(reports_dir(), "flight")
+    return d
+
+
+_SECRET_FRAGMENTS = ("token", "secret", "password", "api_key", "apikey",
+                     "credential")
+
+
+def _redact_value(v):
+    if isinstance(v, str):
+        return v if len(v) <= _MAX_STR else v[:_MAX_STR - 3] + "..."
+    if isinstance(v, (int, float, bool)) or v is None:
+        return v
+    s = repr(v)
+    return s if len(s) <= _MAX_STR else s[:_MAX_STR - 3] + "..."
+
+
+def _redact(fields: dict) -> dict:
+    out = {}
+    for k, v in fields.items():
+        lk = str(k).lower()
+        if any(f in lk for f in _SECRET_FRAGMENTS):
+            out[k] = "<redacted>"
+        else:
+            out[k] = _redact_value(v)
+    return out
+
+
+def record(kind: str, **fields) -> None:
+    """Append one event to the ring.  Near-zero cost by contract: a dict
+    build and a locked append — no I/O, no formatting beyond redaction of
+    the caller's scalar fields.  ``FMT_FLIGHT_EVENTS=0`` reduces it to
+    the capacity check."""
+    global _SEQ, _EVENTS, _CAPACITY_FROM
+    cap = _capacity()
+    if cap <= 0:
+        return
+    trace_id = None
+    try:
+        from flink_ml_tpu.obs import trace as _trace
+
+        ids = _trace.current_trace_ids()
+        if ids:
+            trace_id = ids[0] if len(ids) == 1 else list(ids)
+    except Exception:  # noqa: BLE001 - the recorder must never raise
+        pass
+    event = {
+        "kind": kind,
+        "ts": time.time(),
+        "mono_s": time.monotonic(),
+        "thread": threading.current_thread().name,
+        **_redact(fields),
+    }
+    if trace_id is not None and "trace_id" not in event:
+        event["trace_id"] = trace_id
+    with _LOCK:
+        if _CAPACITY_FROM != cap:
+            _EVENTS = deque(_EVENTS, maxlen=cap)
+            _CAPACITY_FROM = cap
+        _SEQ += 1
+        event["seq"] = _SEQ
+        _EVENTS.append(event)
+    _ensure_crash_hooks()
+
+
+def events() -> List[dict]:
+    """The ring's current contents, oldest first."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def last_dump_path() -> Optional[str]:
+    """Where the most recent black box landed (None if never dumped)."""
+    return _LAST_DUMP_PATH
+
+
+def dump(reason: str, directory: Optional[str] = None,
+         force: bool = False) -> Optional[str]:
+    """Write the ring as one JSONL black box; returns the path.
+
+    Rate-limited per reason (``FMT_FLIGHT_MIN_S``) unless ``force`` —
+    a flapping breaker must not turn the reports dir into a landfill.
+    Returns None when rate-limited, disabled, empty, or unwritable
+    (a black box that throws during a crash hook would eat the crash)."""
+    global _LAST_DUMP_PATH
+    if _capacity() <= 0:
+        return None
+    now = time.monotonic()
+    with _LOCK:
+        if not _EVENTS:
+            return None
+        last = _LAST_DUMP.get(reason)
+        if not force and last is not None \
+                and now - last < _min_interval_s():
+            return None
+        _LAST_DUMP[reason] = now
+        snapshot = list(_EVENTS)
+    try:
+        d = directory or flight_dir()
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)[:48]
+        path = os.path.join(d, f"flight-{stamp}-{os.getpid()}-{safe}.jsonl")
+        header = {
+            "kind": "flight.dump",
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "events": len(snapshot),
+        }
+        with open(path, "a") as f:
+            f.write(json.dumps(header, sort_keys=True) + "\n")
+            for e in snapshot:
+                f.write(json.dumps(e, sort_keys=True) + "\n")
+    except OSError:
+        return None
+    _LAST_DUMP_PATH = path
+    return path
+
+
+def reset() -> None:
+    """Clear the ring and the per-reason dump clocks (tests)."""
+    global _SEQ, _LAST_DUMP_PATH
+    with _LOCK:
+        _EVENTS.clear()
+        _LAST_DUMP.clear()
+        _SEQ = 0
+        _LAST_DUMP_PATH = None
+
+
+# -- crash hooks --------------------------------------------------------------
+
+
+def _ensure_crash_hooks() -> None:
+    """Chain a dump-on-unhandled-crash hook into ``sys.excepthook`` and
+    ``threading.excepthook``, once, lazily — a process that never records
+    an event never has its hooks touched."""
+    global _HOOKS_INSTALLED
+    if _HOOKS_INSTALLED:
+        return
+    with _LOCK:
+        if _HOOKS_INSTALLED:
+            return
+        _HOOKS_INSTALLED = True
+    prev_sys = sys.excepthook
+    prev_threading = threading.excepthook
+
+    def on_crash(exc_type, exc, tb):
+        try:
+            record("crash", error=exc_type.__name__, detail=str(exc))
+            dump("crash", force=True)
+        except Exception:  # noqa: BLE001 - never shadow the real crash
+            pass
+        prev_sys(exc_type, exc, tb)
+
+    def on_thread_crash(args):
+        try:
+            if args.exc_type is not SystemExit:
+                record("crash", error=args.exc_type.__name__,
+                       detail=str(args.exc_value),
+                       thread_name=getattr(args.thread, "name", None))
+                dump("crash", force=True)
+        except Exception:  # noqa: BLE001
+            pass
+        prev_threading(args)
+
+    sys.excepthook = on_crash
+    threading.excepthook = on_thread_crash

@@ -9,7 +9,7 @@ live instead, durable and diffable.
 
 The CLI::
 
-    python -m flink_ml_tpu.obs [--check] [--reports DIR]
+    python -m flink_ml_tpu.obs [--check] [--json] [--reports DIR]
                                [--baseline BASELINE.json]
 
 (``python -m flink_ml_tpu.obs.report`` also works, at the cost of a runpy
@@ -21,6 +21,11 @@ of ``BASELINE.json`` and prints per-metric status; throughput metrics
 are flagged as regressions, and ``--check`` exits non-zero on any.
 Comparisons are backend-scoped: a CPU-backend run is never diffed against
 a TPU-measured baseline (that delta is the hardware, not the code).
+``--json`` swaps the human text for one machine-readable object
+(per-metric pass/fail, gate direction, margin to the boundary, the
+FAULT-ASSISTED/SERVE-DEGRADED flags, timing tail quantiles) for CI
+annotations; ``python -m flink_ml_tpu.obs trace`` renders a request
+waterfall from the span sink (:mod:`flink_ml_tpu.obs.trace`).
 """
 
 from __future__ import annotations
@@ -169,6 +174,13 @@ def _fit_delta_snapshot() -> dict:
                 "count": dc,
                 "total_s": dt,
                 "mean_s": dt / dc,
+                # tail quantiles over the stat's RECENT reservoir window
+                # (TimingStat.RESERVOIR newest samples) — not delta-exact
+                # like count/total, but the window is dominated by this
+                # fit's own observations, and a p99 is a tail signal, not
+                # an accounting identity
+                "p50_s": t.get("p50_s", 0.0),
+                "p99_s": t.get("p99_s", 0.0),
             }
     _PREV_FIT_SNAPSHOT = {
         "counters": dict(snap["counters"]),
@@ -224,10 +236,45 @@ def fit_report(name: str, shape=None, step_metrics=None, extra=None,
     if not _obs_enabled():
         return None
     try:
-        return write_run_report(
-            _build_report("fit", name, shape, step_metrics, extra), directory
-        )
+        report = _build_report("fit", name, shape, step_metrics, extra)
+        tid = _current_trace_id()
+        if tid:  # link the fit report to its trace waterfall
+            report.extra = {**(report.extra or {}), "trace_id": tid}
+        return write_run_report(report, directory)
     except Exception:  # noqa: BLE001
+        return None
+
+
+#: serve-rate timing histograms whose tail quantiles ride along in every
+#: transform RunReport (the registry's bounded-reservoir p50/p99)
+_TRANSFORM_TIMING_KEYS = (
+    "serve.deadline_ms", "pipeline.fused_call_ms",
+    "serving.request_latency_ms",
+)
+
+
+def _transform_timing_quantiles() -> dict:
+    """count/p50/p99 of the serve-rate timing stats (present ones only).
+    The ``_s`` suffix is the TimingStat vocabulary — the underlying unit
+    is whatever the histogram observes (ms for the serve timings)."""
+    out = {}
+    reg = _obs_registry()
+    for k in _TRANSFORM_TIMING_KEYS:
+        t = reg.timing(k)
+        if t is not None and t.get("count"):
+            out[k] = {"count": t["count"], "p50_s": t.get("p50_s", 0.0),
+                      "p99_s": t.get("p99_s", 0.0)}
+    return out
+
+
+def _current_trace_id() -> Optional[str]:
+    """The active trace id (None when tracing is off / nothing active)."""
+    try:
+        from flink_ml_tpu.obs.trace import current_trace_ids
+
+        ids = current_trace_ids()
+        return ids[0] if ids else None
+    except Exception:  # noqa: BLE001 - telemetry must never fail a run
         return None
 
 
@@ -241,18 +288,28 @@ def transform_report(name: str, rows: int, serve_delta: dict,
     computed by the caller so fit-report delta attribution stays
     untouched.  The full registry snapshot is deliberately omitted:
     transforms run at serving rate, and the serve delta is the whole
-    signal ``--check`` judges."""
+    signal ``--check`` judges.  The serve-rate timing quantiles
+    (``timings``: p50/p99 of dispatch wall, fused call, request latency)
+    and the active ``trace_id`` ride along so a slow transform links
+    straight to its waterfall."""
     if not _obs_enabled():
         return None
     try:
+        extra_out = {"rows": int(rows), "serve": dict(serve_delta),
+                     **(extra or {})}
+        timings = _transform_timing_quantiles()
+        if timings:
+            extra_out.setdefault("timings", timings)
+        tid = _current_trace_id()
+        if tid:
+            extra_out.setdefault("trace_id", tid)
         report = RunReport(
             kind="transform",
             name=name,
             ts=time.time(),
             git_sha=git_sha(),
             device=device_topology(),
-            extra={"rows": int(rows), "serve": dict(serve_delta),
-                   **(extra or {})},
+            extra=extra_out,
         )
         return write_run_report(report, directory)
     except Exception:  # noqa: BLE001 - telemetry must never fail a transform
@@ -310,6 +367,58 @@ def serve_degraded_runs(reports: List[dict]) -> List[dict]:
                  "rows": (r.get("extra") or {}).get("rows")}
             )
     return flagged
+
+
+#: per-fit timing stats worth a tail-quantile line in ``--check`` output
+_FIT_TIMING_KEYS = ("train.dispatch", "train.sync", "train.place")
+
+
+def timing_quantile_summary(reports: List[dict]) -> Dict[str, dict]:
+    """p50/p99 tail quantiles from the LATEST fit/transform report per
+    name (the satellite surfacing of TimingStat quantiles beyond the
+    serving reservoir): ``{"fit": {name: {stat: {p50_s, p99_s}}},
+    "transform": {...}}``.  Fit stats are seconds; transform stats keep
+    the unit their histogram observes (the serve timings are ms)."""
+    latest: Dict[str, Dict[str, dict]] = {"fit": {}, "transform": {}}
+    for r in reports:
+        kind = r.get("kind")
+        if kind in latest:
+            latest[kind][str(r.get("name", ""))] = r
+    out: Dict[str, dict] = {"fit": {}, "transform": {}}
+    for name, r in latest["fit"].items():
+        timings = (r.get("metrics") or {}).get("timings") or {}
+        stats = {
+            k: {"p50_s": t.get("p50_s", 0.0), "p99_s": t.get("p99_s", 0.0)}
+            for k, t in timings.items()
+            if k in _FIT_TIMING_KEYS and (t.get("p50_s") or t.get("p99_s"))
+        }
+        if stats:
+            out["fit"][name] = stats
+    for name, r in latest["transform"].items():
+        timings = (r.get("extra") or {}).get("timings") or {}
+        stats = {
+            k: {"p50_s": t.get("p50_s", 0.0), "p99_s": t.get("p99_s", 0.0)}
+            for k, t in sorted(timings.items())
+            if t.get("p50_s") or t.get("p99_s")
+        }
+        if stats:
+            out["transform"][name] = stats
+    return out
+
+
+def _timing_lines(summary: Dict[str, dict]) -> List[str]:
+    lines = []
+    for kind in ("fit", "transform"):
+        unit_scale = 1e3 if kind == "fit" else 1.0  # fit stats are seconds
+        suffix = "ms" if kind == "fit" else ""
+        for name, stats in sorted(summary.get(kind, {}).items()):
+            parts = [
+                f"{k} p50={t['p50_s'] * unit_scale:.2f}{suffix} "
+                f"p99={t['p99_s'] * unit_scale:.2f}{suffix}"
+                for k, t in sorted(stats.items())
+            ]
+            lines.append(f"TIMING {kind} {name}: " + "; ".join(parts))
+    return lines
 
 
 def bench_report(record: dict, directory: Optional[str] = None) -> Optional[str]:
@@ -403,6 +512,19 @@ def diff_against_baseline(reports: List[dict], baseline: dict,
         ratio = float(value) / float(base_value)
         lower_better = base.get("direction") == "lower"
         throughput = "/sec" in (unit or base.get("unit", ""))
+        # direction + margin make the row machine-consumable (--json):
+        # margin is the slack (in ratio units) before the row would flag
+        # as a regression — positive means inside the band, negative by
+        # how much the gate was blown
+        if lower_better:
+            direction = "lower"
+            margin = (1.0 + threshold) - ratio
+        elif throughput:
+            direction = "higher"
+            margin = ratio - (1.0 - threshold)
+        else:
+            direction = None
+            margin = None
         if lower_better and ratio > 1.0 + threshold:
             status = "regression"
         elif lower_better and ratio < 1.0 - threshold:
@@ -414,6 +536,8 @@ def diff_against_baseline(reports: List[dict], baseline: dict,
         else:
             status = "ok"
         row.update(status=status, latest=value, ratio=round(ratio, 3),
+                   direction=direction,
+                   margin=round(margin, 4) if margin is not None else None,
                    git_sha=rep.get("git_sha"))
         rows.append(row)
     return rows
@@ -477,14 +601,48 @@ def main(argv=None) -> int:
                         help="relative drop that counts as a regression")
     parser.add_argument("--check", action="store_true",
                         help="exit 1 when any regression is flagged")
+    parser.add_argument("--json", action="store_true",
+                        help="emit ONE machine-readable JSON object "
+                             "(per-metric pass/fail, direction, margin) "
+                             "instead of the human text — for CI "
+                             "annotations; exit semantics unchanged")
     args = parser.parse_args(argv)
 
     with open(args.baseline) as f:
         baseline = json.load(f)
     reports = load_reports(args.reports)
+    fault_assisted = fault_assisted_runs(reports)
+    serve_degraded = serve_degraded_runs(reports)
+    timing_summary = timing_quantile_summary(reports)
+    rows = diff_against_baseline(reports, baseline, args.threshold)
+    regressions = sum(r["status"] == "regression" for r in rows)
+    n_cmp = sum(r["status"] in ("ok", "improved", "regression") for r in rows)
+    # a gate that silently compares nothing stays green forever — when
+    # baselines exist but NOTHING was diffed (renamed metrics, missing
+    # reports, backend drift), --check fails loudly instead
+    nothing_comparable = bool(rows) and n_cmp == 0
+    failed = bool(args.check and (regressions or nothing_comparable))
+
+    if args.json:
+        print(json.dumps({
+            "ok": not failed,
+            "check": bool(args.check),
+            "threshold": args.threshold,
+            "baseline": args.baseline,
+            "regressions": regressions,
+            "comparable": n_cmp,
+            "baselined": len(rows),
+            "nothing_comparable": nothing_comparable,
+            "metrics": rows,
+            "fault_assisted": fault_assisted,
+            "serve_degraded": serve_degraded,
+            "timings": timing_summary,
+        }, sort_keys=True, indent=1))
+        return 1 if failed else 0
+
     # fault-assisted fits are flagged alongside the perf diff: a run that
     # only passed by retrying is one environment blip from not passing
-    for fr in fault_assisted_runs(reports):
+    for fr in fault_assisted:
         counters = ", ".join(
             f"{k}={v:g}" for k, v in sorted(fr["fault_counters"].items())
         )
@@ -493,20 +651,22 @@ def main(argv=None) -> int:
               f"[{fr.get('git_sha', '')}]: {counters}")
     # transforms that only completed via the CPU fallback: the device path
     # was effectively down — same visibility rule as FAULT-ASSISTED
-    for sr in serve_degraded_runs(reports):
+    for sr in serve_degraded:
         counters = ", ".join(
             f"{k}={v:g}" for k, v in sorted(sr["serve"].items())
         )
         print(f"SERVE-DEGRADED transform {sr['name']} "
               f"[{sr.get('git_sha', '')}]: {counters}")
-    rows = diff_against_baseline(reports, baseline, args.threshold)
+    # tail-quantile lines for the latest fit/transform per name: the p99
+    # lives next to the throughput gate it explains
+    for line in _timing_lines(timing_summary):
+        print(line)
     if not rows:
         print("no measured baselines in"
               f" {args.baseline} — nothing to diff (record bench runs via"
               " bench_all.py, then add them to BASELINE.json 'measured')")
         return 0
     width = max(len(r["metric"]) for r in rows)
-    regressions = 0
     for r in rows:
         ratio = f"{r['ratio']:.3f}x" if r.get("ratio") is not None else "-"
         latest = (f"{r['latest']:.6g}" if r.get("latest") is not None
@@ -515,21 +675,12 @@ def main(argv=None) -> int:
                 else "-")
         print(f"{r['metric']:<{width}}  base={base:<12} latest={latest:<12} "
               f"{ratio:<8} [{r['backend'] or 'any'}] {r['status']}")
-        if r["status"] == "regression":
-            regressions += 1
-    n_cmp = sum(r["status"] in ("ok", "improved", "regression") for r in rows)
     print(f"\n{len(rows)} baselined metric(s), {n_cmp} comparable, "
           f"{regressions} regression(s) at >{args.threshold:.0%} drop")
-    if args.check and regressions:
-        return 1
-    if args.check and rows and n_cmp == 0:
-        # baselines exist but NOTHING was diffed (renamed metrics, missing
-        # reports, backend drift): a gate that silently compares nothing
-        # stays green forever — fail loudly instead
+    if nothing_comparable and args.check:
         print("check FAILED: baselined metrics exist but none were "
               "comparable — metric names, reports/, or backend drifted")
-        return 1
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

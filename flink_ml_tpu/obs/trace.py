@@ -1,0 +1,608 @@
+"""End-to-end request tracing: Dapper-style spans with explicit handoff.
+
+The registry (``obs/registry.py``) answers "how much, in aggregate"; this
+module answers "where did THIS request's time go".  A trace is a tree of
+spans sharing one ``trace_id``, minted at a top-level entry point —
+``ModelServer.submit``, a guarded ``fit``, a top-level ``transform`` —
+and propagated by EXPLICIT context handoff across every thread boundary
+the serving stack crosses (the dispatcher thread, the prefetch producer
+threads, fused-plan dispatch), so one served request renders as one
+causally-nested waterfall::
+
+    serving.request (root, minted at submit)
+      submit          admission + enqueue, on the caller thread
+      queue_wait      enqueue -> batch take, recorded by the dispatcher
+      coalesce        request tables -> one batch table
+      transform       the coalesced dispatch
+        place_h2d       host prep + H2D staging (prefetch thread)
+        serve.dispatch  breaker-guarded device call
+          fused_dispatch  the ONE jitted call of a fused plan
+            device_sync     the bundled fetch (device execution)
+      demux           outputs + quarantine side-tables back per caller
+
+Design rules, in the obs-registry tradition:
+
+* **Off by default, one-bool hooks.**  ``span()`` returns a shared
+  ``nullcontext`` after a single module-bool check when tracing is off,
+  and again when no trace is active on the calling thread — instrumented
+  hot paths pay nothing measurable (the serving bench asserts the <= 2%
+  disabled-overhead contract, BASELINE.json round 11).  Enable with
+  ``FMT_TRACE=1`` or :func:`enable`.
+* **Head sampling.**  ``FMT_TRACE_SAMPLE`` (0..1, default 1.0) decides at
+  trace-mint time; an unsampled request carries no context and every
+  downstream hook stays one boolean check.
+* **Explicit handoff, never ambient.**  A cross-thread consumer installs
+  the submitting request's context with :func:`use` (the dispatcher
+  installs EVERY coalesced request's context at once — batch-scope spans
+  fan out to each sampled trace with shared timestamps, so each caller's
+  waterfall is complete on its own).  A thread with no installed context
+  records nothing: a racing sibling's spans can never attach to the
+  wrong trace.
+* **Spans are JSONL.**  Every finished span appends one line to
+  ``FMT_TRACE_DIR``'s ``traces.jsonl`` (default: the reports dir) —
+  ``python -m flink_ml_tpu.obs trace`` renders a waterfall from it.
+
+Knobs (BASELINE.md round-11 table): ``FMT_TRACE``, ``FMT_TRACE_SAMPLE``,
+``FMT_TRACE_DIR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import random
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "RequestTrace",
+    "SpanContext",
+    "attr",
+    "current",
+    "current_trace_ids",
+    "enable",
+    "enabled",
+    "flush",
+    "main",
+    "record_span",
+    "render_waterfall",
+    "reset",
+    "root_span",
+    "sample_rate",
+    "span",
+    "start_request",
+    "traces_path",
+    "use",
+]
+
+
+from flink_ml_tpu.obs.registry import _env_truthy
+
+_ENABLED = _env_truthy("FMT_TRACE")
+
+#: the serving shed vocabulary (serving/errors.py SHED_* codes) — spans
+#: ended by an exception carrying one of THESE reasons are load sheds,
+#: not failures.  Matched by value, not type: this module must stay
+#: importable without the serving package (and stdlib exceptions like
+#: UnicodeDecodeError carry an unrelated ``.reason`` attribute).
+_SHED_REASONS = frozenset(
+    ("queue_full", "deadline_expired", "breaker_open", "shutdown")
+)
+try:
+    _SAMPLE = float(os.environ.get("FMT_TRACE_SAMPLE", "") or 1.0)
+except ValueError:
+    _SAMPLE = 1.0
+
+_RNG = random.Random()  # OS-seeded; head-sampling only, never correctness
+
+
+def enabled() -> bool:
+    """Is span tracing on for this process?"""
+    return _ENABLED
+
+
+def enable(on: bool = True, sample: Optional[float] = None) -> None:
+    """Turn tracing on/off; optionally set the head-sampling rate."""
+    global _ENABLED, _SAMPLE
+    _ENABLED = bool(on)
+    if sample is not None:
+        _SAMPLE = float(sample)
+
+
+def sample_rate() -> float:
+    return _SAMPLE
+
+
+def _sampled() -> bool:
+    if _SAMPLE >= 1.0:
+        return True
+    if _SAMPLE <= 0.0:
+        return False
+    return _RNG.random() < _SAMPLE
+
+
+def _mint_id() -> str:
+    return os.urandom(8).hex()
+
+
+class SpanContext:
+    """One (trace, parent span) coordinate a child span attaches under.
+
+    Immutable and tiny by design: contexts cross thread boundaries inside
+    queued requests and prefetch closures."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+# -- the sink -----------------------------------------------------------------
+
+#: recent finished spans, in-memory (tests; waterfall without a file)
+_RECENT_CAP = 4096
+_SINK_LOCK = threading.Lock()
+_RECENT: deque = deque(maxlen=_RECENT_CAP)
+_FILE = None
+_FILE_PATH: Optional[str] = None
+_WRITE_FAILED = False
+
+
+def traces_path() -> str:
+    """``FMT_TRACE_DIR``'s (or the reports dir's) ``traces.jsonl``."""
+    d = os.environ.get("FMT_TRACE_DIR")
+    if not d:
+        from flink_ml_tpu.obs.report import reports_dir
+
+        d = reports_dir()
+    return os.path.join(d, "traces.jsonl")
+
+
+#: lines not yet flushed to the sink file — flushed when a ROOT span
+#: lands (a trace just completed: make it readable) or the buffer grows
+#: past the cap, NOT per span: per-span flushes put file I/O inside every
+#: sampled request's hot path and were the dominant enabled-at-1% cost
+_PENDING: list = []
+_PENDING_CAP = 256
+
+
+def _emit(record: dict) -> None:
+    """Append one finished span to the in-memory ring and the (buffered)
+    JSONL sink.  I/O failures are swallowed after one flag flip —
+    tracing must never fail the request it is describing."""
+    with _SINK_LOCK:
+        _RECENT.append(record)
+        if _WRITE_FAILED:
+            return
+        _PENDING.append(json.dumps(record, sort_keys=True))
+        if not record.get("parent_id") or len(_PENDING) >= _PENDING_CAP:
+            _flush_locked()
+
+
+def _flush_locked() -> None:
+    global _FILE, _FILE_PATH, _WRITE_FAILED
+    if not _PENDING:
+        return
+    try:
+        path = traces_path()
+        if _FILE is None or _FILE_PATH != path:
+            if _FILE is not None:
+                _FILE.close()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _FILE = open(path, "a")  # noqa: SIM115 - cached handle
+            _FILE_PATH = path
+        _FILE.write("\n".join(_PENDING) + "\n")
+        _FILE.flush()
+        _PENDING.clear()
+    except OSError:
+        _WRITE_FAILED = True
+        _PENDING.clear()
+
+
+def flush() -> None:
+    """Force any buffered span lines to the sink file (tests; shutdown)."""
+    with _SINK_LOCK:
+        _flush_locked()
+
+
+def recent_spans() -> List[dict]:
+    """Finished spans still in the in-memory ring (newest last)."""
+    with _SINK_LOCK:
+        return list(_RECENT)
+
+
+def reset() -> None:
+    """Drop the in-memory ring and the cached sink handle (tests)."""
+    global _FILE, _FILE_PATH, _WRITE_FAILED
+    with _SINK_LOCK:
+        _RECENT.clear()
+        _PENDING.clear()
+        if _FILE is not None:
+            try:
+                _FILE.close()
+            except OSError:
+                pass
+        _FILE = None
+        _FILE_PATH = None
+        _WRITE_FAILED = False
+
+
+# -- span frames --------------------------------------------------------------
+
+
+class _Frame:
+    """One open span on a thread's stack.
+
+    ``parents`` is a tuple of :class:`SpanContext` — usually one, several
+    when the dispatcher serves a coalesced batch (the span then records
+    once per parent trace, same span_id and timestamps).  ``span_id`` of
+    ``None`` marks a pass-through frame installed by :func:`use`: it
+    parents children but records no span of its own."""
+
+    __slots__ = ("parents", "span_id", "name", "ts", "t0", "attrs")
+
+    def __init__(self, parents, span_id, name, attrs):
+        self.parents = tuple(parents)
+        self.span_id = span_id
+        self.name = name
+        self.ts = time.time()
+        self.t0 = time.perf_counter()
+        self.attrs = dict(attrs) if attrs else {}
+
+
+_TLS = threading.local()
+_NULL = contextlib.nullcontext()
+
+
+def _frames() -> Optional[list]:
+    return getattr(_TLS, "frames", None)
+
+
+def current() -> Tuple[SpanContext, ...]:
+    """The calling thread's active context(s) — what a child span (or a
+    cross-thread handoff) should parent under.  Empty when no trace is
+    active here."""
+    frames = _frames()
+    if not frames:
+        return ()
+    f = frames[-1]
+    if f.span_id is None:  # pass-through (use()) frame
+        return f.parents
+    return tuple(SpanContext(p.trace_id, f.span_id) for p in f.parents)
+
+
+def current_trace_ids() -> Tuple[str, ...]:
+    """Trace ids active on this thread (deduplicated, order kept)."""
+    seen = []
+    for c in current():
+        if c.trace_id not in seen:
+            seen.append(c.trace_id)
+    return tuple(seen)
+
+
+def _record(parents, span_id, name, ts, dur_s, attrs, status) -> None:
+    thread = threading.current_thread().name
+    for p in parents:
+        _emit({
+            "trace_id": p.trace_id,
+            "span_id": span_id,
+            "parent_id": p.span_id,
+            "name": name,
+            "ts": ts,
+            "dur_s": dur_s,
+            "status": status,
+            "thread": thread,
+            "attrs": attrs or {},
+        })
+
+
+@contextlib.contextmanager
+def _span_cm(parents, name, attrs):
+    frames = _frames()
+    if frames is None:
+        frames = _TLS.frames = []
+    frame = _Frame(parents, _mint_id(), name, attrs)
+    frames.append(frame)
+    status = "ok"
+    try:
+        yield frame
+    except BaseException as exc:
+        status = ("shed" if getattr(exc, "reason", None) in _SHED_REASONS
+                  else "error")
+        frame.attrs.setdefault("error", type(exc).__name__)
+        raise
+    finally:
+        dur = time.perf_counter() - frame.t0
+        frames.pop()
+        _record(frame.parents, frame.span_id, frame.name, frame.ts, dur,
+                frame.attrs, status)
+
+
+def span(name: str, attrs: Optional[dict] = None):
+    """Context manager recording a child span of this thread's active
+    trace(s).  One boolean check when tracing is off, and again when no
+    trace is active on this thread — a no-trace hot path never builds a
+    frame."""
+    if not _ENABLED:
+        return _NULL
+    parents = current()
+    if not parents:
+        return _NULL
+    return _span_cm(parents, name, attrs)
+
+
+def root_span(name: str, attrs: Optional[dict] = None):
+    """Context manager minting a NEW trace — unless a trace is already
+    active on this thread, in which case it degrades to a plain child
+    span (a transform inside a served request must not re-root).  Head
+    sampling applies only at the true mint."""
+    if not _ENABLED:
+        return _NULL
+    parents = current()
+    if parents:
+        return _span_cm(parents, name, attrs)
+    if not _sampled():
+        return _NULL
+    return _span_cm((SpanContext(_mint_id(), ""),), name, attrs)
+
+
+@contextlib.contextmanager
+def _use_cm(parents):
+    frames = _frames()
+    if frames is None:
+        frames = _TLS.frames = []
+    frames.append(_Frame(parents, None, None, None))
+    try:
+        yield
+    finally:
+        frames.pop()
+
+
+def use(parents: Sequence[SpanContext]):
+    """Install already-minted context(s) on THIS thread without opening a
+    span — the explicit cross-thread handoff.  The dispatcher installs
+    every coalesced request's context at once; the prefetch producer
+    installs its consumer's.  No-op (shared nullcontext) when tracing is
+    off or ``parents`` is empty."""
+    if not _ENABLED or not parents:
+        return _NULL
+    return _use_cm(tuple(parents))
+
+
+def attr(key: str, value) -> None:
+    """Set an attribute on the innermost OPEN span of this thread (skipping
+    pass-through frames).  One boolean check when tracing is off."""
+    if not _ENABLED:
+        return
+    frames = _frames()
+    if not frames:
+        return
+    for f in reversed(frames):
+        if f.span_id is not None:
+            f.attrs[key] = value
+            return
+
+
+def record_span(parents: Sequence[SpanContext], name: str, dur_s: float,
+                attrs: Optional[dict] = None, status: str = "ok",
+                end_ts: Optional[float] = None) -> None:
+    """Record a span whose boundaries were measured elsewhere (the
+    dispatcher's ``queue_wait`` spans the enqueue-to-take window; the
+    fused trainer's dispatch/sync splits are computed post-hoc).  ``ts``
+    is derived as ``end_ts - dur_s`` (wall now when ``end_ts`` is None)."""
+    if not _ENABLED or not parents:
+        return
+    ts = (end_ts if end_ts is not None else time.time()) - max(dur_s, 0.0)
+    _record(tuple(parents), _mint_id(), name, ts, max(dur_s, 0.0),
+            attrs, status)
+
+
+class RequestTrace:
+    """A root span whose start and end live on DIFFERENT threads (minted
+    at ``ModelServer.submit`` on the caller thread, ended by the
+    dispatcher when the future resolves) — so it cannot ride the
+    thread-local stack.  ``ctx`` is what children and handoffs parent
+    under; :meth:`end` is single-shot and thread-safe."""
+
+    __slots__ = ("trace_id", "ctx", "name", "ts", "t0", "attrs", "_done")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.trace_id = _mint_id()
+        self.ctx = SpanContext(self.trace_id, _mint_id())
+        self.name = name
+        self.ts = time.time()
+        self.t0 = time.perf_counter()
+        self.attrs = dict(attrs) if attrs else {}
+        self._done = False
+
+    def end(self, status: str = "ok",
+            attrs: Optional[dict] = None) -> None:
+        if self._done:  # benign double-end (error path + finally)
+            return
+        self._done = True
+        if attrs:
+            self.attrs.update(attrs)
+        _record((SpanContext(self.trace_id, ""),), self.ctx.span_id,
+                self.name, self.ts, time.perf_counter() - self.t0,
+                self.attrs, status)
+
+
+def start_request(name: str,
+                  attrs: Optional[dict] = None) -> Optional[RequestTrace]:
+    """Mint a request-scoped root trace (head sampling applies); ``None``
+    when tracing is off or the request was sampled out — the whole
+    request then costs one boolean per downstream hook."""
+    if not _ENABLED or not _sampled():
+        return None
+    return RequestTrace(name, attrs)
+
+
+# -- the waterfall ------------------------------------------------------------
+
+
+def load_spans(path: Optional[str] = None) -> List[dict]:
+    """All span records from the JSONL sink (empty when absent; malformed
+    lines — a crash mid-write — are skipped, a black box must open)."""
+    path = path or traces_path()
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def trace_ids(spans: List[dict]) -> List[str]:
+    """Distinct trace ids in first-seen order."""
+    seen: List[str] = []
+    for s in spans:
+        t = s.get("trace_id")
+        if t and t not in seen:
+            seen.append(t)
+    return seen
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    parts = [f"{k}={attrs[k]}" for k in sorted(attrs)]
+    s = " ".join(parts)
+    return s if len(s) <= 72 else s[:69] + "..."
+
+
+def render_waterfall(spans: List[dict], trace_id: str,
+                     width: int = 40) -> str:
+    """One trace's spans as an indented text waterfall.
+
+    Rows sort children under parents in start order; the bar shows each
+    span's [offset, offset+dur) window against the trace's full extent.
+    Duplicate (span_id, parent) lines — a resumed sink — keep the first.
+    """
+    mine = [s for s in spans if s.get("trace_id") == trace_id]
+    if not mine:
+        return f"no spans for trace {trace_id}"
+    seen = set()
+    uniq = []
+    for s in mine:
+        k = (s.get("span_id"), s.get("parent_id"), s.get("name"))
+        if k in seen:
+            continue
+        seen.add(k)
+        uniq.append(s)
+    by_parent: Dict[str, List[dict]] = {}
+    for s in uniq:
+        by_parent.setdefault(s.get("parent_id") or "", []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("ts", 0.0))
+    t_lo = min(s.get("ts", 0.0) for s in uniq)
+    t_hi = max(s.get("ts", 0.0) + s.get("dur_s", 0.0) for s in uniq)
+    total = max(t_hi - t_lo, 1e-9)
+    name_w = max(
+        len(s.get("name", "")) + 2 * _depth_of(s, uniq) for s in uniq
+    )
+    lines = [
+        f"trace {trace_id}  ({total * 1e3:.1f} ms, {len(uniq)} span(s))"
+    ]
+
+    def walk(parent_id: str, depth: int):
+        for s in by_parent.get(parent_id, ()):
+            off = s.get("ts", 0.0) - t_lo
+            dur = s.get("dur_s", 0.0)
+            lo = int(round(off / total * width))
+            hi = max(int(round((off + dur) / total * width)), lo + 1)
+            bar = " " * lo + "█" * min(hi - lo, width - lo)
+            label = "  " * depth + s.get("name", "?")
+            status = s.get("status", "ok")
+            mark = "" if status == "ok" else f" !{status}"
+            lines.append(
+                f"  {label:<{name_w}} {off * 1e3:>8.2f}ms "
+                f"{dur * 1e3:>8.2f}ms |{bar:<{width}}|{mark}"
+                + (f"  {_fmt_attrs(s.get('attrs') or {})}"
+                   if s.get("attrs") else "")
+            )
+            walk(s.get("span_id", ""), depth + 1)
+
+    walk("", 0)
+    # orphans (parent span lost — e.g. the ring rolled): render flat
+    known = {s.get("span_id") for s in uniq} | {""}
+    for s in uniq:
+        if s.get("parent_id") not in known:
+            off = s.get("ts", 0.0) - t_lo
+            lines.append(
+                f"  ~{s.get('name', '?'):<{name_w}} {off * 1e3:>7.2f}ms "
+                f"{s.get('dur_s', 0.0) * 1e3:>8.2f}ms (orphan)"
+            )
+    return "\n".join(lines)
+
+
+def _depth_of(s: dict, spans: List[dict]) -> int:
+    by_id = {x.get("span_id"): x for x in spans}
+    d, cur, hops = 0, s, 0
+    while cur.get("parent_id") and hops < 32:
+        cur = by_id.get(cur["parent_id"])
+        if cur is None:
+            break
+        d += 1
+        hops += 1
+    return d
+
+
+def main(argv=None) -> int:
+    """``python -m flink_ml_tpu.obs trace [TRACE_ID]`` — render one
+    trace's waterfall from the JSONL sink (latest root trace when no id
+    is given); ``--list`` enumerates traces instead."""
+    parser = argparse.ArgumentParser(
+        prog="python -m flink_ml_tpu.obs trace",
+        description="Render a span waterfall from the traces.jsonl sink.",
+    )
+    parser.add_argument("trace_id", nargs="?", default=None,
+                        help="trace to render (default: the latest)")
+    parser.add_argument("--traces", default=None,
+                        help="traces.jsonl path (default: FMT_TRACE_DIR "
+                             "or the reports dir)")
+    parser.add_argument("--list", action="store_true",
+                        help="list trace ids with their root span instead")
+    parser.add_argument("--width", type=int, default=40)
+    args = parser.parse_args(argv)
+
+    spans = load_spans(args.traces)
+    if not spans:
+        print(f"no spans in {args.traces or traces_path()} — run with "
+              "FMT_TRACE=1 first")
+        return 1
+    if args.list:
+        roots = {
+            s["trace_id"]: s for s in spans if not s.get("parent_id")
+        }
+        for tid in trace_ids(spans):
+            r = roots.get(tid)
+            desc = (f"{r.get('name')}  {r.get('dur_s', 0) * 1e3:.1f}ms "
+                    f"[{r.get('status')}]" if r else "(no root span)")
+            print(f"{tid}  {desc}")
+        return 0
+    tid = args.trace_id
+    if tid is None:
+        ids = trace_ids(spans)
+        tid = ids[-1]
+    print(render_waterfall(spans, tid, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._state = _CLOSED
+        self._published_state: Optional[float] = None
         # half-open probe bookkeeping: exactly ONE caller owns the probe
         # (concurrent serving callers hammering an open breaker must not
         # all ride through the cooldown edge at once — that was a probe
@@ -104,6 +105,13 @@ class CircuitBreaker:
         global _STATE_GEN
         _STATE_GEN += 1  # invalidates cross-breaker state memos (serving)
         obs.gauge_set(f"serve.breaker_state.{self.name}", self._state)
+        if self._state != self._published_state:
+            # actual state TRANSITIONS land in the flight recorder: the
+            # black box dumped on breaker-open shows the closed->open
+            # walk (and every shed around it) in causal order
+            obs.flight.record("breaker.state", name=self.name,
+                              state=self._state, failures=self._failures)
+            self._published_state = self._state
 
     @property
     def state(self) -> float:
@@ -168,14 +176,20 @@ class CircuitBreaker:
         return local_ok
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._failures += 1
             if self._state == _HALF_OPEN or self._failures >= _threshold():
+                opened = self._state != _OPEN
                 self._state = _OPEN
                 self._opened_at = time.monotonic()
             self._probing = False
             self._probe_started = None
             self._publish()
+        if opened:
+            # breaker-open is a black-box moment: dump the ring OUTSIDE
+            # the breaker lock (the dump does file I/O; rate-limited)
+            obs.flight.dump("breaker_open")
 
     def record_success(self) -> None:
         with self._lock:
@@ -254,46 +268,64 @@ def dispatch(name: str, device: Callable, fallback: Optional[Callable] = None,
     if fallback is not None and not brk.allow_device(agreed=agreed):
         obs.counter_add("serve.fallbacks")
         obs.counter_add(f"serve.fallbacks.{name}")
-        with obs.phase("serve.fallback"):
+        obs.flight.record("serve.fallback", surface=name,
+                          cause="breaker_open")
+        with obs.phase("serve.fallback"), obs.trace.span(
+                "serve.fallback", {"surface": name,
+                                   "cause": "breaker_open"}):
             return fallback()
 
+    attempts = [0]
+
     def attempt():
+        attempts[0] += 1
         maybe_fail("serve.dispatch")
         return device()
 
     t0 = time.perf_counter()
-    try:
-        out = with_retry(attempt, "serve.dispatch")
-    except BaseException as exc:  # noqa: BLE001 - transient-filtered below
-        if not is_transient(exc) or fallback is None:
-            raise
-        brk.record_failure()
-        obs.counter_add("serve.dispatch_failures")
-        obs.counter_add(f"serve.dispatch_failures.{name}")
-        obs.counter_add("serve.fallbacks")
-        obs.counter_add(f"serve.fallbacks.{name}")
-        warnings.warn(
-            f"device dispatch for {name!r} failed after retries "
-            f"({type(exc).__name__}: {exc}); serving this batch from the "
-            "CPU fallback path",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-        with obs.phase("serve.fallback"):
-            return fallback()
-    dt_ms = (time.perf_counter() - t0) * 1e3
-    obs.observe("serve.deadline_ms", dt_ms)
-    deadline = _deadline_ms()
-    if deadline > 0 and dt_ms > deadline:
-        # a chronically slow device degrades like a failing one: overruns
-        # feed the breaker, and enough of them route traffic to the CPU
-        obs.counter_add("serve.deadline_exceeded")
-        obs.counter_add(f"serve.deadline_exceeded.{name}")
-        brk.record_failure()
-    else:
-        brk.record_success()
-    obs.counter_add("serve.device_ok")
-    return out
+    with obs.trace.span("serve.dispatch", {"surface": name,
+                                           "breaker_state": brk.state}):
+        try:
+            out = with_retry(attempt, "serve.dispatch")
+        except BaseException as exc:  # noqa: BLE001 - transient-filtered
+            if not is_transient(exc) or fallback is None:
+                raise
+            brk.record_failure()
+            obs.counter_add("serve.dispatch_failures")
+            obs.counter_add(f"serve.dispatch_failures.{name}")
+            obs.counter_add("serve.fallbacks")
+            obs.counter_add(f"serve.fallbacks.{name}")
+            obs.flight.record("serve.fallback", surface=name,
+                              cause="dispatch_failed",
+                              error=type(exc).__name__,
+                              attempts=attempts[0])
+            warnings.warn(
+                f"device dispatch for {name!r} failed after retries "
+                f"({type(exc).__name__}: {exc}); serving this batch from "
+                "the CPU fallback path",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            obs.trace.attr("retries", attempts[0] - 1)
+            obs.trace.attr("fallback", True)
+            with obs.phase("serve.fallback"):
+                return fallback()
+        obs.trace.attr("retries", attempts[0] - 1)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        obs.observe("serve.deadline_ms", dt_ms)
+        deadline = _deadline_ms()
+        if deadline > 0 and dt_ms > deadline:
+            # a chronically slow device degrades like a failing one:
+            # overruns feed the breaker, and enough of them route traffic
+            # to the CPU
+            obs.counter_add("serve.deadline_exceeded")
+            obs.counter_add(f"serve.deadline_exceeded.{name}")
+            obs.trace.attr("deadline_exceeded", True)
+            brk.record_failure()
+        else:
+            brk.record_success()
+        obs.counter_add("serve.device_ok")
+        return out
 
 
 # -- per-transform accounting -------------------------------------------------

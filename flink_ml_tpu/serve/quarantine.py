@@ -47,6 +47,7 @@ from flink_ml_tpu.table.table import Table
 __all__ = [
     "QUARANTINE_REASON_COL",
     "QUARANTINE_ROW_COL",
+    "QUARANTINE_TRACE_COL",
     "agreed_bad_mask",
     "capture",
     "drain",
@@ -61,6 +62,10 @@ __all__ = [
 #: extra columns stamped onto quarantined rows in the side-table
 QUARANTINE_REASON_COL = "_quarantine_reason"
 QUARANTINE_ROW_COL = "_quarantine_row"
+#: the trace id(s) active when the row was quarantined ("" when tracing
+#: is off): the handle from a poisoned row back to the request waterfall
+#: that carried it — the serving demux re-stamps it per caller
+QUARANTINE_TRACE_COL = "_quarantine_trace"
 
 #: reason codes (the side-table vocabulary)
 REASON_NAN_INF = "nan_inf"
@@ -298,9 +303,11 @@ def emit(name: str, batch: Table, good_mask: np.ndarray,
     """Record ``batch``'s bad rows in ``name``'s quarantine side-table.
 
     Returns the number of rows quarantined.  The side-table row carries the
-    original columns plus ``_quarantine_reason`` (the code) and
+    original columns plus ``_quarantine_reason`` (the code),
     ``_quarantine_row`` (the row's offset in the applied table, so an
-    operator can find it in the source feed).  Counters
+    operator can find it in the source feed), and ``_quarantine_trace``
+    (the active trace id(s), "" when untraced — the handle back to the
+    request waterfall that carried the poison row).  Counters
     (``serve.quarantined_rows`` and per-reason breakdowns) always hold the
     true totals; the stored table is capped per mapper."""
     bad_mask = ~np.asarray(good_mask, dtype=bool)
@@ -315,11 +322,16 @@ def emit(name: str, batch: Table, good_mask: np.ndarray,
             int(sum(1 for r in bad_reasons if r == reason)),
         )
     rows = np.nonzero(bad_mask)[0] + int(row_offset)
+    # always stamped (empty when untraced) so side-table parts keep ONE
+    # schema and concat across traced and untraced emissions never splits
+    trace_ids = ",".join(obs.trace.current_trace_ids())
     side = (
         batch.filter_rows(bad_mask)
         .with_column(QUARANTINE_REASON_COL, DataTypes.STRING,
                      list(bad_reasons))
         .with_column(QUARANTINE_ROW_COL, DataTypes.LONG, rows)
+        .with_column(QUARANTINE_TRACE_COL, DataTypes.STRING,
+                     [trace_ids] * n_bad)
     )
     sink = getattr(_CAPTURE, "sink", None)
     if sink is not None:
@@ -386,7 +398,9 @@ def reset() -> None:
 def make_quarantine_schema(input_schema: Schema) -> Schema:
     """The side-table schema for a given input schema (docs/consumers)."""
     names = input_schema.field_names + [
-        QUARANTINE_REASON_COL, QUARANTINE_ROW_COL,
+        QUARANTINE_REASON_COL, QUARANTINE_ROW_COL, QUARANTINE_TRACE_COL,
     ]
-    types = input_schema.field_types + [DataTypes.STRING, DataTypes.LONG]
+    types = input_schema.field_types + [
+        DataTypes.STRING, DataTypes.LONG, DataTypes.STRING,
+    ]
     return Schema(names, types)

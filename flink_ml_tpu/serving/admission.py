@@ -30,7 +30,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from flink_ml_tpu import obs
-from flink_ml_tpu.serving.errors import ServerOverloadedError
+from flink_ml_tpu.serving.errors import SHED_BREAKER_OPEN, ServerOverloadedError
 
 __all__ = ["ServingConfig", "now_s", "overloaded", "shed"]
 
@@ -115,15 +115,26 @@ class ServingConfig:
         return enqueued_at + ms / 1e3
 
 
-def overloaded(reason: str, detail: str = "") -> ServerOverloadedError:
+def overloaded(reason: str, detail: str = "",
+               trace_id=None) -> ServerOverloadedError:
     """Count one shed and build its reason-coded error.  EVERY shed —
     synchronous rejection at submit, queued-future sheds, no-drain
     shutdown — goes through here or :func:`shed`, so the
     ``serving.shed.<reason>`` counters can never drift from the errors
-    callers actually see."""
+    callers actually see.  Each shed also lands in the flight-recorder
+    ring (with the shed request's ``trace_id`` when it has one), so a
+    black box dumped moments later shows WHO was turned away and why."""
     obs.counter_add("serving.shed")
     obs.counter_add(f"serving.shed.{reason}")
-    return ServerOverloadedError(reason, detail)
+    obs.flight.record("serving.shed", reason=reason, detail=detail,
+                      trace_id=trace_id)
+    if reason == SHED_BREAKER_OPEN:
+        # turning traffic away because the dispatch path is DEGRADED is a
+        # black-box moment (a queue_full shed is just load): the dump now
+        # holds the closed->open breaker walk AND the shed it caused, in
+        # ring order.  Rate-limited like every dump reason.
+        obs.flight.dump("breaker_open_shed")
+    return ServerOverloadedError(reason, detail, trace_id=trace_id)
 
 
 def shed(request, reason: str, detail: str = "") -> None:
@@ -136,7 +147,13 @@ def shed(request, reason: str, detail: str = "") -> None:
     runs its done-callbacks synchronously, and a callback that touches
     the server (a shed-retry ``submit``) would re-enter under the lock
     mid-queue-iteration."""
-    exc = overloaded(reason, detail)
+    req_trace = getattr(request, "trace", None)
+    exc = overloaded(
+        reason, detail,
+        trace_id=req_trace.trace_id if req_trace is not None else None,
+    )
+    if req_trace is not None:
+        req_trace.end(status="shed", attrs={"shed_reason": reason})
     try:
         request.future.set_exception(exc)
     except InvalidStateError:

@@ -35,7 +35,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from flink_ml_tpu.serve.quarantine import QUARANTINE_ROW_COL
+from flink_ml_tpu.serve.quarantine import (
+    QUARANTINE_ROW_COL,
+    QUARANTINE_TRACE_COL,
+)
 from flink_ml_tpu.table.schema import DataTypes
 from flink_ml_tpu.table.table import Table
 
@@ -44,12 +47,19 @@ __all__ = ["ServeRequest", "ServeResult", "coalesce", "demux"]
 
 @dataclass
 class ServeRequest:
-    """One caller's rows plus the future that will carry them back."""
+    """One caller's rows plus the future that will carry them back.
+
+    ``trace`` is the request's root span
+    (:class:`~flink_ml_tpu.obs.trace.RequestTrace`, minted at submit,
+    None when tracing is off or the request was sampled out) — the
+    explicit handoff object the dispatcher thread parents its batch
+    spans under."""
 
     table: Table
     future: Future
     enqueued_at: float
     deadline_at: Optional[float] = None  # absolute monotonic; None = none
+    trace: Optional[object] = None
     n_rows: int = field(init=False)
 
     def __post_init__(self):
@@ -100,6 +110,7 @@ def demux(
     captured: Sequence[Tuple[str, Table, int]],
     spans: Sequence[Tuple[int, int]],
     version: str,
+    trace_ids: Optional[Sequence[Optional[str]]] = None,
 ) -> List[ServeResult]:
     """Split a coalesced transform's outputs back per request.
 
@@ -110,6 +121,11 @@ def demux(
     global coalesced offsets.  Raises ``RuntimeError`` on row
     misalignment (served + quarantined must account for every input row —
     a demux that guessed would hand callers other callers' rows).
+
+    ``trace_ids`` (span-aligned, entries None for untraced requests)
+    re-stamps each request's quarantine rows with that REQUEST's own
+    trace id: the emitter stamped the batch-scope trace(s), but once the
+    rows are attributed to a caller the precise id is known.
     """
     total = spans[-1][1] if spans else 0
     kept = np.ones(total, dtype=bool)
@@ -149,10 +165,11 @@ def demux(
     # output position of each kept input row: exclusive prefix sum
     out_pos = np.cumsum(kept) - kept.astype(np.int64)
     results: List[ServeResult] = []
-    for lo, hi in spans:
+    for i, (lo, hi) in enumerate(spans):
         span_kept = int(kept[lo:hi].sum())
         start = int(out_pos[lo]) if hi > lo else 0
         table = out.slice_rows(start, start + span_kept)
+        trace_id = trace_ids[i] if trace_ids is not None else None
         quarantine: Dict[str, Table] = {}
         for name, side, rows in side_rows:
             mask = (rows >= lo) & (rows < hi)
@@ -161,6 +178,13 @@ def demux(
             part = side.filter_rows(mask).with_column(
                 QUARANTINE_ROW_COL, DataTypes.LONG, rows[mask] - lo
             )
+            if side.schema.contains(QUARANTINE_TRACE_COL):
+                # the emitter stamped the batch-scope trace(s); the rows
+                # are now attributed to ONE caller, so stamp its exact id
+                part = part.with_column(
+                    QUARANTINE_TRACE_COL, DataTypes.STRING,
+                    [trace_id or ""] * part.num_rows(),
+                )
             if name in quarantine:
                 part = Table.concat([quarantine[name], part])
             quarantine[name] = part

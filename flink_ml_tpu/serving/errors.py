@@ -33,14 +33,19 @@ class ServerOverloadedError(RuntimeError):
     melts down.  ``reason`` is one of the ``SHED_*`` codes
     (``queue_full`` / ``deadline_expired`` / ``breaker_open`` /
     ``shutdown``); the matching ``serving.shed.<reason>`` counter moved by
-    one.
+    one.  ``trace_id`` carries the shed request's trace (None when
+    tracing is off or the request was sampled out) — the handle that
+    finds the request in the span sink and the flight-recorder ring.
     """
 
-    def __init__(self, reason: str, detail: str = ""):
+    trace_id = None
+
+    def __init__(self, reason: str, detail: str = "", trace_id=None):
         super().__init__(
             f"request shed ({reason})" + (f": {detail}" if detail else "")
         )
         self.reason = reason
+        self.trace_id = trace_id
 
 
 class ServerClosedError(RuntimeError):

@@ -39,6 +39,14 @@ Telemetry: ``serving.requests`` / ``request_rows`` / ``batches`` /
 histogram (p50/p99 via the registry's timing quantiles) — all landing in
 a ``serving`` RunReport at shutdown.
 
+Tracing (ISSUE 8, ``FMT_TRACE``): every submit mints a per-request
+``trace_id`` (head-sampled via ``FMT_TRACE_SAMPLE``); the dispatcher
+hands the context across its thread explicitly, so one request renders
+as one ``submit -> queue_wait -> coalesce -> transform -> demux``
+waterfall (``python -m flink_ml_tpu.obs trace``), sheds stamp the
+``trace_id`` into ``ServerOverloadedError`` and the flight-recorder
+ring, and quarantined rows carry it in their side-table.
+
 Knobs (BASELINE.md round-10 table): ``FMT_SERVING_MAX_BATCH``,
 ``FMT_SERVING_MAX_WAIT_MS``, ``FMT_SERVING_QUEUE_CAP``,
 ``FMT_SERVING_DEADLINE_MS``, ``FMT_SERVING_SHED_ON_BREAKER``.
@@ -47,6 +55,7 @@ Knobs (BASELINE.md round-10 table): ``FMT_SERVING_MAX_BATCH``,
 from __future__ import annotations
 
 import threading
+import time
 from collections import Counter, deque
 from concurrent.futures import Future
 from typing import Deque, List, Optional
@@ -276,6 +285,12 @@ class ModelServer:
                 f"({limit}); a request that large is a table, not a "
                 "request — call transform directly"
             )
+        # the request's trace root (None when tracing is off / sampled
+        # out): minted HERE so even a synchronous admission shed carries
+        # a trace_id, and every downstream hop parents under one context
+        t_submit = time.perf_counter()
+        req_trace = obs.trace.start_request("serving.request", {"rows": n})
+        trace_id = req_trace.trace_id if req_trace is not None else None
         # breaker admission reads no queue state: check it OUTSIDE the
         # condition lock so every submit doesn't serialize a scan of all
         # breakers against the dispatcher's wakeups.  Only breakers on
@@ -286,21 +301,31 @@ class ModelServer:
             if open_names:
                 self._tally("serving.shed")
                 self._tally(f"serving.shed.{SHED_BREAKER_OPEN}")
+                if req_trace is not None:
+                    req_trace.end(status="shed", attrs={
+                        "shed_reason": SHED_BREAKER_OPEN,
+                        "breaker": open_names[0],
+                    })
                 raise overloaded(
                     SHED_BREAKER_OPEN,
                     f"circuit breaker open for {open_names[0]!r} — "
                     "refusing to queue onto a degraded dispatch path",
+                    trace_id=trace_id,
                 )
         now = now_s()
         request = ServeRequest(
             table=table, future=Future(), enqueued_at=now,
             deadline_at=self.config.deadline_at(now, deadline_ms),
+            trace=req_trace,
         )
         expired: List[ServeRequest] = []
         rejected = None
         try:
             with self._cond:
                 if self._closed or self._stopping:
+                    if req_trace is not None:
+                        req_trace.end(status="error",
+                                      attrs={"error": "ServerClosedError"})
                     raise ServerClosedError("server is shut down")
                 if self._queued_rows + n > self.config.queue_cap:
                     # make room by shedding what can no longer be served
@@ -324,7 +349,16 @@ class ModelServer:
         if rejected is not None:
             self._tally("serving.shed")
             self._tally(f"serving.shed.{SHED_QUEUE_FULL}")
-            raise overloaded(SHED_QUEUE_FULL, rejected)
+            if req_trace is not None:
+                req_trace.end(status="shed",
+                              attrs={"shed_reason": SHED_QUEUE_FULL})
+            raise overloaded(SHED_QUEUE_FULL, rejected, trace_id=trace_id)
+        if req_trace is not None:
+            # the admission + enqueue window, on the caller thread
+            obs.trace.record_span(
+                (req_trace.ctx,), "submit",
+                time.perf_counter() - t_submit, {"rows": n},
+            )
         self._tally("serving.requests")
         self._tally("serving.request_rows", n)
         obs.counter_add("serving.requests")
@@ -414,6 +448,7 @@ class ModelServer:
         cfg = self.config
         while True:
             expired: List[ServeRequest] = []
+            cancelled: List = []  # RequestTraces of drops, ended unlocked
             try:
                 with self._cond:
                     while True:
@@ -428,7 +463,7 @@ class ModelServer:
                                 or now >= flush_at
                                 or self._stopping
                             ):
-                                return self._take_locked()
+                                return self._take_locked(cancelled)
                             if expired:
                                 break  # shed first, then come back
                             self._cond.wait(timeout=flush_at - now)
@@ -439,17 +474,25 @@ class ModelServer:
                                 break
                             self._cond.wait()
             finally:
+                # cancellation is a terminal outcome too: a sampled
+                # cancelled request's root span must still land (outside
+                # the lock — ending a root flushes the span sink)
+                for tr in cancelled:
+                    tr.end(status="cancelled")
                 for r in expired:
                     self._shed(r, SHED_DEADLINE,
                                "deadline passed while waiting in queue")
 
-    def _take_locked(self) -> List[ServeRequest]:
+    def _take_locked(self, cancelled: Optional[List] = None,
+                     ) -> List[ServeRequest]:
         """Pop whole requests up to ``max_batch`` rows (an oversized
         request serves alone; a schema change cuts the batch so coalesce
         never mixes schemas).  Each taken request transitions its future
         to RUNNING — a request whose caller cancelled it while queued is
-        dropped here, and a RUNNING future can no longer be cancelled, so
-        result delivery cannot race a cancellation."""
+        dropped here (its trace appended to ``cancelled`` for the CALLER
+        to end once the lock is released), and a RUNNING future can no
+        longer be cancelled, so result delivery cannot race a
+        cancellation."""
         taken: List[ServeRequest] = []
         rows = 0
         dropped = 0
@@ -464,6 +507,8 @@ class ModelServer:
             self._queue.popleft()
             if not r.future.set_running_or_notify_cancel():
                 dropped += r.n_rows  # cancelled while queued
+                if r.trace is not None and cancelled is not None:
+                    cancelled.append(r.trace)
                 continue
             schema = r.table.schema
             taken.append(r)
@@ -495,30 +540,75 @@ class ModelServer:
 
     def _serve_batch(self, requests: List[ServeRequest]) -> None:
         """One coalesced dispatch: snapshot the active version, transform
-        under quarantine capture, demux, resolve futures."""
+        under quarantine capture, demux, resolve futures.
+
+        Trace handoff: the dispatcher installs EVERY sampled request's
+        context at once (``trace.use``), so the batch-scope spans —
+        coalesce, the transform (and the fused plan's place/dispatch/sync
+        spans under it), demux — fan out to each participating trace with
+        shared timestamps: every caller's waterfall is complete on its
+        own, and a racing sibling's spans can never cross over."""
+        from flink_ml_tpu.obs import trace
         from flink_ml_tpu.serve import quarantine
+        from flink_ml_tpu.serve.quarantine import QUARANTINE_REASON_COL
 
         if not requests:
             return  # every taken request was cancelled while queued
         version = self._versions.active()  # in-flight pins the old version
-        table, spans = coalesce(requests)
-        n_rows = table.num_rows()
-        try:
-            with obs.phase("serving.batch"):
-                with quarantine.capture() as captured:
-                    out = version.transform(table)
-            results = demux(out, captured, spans, version.version)
-        except BaseException as exc:  # noqa: BLE001 - futures carry it
-            self._tally("serving.failed_batches")
-            self._tally("serving.failed_requests", len(requests))
-            obs.counter_add("serving.failed_batches")
-            obs.counter_add("serving.failed_requests", len(requests))
-            for r in requests:
-                if not r.future.done():
-                    r.future.set_exception(exc)
-            return
+        traced = [r.trace for r in requests if r.trace is not None]
+        now0 = now_s()
+        for r in requests:
+            if r.trace is not None:
+                trace.record_span((r.trace.ctx,), "queue_wait",
+                                  now0 - r.enqueued_at)
+        with trace.use(tuple(t.ctx for t in traced)):
+            with trace.span("coalesce", {"requests": len(requests)}):
+                table, spans = coalesce(requests)
+            n_rows = table.num_rows()
+            try:
+                with obs.phase("serving.batch"):
+                    with trace.span("transform", {
+                        "rows": n_rows, "version": version.version,
+                    }):
+                        with quarantine.capture() as captured:
+                            out = version.transform(table)
+                with trace.span("demux"):
+                    results = demux(
+                        out, captured, spans, version.version,
+                        trace_ids=[
+                            r.trace.trace_id if r.trace is not None
+                            else None
+                            for r in requests
+                        ],
+                    )
+            except BaseException as exc:  # noqa: BLE001 - futures carry it
+                self._tally("serving.failed_batches")
+                self._tally("serving.failed_requests", len(requests))
+                obs.counter_add("serving.failed_batches")
+                obs.counter_add("serving.failed_requests", len(requests))
+                for r in requests:
+                    if r.trace is not None:  # before the future resolves
+                        r.trace.end(status="error", attrs={
+                            "error": type(exc).__name__,
+                        })
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+                return
         now = now_s()
         for r, res in zip(requests, results):
+            if r.trace is not None:
+                # end the trace BEFORE resolving the future: once the
+                # caller observes completion the whole trace must already
+                # be recorded (a caller that disables tracing right after
+                # result() must never race a trailing root-span write)
+                attrs = {"version": res.version,
+                         "quarantined": res.num_quarantined}
+                if res.num_quarantined:
+                    attrs["quarantine_reasons"] = ",".join(sorted({
+                        str(x) for t in res.quarantine.values()
+                        for x in t.col(QUARANTINE_REASON_COL)
+                    }))
+                r.trace.end(status="ok", attrs=attrs)
             r.future.set_result(res)
             latency_ms = (now - r.enqueued_at) * 1e3
             self._latencies.append(latency_ms)

@@ -116,15 +116,27 @@ class VersionManager:
                     candidate.transform(warmup)
             else:
                 obs.counter_add("serving.cold_deploys")
-        except BaseException:
+        except BaseException as exc:
             # the old version never stopped serving; the operator gets the
             # loader's diagnostic (ModelIntegrityError names the artifact)
             obs.counter_add("serving.deploy_failures")
+            # a failed deploy is a black-box moment: the ring shows what
+            # the system was doing when the bad artifact arrived
+            obs.flight.record(
+                "serving.deploy_failure", version=str(version),
+                error=type(exc).__name__, detail=str(exc),
+                source=str(model_or_path)
+                if isinstance(model_or_path, (str, os.PathLike)) else None,
+            )
+            obs.flight.dump("deploy_failure")
             raise
         with self._lock:
             swapped = self._active is not None
+            prev = self._history[-1] if self._history else None
             self._active = candidate
             self._history.append(candidate.version)
+        obs.flight.record("serving.swap", version=candidate.version,
+                          previous=prev, warmed=warmup is not None)
         if swapped:
             obs.counter_add("serving.swaps")
         obs.gauge_set("serving.versions_deployed", len(self.history))

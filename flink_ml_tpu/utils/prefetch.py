@@ -34,19 +34,30 @@ __all__ = ["prefetch_iter"]
 
 def prefetch_iter(items: Iterator, depth: int = 2,
                   name: str = "prefetch") -> Iterator:
-    """Run an iterator on a background thread, ``depth`` items ahead."""
+    """Run an iterator on a background thread, ``depth`` items ahead.
+
+    Trace handoff: the CONSUMER's active trace context is captured here
+    (at call time, on the consuming thread) and installed on the producer
+    thread — so spans the producer's work records (H2D staging, host
+    prep) attach to the submitting request's trace, never to whatever a
+    racing sibling happens to be tracing."""
+    from flink_ml_tpu.obs import trace
+
     q: queue.Queue = queue.Queue(maxsize=depth)
     done = object()
     failure: list = []
+    parents = trace.current()  # () when untraced: use() is then a no-op
 
     def work():
         try:
-            for item in items:
-                # chaos hook: a producer-thread failure must surface at
-                # the consumer (re-raise mid-stream), never vanish with
-                # the thread — the contract the fault layer leans on
-                maybe_fail("prefetch.produce")
-                q.put(item)
+            with trace.use(parents):
+                for item in items:
+                    # chaos hook: a producer-thread failure must surface
+                    # at the consumer (re-raise mid-stream), never vanish
+                    # with the thread — the contract the fault layer
+                    # leans on
+                    maybe_fail("prefetch.produce")
+                    q.put(item)
         except BaseException as exc:  # noqa: BLE001 - re-raised at consumer
             failure.append(exc)
         finally:

@@ -57,6 +57,21 @@ counterpart, against the dynamic micro-batching ``ModelServer``:
 
 plus the ``serving`` RunReport from shutdown carrying the shed/swap
 counters and the request-latency p50/p99.
+
+**Trace mode** (``--trace``, ISSUE 8): the observability counterpart —
+end-to-end request tracing plus the black-box flight recorder:
+
+  1. **waterfall** — one traced request through ``ModelServer`` must
+     yield a single trace whose ``submit -> queue_wait -> coalesce ->
+     transform -> (fused_dispatch -> device_sync) -> demux`` spans nest
+     correctly, with queue_wait + transform accounting within the
+     request's own wall time;
+  2. **black box on breaker-open** — a sticky injected dispatch fault
+     drives the breaker open; a flight-recorder dump must land
+     containing the closed->open breaker transition and the subsequent
+     ``breaker_open`` shed IN CAUSAL ORDER (ring sequence numbers), with
+     the shed event carrying the shed request's ``trace_id`` (the same
+     id stamped on its ``ServerOverloadedError``).
 """
 
 import json
@@ -592,6 +607,131 @@ def serving_main() -> int:
     return 0
 
 
+def trace_main() -> int:
+    """The tracing + flight-recorder chaos matrix (``--trace``)."""
+    import time
+
+    os.environ["FMT_TRACE"] = "1"
+    os.environ["FMT_TRACE_DIR"] = tempfile.mkdtemp(prefix="chaos_traces_")
+    os.environ["FMT_FLIGHT_DIR"] = tempfile.mkdtemp(prefix="chaos_flight_")
+    os.environ["FMT_FLIGHT_MIN_S"] = "0"  # every dump lands (test mode)
+    os.environ["FMT_OBS_REPORTS"] = tempfile.mkdtemp(
+        prefix="chaos_trace_reports_"
+    )
+    from flink_ml_tpu import fault, serve
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import flight, trace
+    from flink_ml_tpu.serving import ModelServer, ServerOverloadedError
+
+    trace.enable(True, sample=1.0)
+    table = dense_table()
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+
+    # -- leg 1: one served request -> one correctly-nested waterfall ---------
+    trace.reset()
+    serve.reset_breakers()
+    with ModelServer(model, max_wait_ms=1,
+                     warmup=table.slice_rows(0, 4)) as server:
+        t0 = time.perf_counter()
+        server.predict(table.slice_rows(0, 8), timeout=60)
+        wall_s = time.perf_counter() - t0
+    spans = trace.load_spans()
+    roots = [s for s in spans if s["name"] == "serving.request"]
+    assert len(roots) == 1, f"expected 1 request trace, got {len(roots)}"
+    tid = roots[0]["trace_id"]
+    mine = [s for s in spans if s["trace_id"] == tid]
+    by_name = {s["name"]: s for s in mine}
+    for want in ("submit", "queue_wait", "coalesce", "transform",
+                 "fused_dispatch", "device_sync", "demux"):
+        assert want in by_name, f"missing span {want!r}: {sorted(by_name)}"
+    root_id = roots[0]["span_id"]
+    for child in ("submit", "queue_wait", "coalesce", "transform", "demux"):
+        assert by_name[child]["parent_id"] == root_id, (
+            child, by_name[child]["parent_id"], root_id)
+    # fused_dispatch nests under serve.dispatch, inside the transform tree
+    by_id = {s["span_id"]: s for s in mine}
+    anc, hops = by_name["fused_dispatch"], []
+    while anc["parent_id"]:
+        anc = by_id[anc["parent_id"]]
+        hops.append(anc["name"])
+    assert hops[0] == "serve.dispatch" and "transform" in hops, hops
+    assert by_name["device_sync"]["parent_id"] == \
+        by_name["fused_dispatch"]["span_id"]
+    # the accounted hops sum within the measured request wall time
+    accounted = by_name["queue_wait"]["dur_s"] + by_name["transform"]["dur_s"]
+    assert accounted <= wall_s * 1.05, (accounted, wall_s)
+    assert roots[0]["dur_s"] <= wall_s * 1.05, (roots[0]["dur_s"], wall_s)
+    waterfall = trace.render_waterfall(spans, tid)
+    assert "fused_dispatch" in waterfall
+    print(f"  waterfall: {len(mine)} spans, correct nesting, "
+          f"queue_wait+transform {accounted * 1e3:.1f}ms within "
+          f"wall {wall_s * 1e3:.1f}ms")
+    print("\n".join("    " + line for line in waterfall.splitlines()))
+
+    # -- leg 2: sticky dispatch fault -> breaker opens -> black box ----------
+    flight.reset()
+    serve.reset_breakers()
+    os.environ["FMT_SERVE_BREAKER_THRESHOLD"] = "2"
+    os.environ["FMT_SERVE_BREAKER_COOLDOWN_S"] = "60"
+    server = ModelServer(model, max_wait_ms=1,
+                         warmup=table.slice_rows(0, 4))
+    try:
+        fault.configure("serve.dispatch@1+", seed=0)
+        # every dispatch fails -> CPU fallback still serves -> after the
+        # threshold the breaker opens and dumps the black box
+        shed_exc = None
+        for i in range(8):
+            try:
+                server.predict(table.slice_rows(i * 4, i * 4 + 4),
+                               timeout=120)
+            except ServerOverloadedError as exc:
+                shed_exc = exc
+                break
+        assert shed_exc is not None, "breaker never shed at admission"
+        assert shed_exc.reason == "breaker_open", shed_exc.reason
+        assert shed_exc.trace_id, "shed error carries no trace_id"
+    finally:
+        fault.configure(None)
+        server.shutdown()
+        serve.reset_breakers()
+        os.environ.pop("FMT_SERVE_BREAKER_THRESHOLD", None)
+        os.environ.pop("FMT_SERVE_BREAKER_COOLDOWN_S", None)
+    dump_path = flight.last_dump_path()
+    assert dump_path and os.path.exists(dump_path), (
+        "no flight-recorder dump landed on breaker-open")
+    events = [json.loads(line) for line in open(dump_path)]
+    header, events = events[0], events[1:]
+    assert header["kind"] == "flight.dump"
+    opens = [e for e in events
+             if e["kind"] == "breaker.state" and e.get("state") == 1.0]
+    sheds = [e for e in events
+             if e["kind"] == "serving.shed"
+             and e.get("reason") == "breaker_open"]
+    assert opens, f"no breaker-open transition in the dump: " \
+                  f"{sorted({e['kind'] for e in events})}"
+    assert sheds, "no breaker_open shed event in the dump"
+    assert sheds[-1].get("trace_id") == shed_exc.trace_id, (
+        sheds[-1].get("trace_id"), shed_exc.trace_id)
+    # causal order: the ring's sequence numbers put the breaker opening
+    # BEFORE the shed it caused
+    assert opens[0]["seq"] < sheds[-1]["seq"], (
+        opens[0]["seq"], sheds[-1]["seq"])
+    assert any(e["kind"] == "serve.fallback" for e in events), (
+        "no fallback events recorded before the breaker opened")
+    print(f"  black box: {len(events)} events in {dump_path}")
+    print(f"    breaker open seq={opens[0]['seq']} -> shed "
+          f"seq={sheds[-1]['seq']} trace_id={sheds[-1]['trace_id']}")
+    print("trace chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -600,6 +740,8 @@ def main() -> int:
         return serve_main()
     if "--serving" in sys.argv:
         return serving_main()
+    if "--trace" in sys.argv:
+        return trace_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -10,11 +10,20 @@ enough — the jax config must be updated before the first backend use.
 """
 
 import os
+import tempfile
 
 # the persistent compilation cache is a production warm-start feature; in
 # tests it only adds disk churn and cross-process atime races (and the
 # suite's programs are tiny), so keep it off unless a test opts in
 os.environ.setdefault("FLINK_ML_TPU_COMPILE_CACHE", "off")
+
+# flight-recorder dumps (breaker-open tests fire them) and trace sinks go
+# to a throwaway dir, not the committed reports/ — a test run must leave
+# the repo clean
+os.environ.setdefault("FMT_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="fmt_test_flight_"))
+os.environ.setdefault("FMT_TRACE_DIR",
+                      tempfile.mkdtemp(prefix="fmt_test_traces_"))
 
 #: FMT_TEST_TPU=1 runs the suite on the real TPU backend instead of the
 #: virtual CPU mesh — the only way to exercise the Mosaic-lowered (non-

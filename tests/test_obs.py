@@ -130,6 +130,9 @@ class TestRunReports:
         assert b["metrics"]["counters"]["train.epochs"] == 2
         assert b["metrics"]["timings"]["train.dispatch"] == {
             "count": 1, "total_s": 0.25, "mean_s": 0.25,
+            # tail quantiles ride along (ISSUE 8): window quantiles over
+            # the stat's recent reservoir, not delta-exact accounting
+            "p50_s": 0.25, "p99_s": 1.0,
         }
         assert c["metrics"]["counters"] == {}
         assert c["metrics"]["timings"] == {}
