@@ -63,6 +63,7 @@ import numpy as np
 
 from flink_ml_tpu import obs
 from flink_ml_tpu.common.mapper import ColumnSink, _kept_indices
+from flink_ml_tpu.fault import pressure
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
 
@@ -383,6 +384,7 @@ class FusedRun:
 
         from flink_ml_tpu.lib.common import fetch_flat
 
+        pressure.maybe_oom(n)
         t0 = time.perf_counter()
         with obs.trace.span("fused_dispatch", {
             "rows": n, "plan": self.serve_name,
@@ -423,6 +425,35 @@ class FusedRun:
         obs.observe("pipeline.fused_call_ms", dt_ms)
         obs.observe(f"pipeline.fused_call_ms.{self.serve_name}", dt_ms)
         return out
+
+    def _bisected_batch(self, mesh, t: Table, n: int, args,
+                        row_multiple: int):
+        """Pressure-aware fused dispatch for one batch (ISSUE 9).
+
+        The unsplit fast path IS :meth:`_device_batch` on the
+        pre-extracted args — zero extra work when no pressure.  On an
+        allocator OOM, :func:`~flink_ml_tpu.fault.pressure.run_bisected`
+        frees unpinned slabs, then halves the batch's row range:
+        sub-ranges re-extract their features (padded to their own ladder
+        bucket) and dispatch independently, and the fetched output
+        columns concatenate host-side.  Exact parity: every fused kernel
+        is row-independent (scores, assignments, scaling — pad rows never
+        feed real rows), so the concatenation is bit-identical to the
+        unsplit dispatch.  Validation already ran at plan entry on the
+        FULL batch, so quarantine side-tables and their original-feed row
+        offsets are untouched by the split."""
+
+        def fn(lo, hi):
+            if lo == 0 and hi == n:
+                return self._device_batch(mesh, n, args)
+            sub = t.slice_rows(lo, hi)
+            b = self._bucket(hi - lo, row_multiple)
+            sub_args = self._extract(sub, b, mesh, row_multiple)
+            return self._device_batch(mesh, hi - lo, sub_args)
+
+        return pressure.run_bisected(
+            fn, n, surface=self.serve_name, floor=max(1, row_multiple),
+        )
 
     def _staged_batch(self, t: Table, offset: int):
         """The per-stage fallback for one batch (breaker open / device
@@ -479,7 +510,9 @@ class FusedRun:
             else:
                 out = serve.dispatch(
                     self.serve_name,
-                    device=lambda: self._device_batch(mesh, n, args),
+                    device=lambda: self._bisected_batch(
+                        mesh, t, n, args, row_multiple
+                    ),
                     fallback=lambda: self._staged_batch(t, offset),
                 )
             for name in self.batch_cols:
@@ -504,9 +537,14 @@ class FusedRun:
 def _try_place(a, mesh, row_multiple: int):
     """Best-effort async H2D on the producer thread; a transient placement
     failure hands the host array through so the consumer's retried dispatch
-    (and, past that, the per-stage fallback) still gets its shot."""
+    (and, past that, the per-stage fallback) still gets its shot.  An
+    allocator OOM passes the host array through too: the placement retried
+    at dispatch time raises INSIDE the bisection wrapper, where pressure
+    recovery can split the batch (an OOM raised here would surface on the
+    prefetch producer thread, outside any recovery scope)."""
     import jax
 
+    from flink_ml_tpu.fault.pressure import is_oom
     from flink_ml_tpu.fault.retry import is_transient
 
     if not isinstance(a, np.ndarray):
@@ -518,7 +556,7 @@ def _try_place(a, mesh, row_multiple: int):
             return jax.device_put(a, NamedSharding(mesh, P("data")))
         return jax.device_put(a)
     except Exception as exc:  # noqa: BLE001 - transient-filtered
-        if not is_transient(exc):
+        if not is_transient(exc) and not is_oom(exc):
             raise
         return a
 

@@ -13,7 +13,12 @@ train path:
   rollback/retry at a backed-off learning rate, and the SIGTERM
   emergency-checkpoint path;
 * :mod:`~flink_ml_tpu.fault.watchdog` — ``FMT_AGREE_TIMEOUT_S`` watchdog
-  so a dead peer fails collectives loudly instead of hanging the fleet.
+  so a dead peer fails collectives loudly instead of hanging the fleet;
+* :mod:`~flink_ml_tpu.fault.pressure` — memory-pressure resilience
+  (ISSUE 9): allocator-OOM classification (deterministic, never retried
+  at the same size), adaptive batch bisection with exact-parity
+  host-side concatenation, slab-pool pressure eviction, and per-surface
+  AIMD recovery back to full batch size.
 
 Chaos entry point: ``python scripts/chaos_smoke.py`` (also the CI
 ``chaos-smoke`` job) runs the fast fit matrix under seeded injection and
@@ -36,6 +41,11 @@ from flink_ml_tpu.fault.injection import (  # noqa: F401
     configure_from_env,
     maybe_fail,
 )
+from flink_ml_tpu.fault.pressure import (  # noqa: F401
+    is_oom,
+    maybe_oom,
+    run_bisected,
+)
 from flink_ml_tpu.fault.retry import (  # noqa: F401
     RetryPolicy,
     is_transient,
@@ -56,8 +66,11 @@ __all__ = [
     "configure",
     "configure_from_env",
     "emergency_save",
+    "is_oom",
     "is_transient",
     "maybe_fail",
+    "maybe_oom",
+    "run_bisected",
     "preempted",
     "preemption_scope",
     "reset_preempted",

@@ -24,6 +24,12 @@ Spec grammar (comma-separated terms, configured via the environment or
     point~P      fail each call with probability P, from a per-point RNG
                  seeded by ``FMT_FAULT_SEED`` (default 0) — deterministic
                  for a fixed seed and call sequence
+    point>N      fail every call whose caller-supplied ``value`` exceeds
+                 N (value-conditioned: the hook passes
+                 ``maybe_fail(point, value=rows)``) — a deterministic
+                 fixed-capacity simulation, e.g. ``fault.oom>256`` is a
+                 256-row HBM ceiling the pressure layer's bisection
+                 must converge under
 
 e.g. ``FMT_FAULT_INJECT="place.h2d@1,spill.read@2,ckpt.save~0.2"``.
 
@@ -41,6 +47,10 @@ Planted points (grep ``maybe_fail`` for the live set):
 ``serve.dispatch``  :func:`~flink_ml_tpu.serve.breaker.dispatch` — every
                     mapper's inference device call (retried, then breaker
                     + CPU fallback)
+``fault.oom``       :func:`~flink_ml_tpu.fault.pressure.maybe_oom` — every
+                    pressure-aware dispatch (fused plans, staged applies,
+                    training placement, serving batches); pair with the
+                    value-conditioned ``fault.oom>N`` grammar
 ==================  =========================================================
 """
 
@@ -81,14 +91,16 @@ class InjectedFault(RuntimeError):
 class _Rule:
     """One parsed spec term: when does ``point`` fail?"""
 
-    __slots__ = ("point", "nth", "sticky", "prob", "rng")
+    __slots__ = ("point", "nth", "sticky", "prob", "rng", "over")
 
     def __init__(self, point: str, nth: Optional[int], sticky: bool,
-                 prob: Optional[float], seed: int):
+                 prob: Optional[float], seed: int,
+                 over: Optional[float] = None):
         self.point = point
         self.nth = nth
         self.sticky = sticky
         self.prob = prob
+        self.over = over
         if prob is not None:
             import zlib
 
@@ -102,7 +114,13 @@ class _Rule:
         else:
             self.rng = None
 
-    def fires(self, call_no: int) -> bool:
+    def fires(self, call_no: int, value=None) -> bool:
+        if self.over is not None:
+            # value-conditioned: fires exactly while the caller's size
+            # exceeds the spec threshold (no value -> no fire), so a
+            # bisection that halves under the threshold provably stops
+            # faulting — the fixed-capacity simulation contract
+            return value is not None and float(value) > self.over
         if self.prob is not None:
             return bool(self.rng.random_sample() < self.prob)
         if self.sticky:
@@ -124,7 +142,22 @@ def _parse(spec: str, seed: int) -> Dict[str, _Rule]:
         term = term.strip()
         if not term:
             continue
-        if "~" in term:
+        if ">" in term:
+            point, over = term.split(">", 1)
+            try:
+                threshold = float(over)
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {term!r}: threshold after '>' must be "
+                    "a number"
+                ) from None
+            if threshold < 0:
+                raise ValueError(
+                    f"fault spec {term!r}: threshold must be >= 0"
+                )
+            rules[point] = _Rule(point, None, False, None, seed,
+                                 over=threshold)
+        elif "~" in term:
             point, prob = term.split("~", 1)
             rules[point] = _Rule(point, None, False, float(prob), seed)
         elif "@" in term:
@@ -138,8 +171,8 @@ def _parse(spec: str, seed: int) -> Dict[str, _Rule]:
             rules[point] = _Rule(point, n, sticky, None, seed)
         else:
             raise ValueError(
-                f"fault spec term {term!r}: expected point@N, point@N+ "
-                "or point~P"
+                f"fault spec term {term!r}: expected point@N, point@N+, "
+                "point~P or point>N"
             )
     return rules
 
@@ -176,9 +209,12 @@ def active() -> bool:
     return _ACTIVE
 
 
-def maybe_fail(point: str) -> None:
+def maybe_fail(point: str, value=None) -> None:
     """The planted hook: raise :class:`InjectedFault` when ``point``'s
-    schedule says this call fails.  One module-bool check when inactive."""
+    schedule says this call fails.  One module-bool check when inactive.
+    ``value`` is the caller-supplied size a value-conditioned rule
+    (``point>N``) compares against — e.g. the row count a dispatch is
+    about to make device-resident."""
     if not _ACTIVE:
         return
     with _LOCK:
@@ -187,7 +223,7 @@ def maybe_fail(point: str) -> None:
             return
         call_no = _CALLS.get(point, 0) + 1
         _CALLS[point] = call_no
-        fires = rule.fires(call_no)
+        fires = rule.fires(call_no, value)
         if fires:
             _FIRES[point] = _FIRES.get(point, 0) + 1
     if fires:

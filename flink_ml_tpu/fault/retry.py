@@ -15,6 +15,14 @@ whose message carries a transient gRPC/XLA status (``RESOURCE_EXHAUSTED``,
 classes a device/host blip produces.  Anything else (shape errors, value
 errors, real bugs) re-raises immediately: retrying a deterministic failure
 just triples its latency.
+
+One carve-out (ISSUE 9): a ``RESOURCE_EXHAUSTED`` whose message matches
+an *allocator* OOM (:func:`~flink_ml_tpu.fault.pressure.is_oom` — "out
+of memory", bytes-requested patterns, the ``fault.oom`` injection) is
+deterministic, not transient: the identical batch fails identically, so
+it routes to the pressure layer's batch bisection instead of a same-size
+retry.  Genuine transient exhaustion (quota, RPC backpressure) carries
+no allocator vocabulary and stays retryable.
 """
 
 from __future__ import annotations
@@ -65,6 +73,13 @@ _DETERMINISTIC_ERRNOS = frozenset(
 
 def is_transient(exc: BaseException) -> bool:
     """Would retrying this failure plausibly succeed?"""
+    from flink_ml_tpu.fault.pressure import is_oom
+
+    if is_oom(exc):
+        # allocator exhaustion is DETERMINISTIC: the same batch fails
+        # identically, so a same-size retry only triples the latency —
+        # recovery belongs to fault.pressure's bisection, not here
+        return False
     if isinstance(exc, InjectedFault):
         return True
     if isinstance(exc, OSError):

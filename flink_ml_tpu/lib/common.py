@@ -2267,6 +2267,282 @@ def fetch_flat(*arrays):
     return out
 
 
+#: the dense GLM training pressure surface (ISSUE 9) — shared by the
+#: estimator's pooled placement gate and the micro-batch fallback
+_TRAIN_PRESSURE_SURFACE = "train.glm"
+
+
+def _pressure_window_fn(grad_fn: GradFn, mesh, learning_rate: float,
+                        reg: float, w: int):
+    """``w`` consecutive global SGD steps as ONE compiled program over a
+    window batch of shape ``(n_dev*w, mb, d+2)`` — the resident-memory
+    knob of the pressure fallback.  The scanned minibatch body is
+    verbatim the fused program's (same grad math, same psum, same update,
+    same loss bookkeeping), so streaming a run through windows of ANY
+    size replays the identical per-step floating-point computation:
+    final params match the whole-batch fused run exactly."""
+    check_vma = getattr(grad_fn, "shard_map_check_vma", True)
+    key = ("pressure_win", grad_fn, mesh, float(learning_rate),
+           float(reg), int(w), check_vma)
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    sgd_update = make_sgd_update(learning_rate, reg)
+
+    def local_window(params, batch):  # local (w, mb, d+2)
+        def mb_step(p, mb):
+            grads, loss_sum, w_sum = grad_fn(
+                p, mb[..., :-2], mb[..., -2], mb[..., -1]
+            )
+            grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
+            loss_sum = psum(loss_sum, "data")
+            w_sum = psum(w_sum, "data")
+            count = jnp.maximum(w_sum, 1.0)
+            return sgd_update(p, grads, count), (loss_sum / count, w_sum)
+
+        params, (losses, counts) = jax.lax.scan(mb_step, params, batch)
+        return params, losses, counts
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = shard_map(
+        local_window, mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P(), P()),
+        check_vma=check_vma,
+    )
+    return _cache_put(key, jax.jit(sharded))
+
+
+def _pressure_grad_fn(grad_fn: GradFn, mesh, c: int):
+    """psum'd gradient SUMS over one ``c``-row micro-chunk per device (no
+    update) — the accumulation half of micro-batch gradient accumulation
+    for a single SGD step that exceeds device capacity on its own."""
+    check_vma = getattr(grad_fn, "shard_map_check_vma", True)
+    key = ("pressure_grad", grad_fn, mesh, int(c), check_vma)
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+
+    def local_grad(params, chunk):  # local (1, c, d+2)
+        mb = chunk[0]
+        grads, loss_sum, w_sum = grad_fn(
+            params, mb[..., :-2], mb[..., -2], mb[..., -1]
+        )
+        grads = jax.tree_util.tree_map(lambda g: psum(g, "data"), grads)
+        return grads, psum(loss_sum, "data"), psum(w_sum, "data")
+
+    from jax.sharding import PartitionSpec as P
+
+    sharded = shard_map(
+        local_grad, mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P(), P()),
+        check_vma=check_vma,
+    )
+    return _cache_put(key, jax.jit(sharded))
+
+
+def _pressure_update_fn(learning_rate: float, reg: float):
+    """One SGD update from accumulated gradient sums (+ the step's mean
+    loss) — the apply half of gradient accumulation."""
+    key = ("pressure_upd", float(learning_rate), float(reg))
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    sgd_update = make_sgd_update(learning_rate, reg)
+
+    def upd(params, grads, loss_sum, w_sum):
+        count = jnp.maximum(w_sum, 1.0)
+        return sgd_update(params, grads, count), loss_sum / count
+
+    return _cache_put(key, jax.jit(upd))
+
+
+def _pressure_accum_step(params, step_rows: np.ndarray, mesh,
+                         grad_fn: GradFn, learning_rate: float, reg: float):
+    """One SGD step whose minibatch alone exceeds device capacity:
+    sum-based gradient accumulation over contiguous row micro-chunks
+    (ascending ranges — a bitwise-stable accumulation order, identical on
+    every run), one psum'd grad program per resident chunk, then a single
+    update.  ``step_rows`` is the step's host minibatch
+    ``(n_dev, mb, d+2)``."""
+    from flink_ml_tpu.fault import pressure
+    from flink_ml_tpu.fault.retry import with_retry
+    from flink_ml_tpu.parallel.mesh import shard_batch
+
+    n_dev, mb = step_rows.shape[0], step_rows.shape[1]
+
+    def chunk_call(lo: int, hi: int):
+        chunk = np.ascontiguousarray(step_rows[:, lo:hi])
+        fault.maybe_oom(n_dev * (hi - lo))
+        win = with_retry(lambda: shard_batch(mesh, chunk), "place")
+        return _pressure_grad_fn(grad_fn, mesh, hi - lo)(params, win)
+
+    def accum(pieces):
+        grads, loss_sum, w_sum = pieces[0]
+        for g2, l2, w2 in pieces[1:]:
+            grads = jax.tree_util.tree_map(jnp.add, grads, g2)
+            loss_sum = loss_sum + l2
+            w_sum = w_sum + w2
+        return grads, loss_sum, w_sum
+
+    grads, loss_sum, w_sum = pressure.run_bisected(
+        chunk_call, mb, surface=_TRAIN_PRESSURE_SURFACE + ".accum",
+        concat=accum, evict=False,
+    )
+    obs.counter_add("pressure.accum_steps")
+    new_params, loss = _pressure_update_fn(learning_rate, reg)(
+        params, grads, loss_sum, w_sum
+    )
+    return new_params, loss, w_sum
+
+
+def _train_glm_pressure(init_params, stack: MinibatchStack,
+                        grad_fn: GradFn, mesh, learning_rate: float,
+                        reg: float, max_iter: int, tol: float) -> TrainResult:
+    """Micro-batch GLM training under HBM pressure (ISSUE 9).
+
+    The whole-run fused program needs the entire packed batch
+    device-resident; when that allocation OOMs, this driver streams the
+    SAME update schedule through bounded windows instead: per pass, the
+    rows of ``w`` consecutive global steps are placed and scanned by
+    :func:`_pressure_window_fn` (per-step math verbatim the fused
+    program's — exact-parity contract), shrinking ``w`` on further OOM
+    down to one step, below which :func:`_pressure_accum_step` splits the
+    single minibatch into accumulated gradient micro-chunks.  The
+    ``train.glm`` pressure state remembers the workable window across
+    fits and AIMD-probes back toward the whole-batch fused path."""
+    from flink_ml_tpu.fault import pressure
+    from flink_ml_tpu.fault.retry import with_retry
+    from flink_ml_tpu.parallel.mesh import replicate, shard_batch
+
+    comb = _combined_view_memo(stack)
+    steps, mb = stack.steps, stack.mb
+    n_dev = comb.shape[0] // max(steps, 1)
+    group_rows = n_dev * mb
+    st = pressure.state(_TRAIN_PRESSURE_SURFACE)
+    metrics = StepMetrics("pressure_train")
+    metrics.start_step()
+    params = replicate(mesh, init_params)
+    losses_dev: list = []
+    delta = None
+    tol_ = float(tol)
+    epoch = 0
+
+    def window_steps() -> int:
+        cap = st.current_cap()
+        if cap is None:
+            return steps
+        return max(1, min(steps, cap // max(group_rows, 1)))
+
+    while epoch < max_iter:
+        if tol_ > 0.0 and epoch > 0 and float(delta) <= tol_:
+            break
+        st.admit(comb.shape[0] * mb)  # AIMD up-probe between epochs
+        start = params
+        ep_losses: list = []
+        ep_counts: list = []
+        s = 0
+        while s < steps:
+            w = min(window_steps(), steps - s)
+            cap = st.current_cap()
+            if w == 1 and cap is not None and cap < group_rows:
+                # the cap already says ONE step cannot fit: go straight
+                # to gradient accumulation instead of paying a doomed
+                # full-minibatch placement (and an OOM event) per step
+                idx = np.arange(n_dev) * steps + s
+                params, loss1, count1 = _pressure_accum_step(
+                    params, comb[idx], mesh, grad_fn, learning_rate, reg
+                )
+                ep_losses.append(jnp.reshape(loss1, (1,)))
+                ep_counts.append(jnp.reshape(count1, (1,)))
+                s += 1
+                continue
+            # device-major gather: global step s' uses dim-0 rows
+            # {k*steps + s'} — window rows stay device-contiguous so the
+            # 'data'-axis shard sees its own steps in order
+            idx = (np.arange(n_dev)[:, None] * steps
+                   + (s + np.arange(w))[None, :]).reshape(-1)
+            host_win = np.ascontiguousarray(comb[idx])
+            rows = n_dev * w * mb
+            try:
+                fault.maybe_oom(rows)
+                win = with_retry(
+                    lambda hw=host_win: shard_batch(mesh, hw), "place"
+                )
+                params, losses_w, counts_w = _pressure_window_fn(
+                    grad_fn, mesh, learning_rate, reg, w
+                )(params, win)
+            except Exception as exc:  # noqa: BLE001 - OOM-filtered
+                if not fault.is_oom(exc):
+                    raise
+                if w > 1:
+                    pressure.note_oom(_TRAIN_PRESSURE_SURFACE, rows, exc,
+                                      floor=group_rows)
+                    obs.counter_add("pressure.bisections")
+                    obs.counter_add(
+                        f"pressure.bisections.{_TRAIN_PRESSURE_SURFACE}"
+                    )
+                    continue  # same step range, smaller window
+                # a single step is too big on its own: accumulate
+                pressure.note_oom(_TRAIN_PRESSURE_SURFACE, rows, exc)
+                params, loss1, count1 = _pressure_accum_step(
+                    params, comb[idx], mesh, grad_fn, learning_rate, reg
+                )
+                ep_losses.append(jnp.reshape(loss1, (1,)))
+                ep_counts.append(jnp.reshape(count1, (1,)))
+                s += 1
+                continue
+            ep_losses.append(losses_w)
+            ep_counts.append(counts_w)
+            s += w
+        losses_all = jnp.concatenate(ep_losses)
+        counts_all = jnp.concatenate(ep_counts)
+        total = jnp.maximum(jnp.sum(counts_all), 1.0)
+        losses_dev.append(jnp.sum(losses_all * counts_all) / total)
+        delta = jnp.sqrt(sum(
+            jnp.sum((a - b) ** 2)
+            for a, b in zip(jax.tree_util.tree_leaves(params),
+                            jax.tree_util.tree_leaves(start))
+        ))
+        epoch += 1
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    loss_hist = (
+        jnp.stack(losses_dev) if losses_dev
+        else jnp.zeros((0,), dtype=jnp.float32)
+    )
+    fetched = fetch_flat(
+        *leaves, loss_hist,
+        jnp.asarray(delta if delta is not None else jnp.inf),
+    )
+    losses = [float(x) for x in fetched[-2]]
+    host_params = jax.tree_util.tree_unflatten(
+        treedef, fetched[: len(leaves)]
+    )
+    metrics.end_step(
+        samples=stack.n_rows * epoch, epochs=epoch,
+        loss=losses[-1] if losses else 0.0,
+    )
+    obs.counter_add("train.pressure_runs")
+    obs.counter_add("train.epochs", epoch)
+    obs.counter_add("train.rows", stack.n_rows * epoch)
+    obs.record_hbm_gauges()
+    fault.check_health(
+        losses, fetched[: len(leaves)],
+        float(fetched[-1]) if epoch else None,
+        where="pressure_train",
+    )
+    return TrainResult(
+        params=host_params,
+        epochs=epoch,
+        losses=losses,
+        final_delta=float(fetched[-1]),
+        metrics=metrics,
+    )
+
+
 def train_glm(
     init_params,
     stack: MinibatchStack,
@@ -2298,16 +2574,53 @@ def train_glm(
     from flink_ml_tpu.parallel.mesh import replicate, shard_batch
 
     if not listeners and checkpoint is None:
+        from flink_ml_tpu.fault import pressure
+
+        row_slots = stack.x.shape[0] * stack.mb
+        st = pressure.state(_TRAIN_PRESSURE_SURFACE)
+        if pressure.enabled() and st.capped_below(row_slots):
+            # known pressure from an earlier fit: go straight to the
+            # micro-batch path at the remembered window (no failing
+            # whole-batch probe); the AIMD up-probe inside restores the
+            # fused path once the cap recovers
+            return _train_glm_pressure(
+                init_params, stack, grad_fn, mesh, learning_rate, reg,
+                max_iter, tol,
+            )
         train_fn = make_glm_train_fn(
             grad_fn, mesh, learning_rate, reg, max_iter, tol
         )
-        return _run_fused_train(
-            train_fn, init_params,
-            device_batch if device_batch is not None
-            else _combined_view_memo(stack),
-            mesh, batch_preplaced=device_batch is not None,
-            n_rows=stack.n_rows,
-        )
+        try:
+            fault.maybe_oom(row_slots)
+            # device_batch may be a thunk (lib/glm.py passes one so no
+            # caller frame pins the placed slab): resolve it HERE, inside
+            # the pressure scope, so a placement OOM recovers too
+            device_batch = _resolve_thunk(device_batch)
+            return _run_fused_train(
+                train_fn, init_params,
+                device_batch if device_batch is not None
+                else _combined_view_memo(stack),
+                mesh, batch_preplaced=device_batch is not None,
+                n_rows=stack.n_rows,
+            )
+        except Exception as exc:  # noqa: BLE001 - OOM-filtered below
+            if not (pressure.enabled() and fault.is_oom(exc)):
+                raise
+            # the whole-batch resident program exhausted the allocator:
+            # DROP the placed slab (our local is the last strong
+            # reference — the pool entry goes with evict_for_pressure, so
+            # the runtime can actually free the HBM the windows need),
+            # remember the pressure, and stream the identical update
+            # schedule through bounded windows
+            from flink_ml_tpu.table import slab_pool
+
+            device_batch = None
+            slab_pool.evict_for_pressure()
+            pressure.note_oom(_TRAIN_PRESSURE_SURFACE, row_slots, exc)
+            return _train_glm_pressure(
+                init_params, stack, grad_fn, mesh, learning_rate, reg,
+                max_iter, tol,
+            )
 
     start_epoch = 0
     losses: list = []
@@ -2472,10 +2785,16 @@ def apply_sharded(apply_factory, X: np.ndarray, *args,
     fn = apply_factory(mesh)
     row_multiple = data_parallel_size(mesh)
     if pool_key is not None:
+        from flink_ml_tpu.fault import pressure
         from flink_ml_tpu.table import slab_pool
 
         if not slab_pool.enabled():
             pool_key = None  # skip tokenization entirely: pooling is off
+        elif pressure.state("apply").capped_below(X.shape[0]):
+            # active memory pressure: the pooled path would place the
+            # FULL padded batch the cap says cannot fit — go straight to
+            # the bisected unpooled path below
+            pool_key = None
     if pool_key is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -2492,16 +2811,25 @@ def apply_sharded(apply_factory, X: np.ndarray, *args,
 
         refs: list = []
         token = slab_pool.array_token(X, refs)
-        # agreed=False: inference is collective-free by contract (each
-        # process scores its own rows on its own local mesh, with batch
-        # counts no peer mirrors) — a pool-level allgather here would hang
-        Xd = slab_pool.pool().get_or_build(
-            ("apply", mesh, pool_key, token, b), build, refs=refs,
-            agreed=False,
-        )
-        with slab_pool.pool().pinned(Xd):
-            out = fn(Xd, *args)
-            return np.asarray(out)[:n]
+        try:
+            fault.maybe_oom(n)
+            # agreed=False: inference is collective-free by contract (each
+            # process scores its own rows on its own local mesh, with batch
+            # counts no peer mirrors) — a pool-level allgather here would
+            # hang
+            Xd = slab_pool.pool().get_or_build(
+                ("apply", mesh, pool_key, token, b), build, refs=refs,
+                agreed=False,
+            )
+            with slab_pool.pool().pinned(Xd):
+                out = fn(Xd, *args)
+                return np.asarray(out)[:n]
+        except Exception as exc:  # noqa: BLE001 - OOM-filtered below
+            if not fault.is_oom(exc):
+                raise
+            # allocator exhaustion on the pooled full-batch placement:
+            # the bisected path below rediscovers the workable chunk size
+            # (and records the pressure telemetry as it does)
     return apply_batched(
         fn, X, *args,
         bucket_minimum=bucket_minimum,
@@ -2557,8 +2885,23 @@ def apply_batched(
     ``row_multiple`` rounds the bucket up so mesh-sharded applies
     (:func:`~flink_ml_tpu.parallel.collectives.make_data_parallel_apply`)
     always see a row count divisible by the data-axis size.
+
+    Memory-pressure resilient (ISSUE 9): the dispatch runs under the
+    shared ``apply`` pressure surface — an allocator OOM chunks X's rows
+    (KMeans assign, the Knn reference scan, scaler applies all route
+    here), each chunk padded to its own ladder bucket, and the sliced
+    results concatenate host-side.  Row-aligned fns are row-independent,
+    so the concatenation is bit-identical to the unsplit call.
     """
     n = X.shape[0]
-    Xp = _pad_rows_to(X, _bucket_for(n, bucket_minimum, row_multiple))
-    out = fn(jnp.asarray(Xp), *args)
-    return np.asarray(out)[:n]
+
+    def run(lo: int, hi: int) -> np.ndarray:
+        sub = X[lo:hi]
+        fault.maybe_oom(hi - lo)
+        Xp = _pad_rows_to(sub, _bucket_for(hi - lo, bucket_minimum,
+                                           row_multiple))
+        out = fn(jnp.asarray(Xp), *args)
+        return np.asarray(out)[: hi - lo]
+
+    return fault.run_bisected(run, n, surface="apply",
+                              floor=max(1, row_multiple))

@@ -374,7 +374,13 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
             from flink_ml_tpu.parallel.mesh import shard_batch_prefetched
             from flink_ml_tpu.table import slab_pool
 
-            device_batch = slab_pool.get_or_place(
+            # a THUNK, resolved inside train_glm's memory-pressure scope:
+            # this closure must hold no reference to the placed whole-
+            # batch slab — an OOM fallback that streams windows has to be
+            # able to actually FREE that allocation first — and under an
+            # already-known pressure cap train_glm skips the placement
+            # entirely
+            device_batch = lambda: slab_pool.get_or_place(  # noqa: E731
                 table, layout_key + ("dev",), mesh,
                 lambda: shard_batch_prefetched(mesh, _combined_view(stack)),
                 cols=layout_cols,

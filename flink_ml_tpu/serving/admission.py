@@ -30,9 +30,19 @@ from dataclasses import dataclass
 from typing import Optional
 
 from flink_ml_tpu import obs
-from flink_ml_tpu.serving.errors import SHED_BREAKER_OPEN, ServerOverloadedError
+from flink_ml_tpu.serving.errors import (
+    SHED_BREAKER_OPEN,
+    SHED_MEMORY_PRESSURE,
+    ServerOverloadedError,
+)
 
-__all__ = ["ServingConfig", "now_s", "overloaded", "shed"]
+__all__ = [
+    "ServingConfig",
+    "now_s",
+    "overloaded",
+    "shed",
+    "table_nbytes",
+]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -50,6 +60,11 @@ class ServingConfig:
     ``max_batch``   rows per coalesced dispatch (flush trigger 1)
     ``max_wait_ms`` oldest-request age that forces a flush (trigger 2)
     ``queue_cap``   max queued rows before admission sheds
+    ``queue_cap_mb`` max estimated queued MEGABYTES before admission
+                    sheds with the ``memory_pressure`` reason (0 = off):
+                    a row cap cannot see that one caller's rows are 100x
+                    wider than another's, so an HBM budget needs a
+                    bytes-denominated door too (ISSUE 9)
     ``deadline_ms`` default per-request deadline (0 = none)
     ``shed_on_breaker`` refuse at the door while a circuit breaker is
                     open instead of queueing onto a dead device
@@ -58,6 +73,7 @@ class ServingConfig:
     max_batch: int = 512
     max_wait_ms: float = 2.0
     queue_cap: int = 4096
+    queue_cap_mb: float = 0.0
     deadline_ms: float = 0.0
     shed_on_breaker: bool = True
 
@@ -67,6 +83,7 @@ class ServingConfig:
         max_batch: Optional[int] = None,
         max_wait_ms: Optional[float] = None,
         queue_cap: Optional[int] = None,
+        queue_cap_mb: Optional[float] = None,
         deadline_ms: Optional[float] = None,
         shed_on_breaker: Optional[bool] = None,
     ) -> "ServingConfig":
@@ -87,6 +104,10 @@ class ServingConfig:
                 queue_cap if queue_cap is not None
                 else _env_float("FMT_SERVING_QUEUE_CAP", 4096)
             ),
+            queue_cap_mb=float(
+                queue_cap_mb if queue_cap_mb is not None
+                else _env_float("FMT_SERVING_QUEUE_CAP_MB", 0.0)
+            ),
             deadline_ms=float(
                 deadline_ms if deadline_ms is not None
                 else _env_float("FMT_SERVING_DEADLINE_MS", 0.0)
@@ -98,7 +119,16 @@ class ServingConfig:
                 f"max_batch and queue_cap must be >= 1 "
                 f"(got {cfg.max_batch}, {cfg.queue_cap})"
             )
+        if cfg.queue_cap_mb < 0:
+            raise ValueError(
+                f"queue_cap_mb must be >= 0 (got {cfg.queue_cap_mb})"
+            )
         return cfg
+
+    @property
+    def queue_cap_bytes(self) -> int:
+        """The bytes-denominated admission cap (0 = disabled)."""
+        return int(self.queue_cap_mb * (1 << 20))
 
     @property
     def max_wait_s(self) -> float:
@@ -113,6 +143,30 @@ class ServingConfig:
         if ms <= 0:
             return None
         return enqueued_at + ms / 1e3
+
+
+#: fallback bytes/row for columns whose width the schema cannot bound
+#: (object columns: strings, sparse vectors) — deliberately conservative
+_OBJECT_ROW_BYTES = 64
+
+
+def table_nbytes(table) -> int:
+    """Estimated resident bytes of one request's rows — the unit of the
+    ``FMT_SERVING_QUEUE_CAP_MB`` admission budget.  Numeric/vector
+    columns report their backing buffers' true ``nbytes`` (the schema row
+    width times rows, exactly); object columns estimate a conservative
+    per-row width."""
+    total = 0
+    n = table.num_rows()
+    for name in table.schema.field_names:
+        col = table.col(name)
+        nbytes = getattr(col, "nbytes", None)
+        if nbytes is not None and getattr(col, "dtype", None) is not None \
+                and col.dtype != object:
+            total += int(nbytes)
+        else:
+            total += _OBJECT_ROW_BYTES * n
+    return total
 
 
 def overloaded(reason: str, detail: str = "",
@@ -134,6 +188,11 @@ def overloaded(reason: str, detail: str = "",
         # holds the closed->open breaker walk AND the shed it caused, in
         # ring order.  Rate-limited like every dump reason.
         obs.flight.dump("breaker_open_shed")
+    elif reason == SHED_MEMORY_PRESSURE:
+        # shedding for MEMORY is a degradation signal too (ISSUE 9): the
+        # dump holds the pressure walk — OOMs, evictions, bisections —
+        # that led to turning this request away
+        obs.flight.dump("memory_pressure_shed")
     return ServerOverloadedError(reason, detail, trace_id=trace_id)
 
 

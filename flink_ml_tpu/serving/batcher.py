@@ -64,6 +64,18 @@ class ServeRequest:
 
     def __post_init__(self):
         self.n_rows = self.table.num_rows()
+        self._n_bytes: Optional[int] = None
+
+    @property
+    def n_bytes(self) -> int:
+        """Estimated resident bytes (ISSUE 9) — computed lazily and
+        memoized, so requests only pay the schema walk when a server
+        actually enforces ``FMT_SERVING_QUEUE_CAP_MB``."""
+        if self._n_bytes is None:
+            from flink_ml_tpu.serving.admission import table_nbytes
+
+            self._n_bytes = table_nbytes(self.table)
+        return self._n_bytes
 
     def expired(self, now: float) -> bool:
         return self.deadline_at is not None and now > self.deadline_at

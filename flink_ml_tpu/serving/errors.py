@@ -10,6 +10,7 @@ from __future__ import annotations
 __all__ = [
     "SHED_BREAKER_OPEN",
     "SHED_DEADLINE",
+    "SHED_MEMORY_PRESSURE",
     "SHED_QUEUE_FULL",
     "SHED_SHUTDOWN",
     "ServerClosedError",
@@ -22,6 +23,10 @@ SHED_QUEUE_FULL = "queue_full"
 SHED_DEADLINE = "deadline_expired"
 SHED_BREAKER_OPEN = "breaker_open"
 SHED_SHUTDOWN = "shutdown"
+#: the queue's estimated bytes would exceed ``FMT_SERVING_QUEUE_CAP_MB``
+#: (ISSUE 9): admission refuses work the device memory budget cannot hold
+#: rather than queueing it onto an allocator already under pressure
+SHED_MEMORY_PRESSURE = "memory_pressure"
 
 
 class ServerOverloadedError(RuntimeError):
@@ -32,7 +37,8 @@ class ServerOverloadedError(RuntimeError):
     reason-coded rejection degrades predictably where unbounded queueing
     melts down.  ``reason`` is one of the ``SHED_*`` codes
     (``queue_full`` / ``deadline_expired`` / ``breaker_open`` /
-    ``shutdown``); the matching ``serving.shed.<reason>`` counter moved by
+    ``memory_pressure`` / ``shutdown``); the matching
+    ``serving.shed.<reason>`` counter moved by
     one.  ``trace_id`` carries the shed request's trace (None when
     tracing is off or the request was sampled out) — the handle that
     finds the request in the span sink and the flight-recorder ring.

@@ -47,9 +47,20 @@ waterfall (``python -m flink_ml_tpu.obs trace``), sheds stamp the
 ``trace_id`` into ``ServerOverloadedError`` and the flight-recorder
 ring, and quarantined rows carry it in their side-table.
 
-Knobs (BASELINE.md round-10 table): ``FMT_SERVING_MAX_BATCH``,
+Memory pressure (ISSUE 9, round 12): admission also enforces a
+bytes-denominated budget — ``FMT_SERVING_QUEUE_CAP_MB`` (estimated from
+each request's schema row width) sheds with the ``memory_pressure``
+reason before the queue's memory footprint can grow past what the
+device budget could ever serve — and the dispatcher recovers from
+allocator OOM by splitting the coalesced batch at request boundaries
+(bit-identical per-caller results), with the ``serving.batch`` pressure
+state capping subsequent coalescing until the AIMD probe restores full
+batches.
+
+Knobs (BASELINE.md round-10/12 tables): ``FMT_SERVING_MAX_BATCH``,
 ``FMT_SERVING_MAX_WAIT_MS``, ``FMT_SERVING_QUEUE_CAP``,
-``FMT_SERVING_DEADLINE_MS``, ``FMT_SERVING_SHED_ON_BREAKER``.
+``FMT_SERVING_QUEUE_CAP_MB``, ``FMT_SERVING_DEADLINE_MS``,
+``FMT_SERVING_SHED_ON_BREAKER``.
 """
 
 from __future__ import annotations
@@ -61,6 +72,7 @@ from concurrent.futures import Future
 from typing import Deque, List, Optional
 
 from flink_ml_tpu import obs
+from flink_ml_tpu.fault import pressure
 from flink_ml_tpu.serving.admission import (
     ServingConfig,
     now_s,
@@ -76,6 +88,7 @@ from flink_ml_tpu.serving.batcher import (
 from flink_ml_tpu.serving.errors import (
     SHED_BREAKER_OPEN,
     SHED_DEADLINE,
+    SHED_MEMORY_PRESSURE,
     SHED_QUEUE_FULL,
     SHED_SHUTDOWN,
     ServerClosedError,
@@ -88,6 +101,11 @@ __all__ = ["ModelServer"]
 #: rows retained from the newest coalesced batch as the default warmup
 #: sample for the next deploy (enough to exercise the plan, cheap to hold)
 _WARMUP_SAMPLE_ROWS = 8
+
+#: the dispatcher's memory-pressure surface (ISSUE 9): an allocator OOM
+#: from a coalesced transform splits the batch at a request boundary and
+#: caps subsequent coalescing here until the AIMD probe recovers
+_SERVING_SURFACE = "serving.batch"
 
 
 def _breaker_scope_names(model) -> frozenset:
@@ -134,6 +152,7 @@ class ModelServer:
                  max_batch: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  queue_cap: Optional[int] = None,
+                 queue_cap_mb: Optional[float] = None,
                  deadline_ms: Optional[float] = None,
                  shed_on_breaker: Optional[bool] = None,
                  start: bool = True):
@@ -141,7 +160,8 @@ class ModelServer:
             raise ValueError("pass exactly one of model / path")
         self.config = ServingConfig.from_env(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
-            queue_cap=queue_cap, deadline_ms=deadline_ms,
+            queue_cap=queue_cap, queue_cap_mb=queue_cap_mb,
+            deadline_ms=deadline_ms,
             shed_on_breaker=shed_on_breaker,
         )
         # a coalesced dispatch must stay a SINGLE internal transform batch:
@@ -172,6 +192,7 @@ class ModelServer:
         self._cond = threading.Condition()
         self._queue: Deque[ServeRequest] = deque()
         self._queued_rows = 0
+        self._queued_bytes = 0
         self._stopping = False
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -247,6 +268,7 @@ class ModelServer:
                 dropped = list(self._queue)
                 self._queue.clear()
                 self._queued_rows = 0
+                self._queued_bytes = 0
             self._cond.notify_all()
         for r in dropped:  # complete futures outside the lock
             self._shed(r, SHED_SHUTDOWN, "server shut down without draining")
@@ -318,6 +340,7 @@ class ModelServer:
             deadline_at=self.config.deadline_at(now, deadline_ms),
             trace=req_trace,
         )
+        cap_bytes = self.config.queue_cap_bytes
         expired: List[ServeRequest] = []
         rejected = None
         try:
@@ -327,19 +350,36 @@ class ModelServer:
                         req_trace.end(status="error",
                                       attrs={"error": "ServerClosedError"})
                     raise ServerClosedError("server is shut down")
-                if self._queued_rows + n > self.config.queue_cap:
+                if self._queued_rows + n > self.config.queue_cap or (
+                    cap_bytes
+                    and self._queued_bytes + request.n_bytes > cap_bytes
+                ):
                     # make room by shedding what can no longer be served
                     # in time — oldest first (FIFO order IS age order)
                     expired = self._collect_expired_locked(now)
                 if self._queued_rows + n > self.config.queue_cap:
-                    rejected = (
+                    rejected = (SHED_QUEUE_FULL, (
                         f"{self._queued_rows} rows queued against a cap "
                         f"of {self.config.queue_cap} (request adds {n})"
-                    )
+                    ))
+                elif (cap_bytes
+                      and self._queued_bytes + request.n_bytes > cap_bytes):
+                    # the rows fit but the BYTES don't: the queue's
+                    # estimated memory footprint would exceed the HBM
+                    # admission budget (FMT_SERVING_QUEUE_CAP_MB)
+                    rejected = (SHED_MEMORY_PRESSURE, (
+                        f"{self._queued_bytes} estimated bytes queued "
+                        f"against a cap of {cap_bytes} (request adds "
+                        f"{request.n_bytes})"
+                    ))
                 else:
                     self._queue.append(request)
                     self._queued_rows += n
                     obs.gauge_set("serving.queue_depth", self._queued_rows)
+                    if cap_bytes:
+                        self._queued_bytes += request.n_bytes
+                        obs.gauge_set("serving.queue_bytes",
+                                      self._queued_bytes)
                     self._cond.notify()
         finally:
             # futures complete OUTSIDE the lock: done-callbacks may touch
@@ -347,12 +387,13 @@ class ModelServer:
             for r in expired:
                 self._shed(r, SHED_DEADLINE, "deadline passed while queued")
         if rejected is not None:
+            reason, detail = rejected
             self._tally("serving.shed")
-            self._tally(f"serving.shed.{SHED_QUEUE_FULL}")
+            self._tally(f"serving.shed.{reason}")
             if req_trace is not None:
                 req_trace.end(status="shed",
-                              attrs={"shed_reason": SHED_QUEUE_FULL})
-            raise overloaded(SHED_QUEUE_FULL, rejected, trace_id=trace_id)
+                              attrs={"shed_reason": reason})
+            raise overloaded(reason, detail, trace_id=trace_id)
         if req_trace is not None:
             # the admission + enqueue window, on the caller thread
             obs.trace.record_span(
@@ -495,16 +536,26 @@ class ModelServer:
         cancellation."""
         taken: List[ServeRequest] = []
         rows = 0
+        bytes_out = 0
         dropped = 0
         schema = None
+        # under memory pressure the coalescing target shrinks to the last
+        # working batch size (and AIMD-probes back toward max_batch) —
+        # one OOM must not re-split every subsequent coalesced dispatch
+        max_rows = pressure.state(_SERVING_SURFACE).admit(
+            self.config.max_batch
+        )
+        track_bytes = bool(self.config.queue_cap_bytes)
         while self._queue:
             r = self._queue[0]
             if taken and (
-                rows + r.n_rows > self.config.max_batch
+                rows + r.n_rows > max_rows
                 or r.table.schema != schema
             ):
                 break
             self._queue.popleft()
+            if track_bytes:
+                bytes_out += r.n_bytes
             if not r.future.set_running_or_notify_cancel():
                 dropped += r.n_rows  # cancelled while queued
                 if r.trace is not None and cancelled is not None:
@@ -515,6 +566,9 @@ class ModelServer:
             rows += r.n_rows
         self._queued_rows -= rows + dropped
         obs.gauge_set("serving.queue_depth", self._queued_rows)
+        if track_bytes:
+            self._queued_bytes = max(self._queued_bytes - bytes_out, 0)
+            obs.gauge_set("serving.queue_bytes", self._queued_bytes)
         if dropped:
             self._tally("serving.cancelled_rows", dropped)
             obs.counter_add("serving.cancelled_rows", dropped)
@@ -528,17 +582,56 @@ class ModelServer:
             return []
         expired: List[ServeRequest] = []
         kept: Deque[ServeRequest] = deque()
+        track_bytes = bool(self.config.queue_cap_bytes)
         for r in self._queue:
             if r.expired(now):
                 self._queued_rows -= r.n_rows
+                if track_bytes:
+                    self._queued_bytes = max(
+                        self._queued_bytes - r.n_bytes, 0
+                    )
                 expired.append(r)
             else:
                 kept.append(r)
         self._queue = kept
         obs.gauge_set("serving.queue_depth", self._queued_rows)
+        if track_bytes:
+            obs.gauge_set("serving.queue_bytes", self._queued_bytes)
         return expired
 
     def _serve_batch(self, requests: List[ServeRequest]) -> None:
+        """One coalesced dispatch, with memory-pressure recovery (ISSUE
+        9): an allocator OOM from the transform splits the batch at a
+        REQUEST boundary and serves each half on its own dispatch.
+        Request-local demux offsets never depended on batchmates, so
+        every caller's result — outputs and quarantine side-tables —
+        stays bit-identical to the unsplit (and the solo) path.  The
+        ``serving.batch`` pressure state caps subsequent coalescing at
+        the working size, and the AIMD probe restores full batches once
+        pressure clears."""
+        if not requests:
+            return
+        try:
+            self._serve_batch_once(requests)
+        except BaseException as exc:  # noqa: BLE001 - OOM-only, see below
+            # _serve_batch_once resolves every other failure into the
+            # futures itself; only a splittable OOM escapes it
+            if not (pressure.enabled() and pressure.is_oom(exc)
+                    and len(requests) > 1):
+                raise
+            n_rows = sum(r.n_rows for r in requests)
+            pressure.note_oom(_SERVING_SURFACE, n_rows, exc)
+            obs.counter_add("pressure.bisections")
+            obs.counter_add(f"pressure.bisections.{_SERVING_SURFACE}")
+            obs.counter_add("serving.pressure_splits")
+            self._tally("serving.pressure_splits")
+            obs.flight.record("serving.pressure_split", rows=n_rows,
+                              requests=len(requests))
+            mid = len(requests) // 2
+            self._serve_batch(requests[:mid])
+            self._serve_batch(requests[mid:])
+
+    def _serve_batch_once(self, requests: List[ServeRequest]) -> None:
         """One coalesced dispatch: snapshot the active version, transform
         under quarantine capture, demux, resolve futures.
 
@@ -558,7 +651,12 @@ class ModelServer:
         traced = [r.trace for r in requests if r.trace is not None]
         now0 = now_s()
         for r in requests:
-            if r.trace is not None:
+            # once per request: a memory-pressure split re-enters here
+            # for each half, and a duplicate queue_wait would double-
+            # count the wait in the request's waterfall
+            if r.trace is not None and not getattr(
+                    r, "_queue_wait_recorded", False):
+                r._queue_wait_recorded = True
                 trace.record_span((r.trace.ctx,), "queue_wait",
                                   now0 - r.enqueued_at)
         with trace.use(tuple(t.ctx for t in traced)):
@@ -582,6 +680,12 @@ class ModelServer:
                         ],
                     )
             except BaseException as exc:  # noqa: BLE001 - futures carry it
+                if (pressure.enabled() and pressure.is_oom(exc)
+                        and len(requests) > 1):
+                    # allocator exhaustion on a splittable batch: let the
+                    # caller split at a request boundary — the futures
+                    # stay pending and every request still serves
+                    raise
                 self._tally("serving.failed_batches")
                 self._tally("serving.failed_requests", len(requests))
                 obs.counter_add("serving.failed_batches")

@@ -64,6 +64,7 @@ __all__ = [
     "SlabPool",
     "array_token",
     "enabled",
+    "evict_for_pressure",
     "place_batch",
     "pool",
     "pool_active",
@@ -481,6 +482,28 @@ class SlabPool:
                 with self._lock:
                     entry.pins -= 1
 
+    def evict_for_pressure(self) -> int:
+        """Drop EVERY unpinned entry under device memory pressure (ISSUE
+        9) and return the bytes released.  The pool is an optimization,
+        never a correctness dependency: on an allocator OOM the pressure
+        layer frees cached slabs first — the cheapest HBM to reclaim —
+        before shrinking the failing batch.  Pinned entries (in-flight
+        device calls) keep their reference, honoring the pin invariant;
+        the runtime frees device memory when the last holder lets go."""
+        with self._lock:
+            dropped = 0
+            for key, entry in list(self._entries.items()):
+                if entry.pins > 0:
+                    continue
+                dropped += entry.nbytes
+                self._drop(key, entry)
+                self.evictions += 1
+            if dropped:
+                obs.counter_add("slab_pool.pressure_evictions")
+                obs.counter_add("slab_pool.pressure_evicted_bytes", dropped)
+                self._record_gauges()
+        return dropped
+
     def reap(self) -> None:
         """Drop entries whose source buffers died (queued by the weakref
         death callbacks).  O(queued keys), no-op when nothing died — cheap
@@ -514,6 +537,15 @@ def reset_pool() -> None:
     """Drop the default pool (tests; bench uncached runs)."""
     global _POOL
     _POOL = None
+
+
+def evict_for_pressure() -> int:
+    """Module-level pressure-eviction entry point: drop every unpinned
+    slab from the default pool (no-op — and no pool construction — when
+    none exists yet).  Returns bytes released."""
+    if _POOL is None:
+        return 0
+    return _POOL.evict_for_pressure()
 
 
 # -- placement entry points ---------------------------------------------------

@@ -58,6 +58,28 @@ counterpart, against the dynamic micro-batching ``ModelServer``:
 plus the ``serving`` RunReport from shutdown carrying the shed/swap
 counters and the request-latency p50/p99.
 
+**Pressure mode** (``--pressure``, ISSUE 9): the memory-pressure
+resilience counterpart — a deterministic 256-row HBM ceiling
+(``FMT_FAULT_INJECT="fault.oom>256"``) against the serving and training
+stacks:
+
+  1. **serving survives the ceiling** — a 2048-row load (32 x 64-row
+     requests) through ``ModelServer`` must complete with ZERO failed
+     requests, every caller's predictions BIT-IDENTICAL to the
+     unpressured run, and ``pressure.ooms``/``pressure.bisections``
+     nonzero (the fused plan bisected under the ceiling instead of
+     failing);
+  2. **AIMD recovery** — once the ceiling lifts, continued traffic must
+     probe the cap back up (``pressure.resizes`` > 0) until full batches
+     dispatch unsplit again (the surface's cap clears);
+  3. **training grad-accumulation parity** — a fit under the ceiling
+     must stream micro-batch windows and produce params EXACTLY equal to
+     the fault-free fit's;
+  4. **memory-pressure admission** — with ``FMT_SERVING_QUEUE_CAP_MB``
+     set below the offered load, admission must shed with the
+     reason-coded ``memory_pressure`` ``ServerOverloadedError`` and a
+     flight-recorder dump must land for it.
+
 **Trace mode** (``--trace``, ISSUE 8): the observability counterpart —
 end-to-end request tracing plus the black-box flight recorder:
 
@@ -732,6 +754,162 @@ def trace_main() -> int:
     return 0
 
 
+def pressure_main() -> int:
+    """The memory-pressure chaos matrix (``--pressure``, ISSUE 9)."""
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_pressure_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ["FMT_FLIGHT_DIR"] = tempfile.mkdtemp(prefix="chaos_pflight_")
+    os.environ["FMT_FLIGHT_MIN_S"] = "0"
+    from flink_ml_tpu import fault, obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.fault import pressure
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import flight
+    from flink_ml_tpu.serving import ModelServer, ServerOverloadedError
+    from flink_ml_tpu.table import slab_pool
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(11)
+    n_rows, req_rows = 2048, 64
+    X = rng.randn(n_rows, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    t = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(t)
+    (ref,) = model.transform(t)
+    refp = np.asarray(ref.col("p"))
+
+    # -- leg 1: 2048-row serving load under a 256-row HBM ceiling ------------
+    pressure.reset_states()
+    obs.reset()
+    os.environ["FMT_PRESSURE_PROBE_S"] = "0"  # probe on every admit
+    fault.configure("fault.oom>256")
+    failures = []
+    try:
+        with ModelServer(model, max_batch=512, max_wait_ms=1) as server:
+            futs = [
+                server.submit(t.slice_rows(i * req_rows, (i + 1) * req_rows))
+                for i in range(n_rows // req_rows)
+            ]
+            for i, fut in enumerate(futs):
+                try:
+                    got = np.asarray(fut.result(120).table.col("p"))
+                    np.testing.assert_array_equal(
+                        got, refp[i * req_rows:(i + 1) * req_rows],
+                        err_msg=f"request {i} diverged under pressure",
+                    )
+                except BaseException as exc:  # noqa: BLE001 - the assertion
+                    failures.append(exc)
+            assert not failures, (
+                f"{len(failures)} of {len(futs)} requests failed under the "
+                f"injected ceiling: {failures[0]!r}"
+            )
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("pressure.ooms", 0) >= 1, c
+            assert c.get("pressure.bisections", 0) >= 1, c
+            print(f"  ceiling: {len(futs)} x {req_rows}-row requests served, "
+                  f"zero failures, bit-identical "
+                  f"(ooms={c.get('pressure.ooms'):g}, "
+                  f"bisections={c.get('pressure.bisections'):g})")
+
+            # -- leg 2: ceiling lifts -> AIMD probes back to full batch ------
+            fault.configure(None)
+            deadline = time.monotonic() + 60
+            plan_surfaces = [
+                name for name in pressure._STATES
+                if name.startswith("FusedPlan[")
+            ]
+            assert plan_surfaces, sorted(pressure._STATES)
+
+            def caps():
+                return [pressure.state(s).cap for s in plan_surfaces]
+
+            while any(cap is not None for cap in caps()):
+                assert time.monotonic() < deadline, (
+                    f"AIMD never recovered: caps={caps()}"
+                )
+                server.predict(t.slice_rows(0, 512), timeout=120)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("pressure.resizes", 0) >= 1, c
+        # recovered: one more transform must dispatch UNSPLIT (bisections
+        # stay flat) and stay bit-identical
+        before = c.get("pressure.bisections", 0)
+        (out,) = model.transform(t)
+        np.testing.assert_array_equal(np.asarray(out.col("p")), refp)
+        after = obs.registry().snapshot()["counters"].get(
+            "pressure.bisections", 0)
+        assert after == before, (before, after)
+        print(f"  AIMD: caps cleared, resizes={c.get('pressure.resizes'):g}, "
+              "full-batch dispatch restored unsplit")
+    finally:
+        fault.configure(None)
+        os.environ.pop("FMT_PRESSURE_PROBE_S", None)
+
+    # -- leg 3: training under the ceiling -> exact grad-accum parity --------
+    base = fused_est().set_global_batch_size(32).fit(dense_table())
+    w0, b0 = params_of(base)
+    slab_pool.reset_pool()
+    pressure.reset_states()
+    obs.reset()
+    fault.configure("fault.oom>64")
+    try:
+        pressured = fused_est().set_global_batch_size(32).fit(dense_table())
+    finally:
+        fault.configure(None)
+    w1, b1 = params_of(pressured)
+    np.testing.assert_array_equal(w1, w0)
+    assert b1 == b0
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("train.pressure_runs", 0) >= 1, c
+    assert c.get("pressure.ooms.train.glm", 0) >= 1, c
+    print("  training: fit under ceiling streamed micro-batch windows, "
+          f"params exact (pressure_runs={c.get('train.pressure_runs'):g})")
+
+    # -- leg 4: bytes-denominated admission sheds memory_pressure -------------
+    pressure.reset_states()
+    flight.reset()
+    obs.reset()
+    # one 64-row request is 64 x (8 f32 features + 1 f64 label) = 2560
+    # bytes: a 6 KiB cap admits two requests and sheds the third
+    server = ModelServer(model, queue_cap=4096,
+                         queue_cap_mb=6.0 / 1024.0, max_wait_ms=1,
+                         start=False)
+    server.submit(t.slice_rows(0, 64))
+    server.submit(t.slice_rows(64, 128))
+    try:
+        server.submit(t.slice_rows(128, 192))
+        raise AssertionError("past-bytes-cap submit was admitted")
+    except ServerOverloadedError as exc:
+        assert exc.reason == "memory_pressure", exc.reason
+    dump_path = flight.last_dump_path()
+    assert dump_path and os.path.exists(dump_path), (
+        "no flight-recorder dump landed on the memory_pressure shed"
+    )
+    events = [json.loads(line) for line in open(dump_path)]
+    sheds = [e for e in events if e.get("kind") == "serving.shed"
+             and e.get("reason") == "memory_pressure"]
+    assert sheds, sorted({e.get("kind") for e in events})
+    server.start()
+    server.shutdown()  # drain the two admitted requests
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("serving.shed.memory_pressure", 0) == 1, c
+    print("  admission: bytes cap shed memory_pressure, black-box dump "
+          f"landed ({os.path.basename(dump_path)})")
+    print("pressure chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -742,6 +920,8 @@ def main() -> int:
         return serving_main()
     if "--trace" in sys.argv:
         return trace_main()
+    if "--pressure" in sys.argv:
+        return pressure_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir
