@@ -65,6 +65,7 @@ from flink_ml_tpu.fault.injection import InjectedFault, maybe_fail
 __all__ = [
     "OOM_POINT",
     "PressureState",
+    "current_caps",
     "enabled",
     "is_oom",
     "maybe_oom",
@@ -236,6 +237,21 @@ def state(name: str) -> PressureState:
         if st is None:
             st = _STATES[name] = PressureState(name)
         return st
+
+
+def current_caps() -> Dict[str, int]:
+    """Every surface currently under pressure: ``{surface: cap}`` for
+    states whose cap is active (a cleared surface drops out).  The
+    telemetry plane's ``/readyz``/``/statusz`` read this — a cap pinned
+    below the readiness floor marks the process unready."""
+    with _STATES_LOCK:
+        states = list(_STATES.values())
+    out: Dict[str, int] = {}
+    for st in states:
+        cap = st.current_cap()
+        if cap is not None:
+            out[st.name] = cap
+    return out
 
 
 def reset_states() -> None:

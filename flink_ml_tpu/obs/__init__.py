@@ -32,9 +32,22 @@ ISSUE 8 added the per-request layer on top of the aggregates:
     structured events (swaps, sheds, breaker transitions, fault
     retries/rollbacks, plan fallbacks) dumped as a redacted JSONL black
     box on breaker-open, deploy failure, guard rollback, or crash.
+
+ISSUE 10 added the LIVE plane on top of the post-hoc layers:
+
+  * :mod:`flink_ml_tpu.obs.telemetry` — an embedded HTTP endpoint
+    (``FMT_TELEMETRY_PORT``, off by default) exposing ``/metrics``
+    (OpenMetrics rendering of the registry), ``/healthz`` / ``/readyz``
+    (liveness vs. reason-coded readiness: open breakers, pressure caps,
+    deploys in progress, queue saturation, burning SLOs), and
+    ``/statusz`` (one JSON snapshot).
+  * :mod:`flink_ml_tpu.obs.slo` — the in-process SLO burn-rate monitor
+    (serving p99 latency + shed/error ratio on a rolling window)
+    feeding the ``slo.burning.*`` gauges, flight-recorder breach dumps,
+    and ``/readyz``.
 """
 
-from flink_ml_tpu.obs import flight, trace  # noqa: F401
+from flink_ml_tpu.obs import flight, slo, telemetry, trace  # noqa: F401
 from flink_ml_tpu.obs.registry import (
     MetricsRegistry,
     counter_add,
@@ -79,6 +92,8 @@ __all__ = [
     "registry",
     "reports_dir",
     "reset",
+    "slo",
+    "telemetry",
     "trace",
     "write_run_report",
 ]

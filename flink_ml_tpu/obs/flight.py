@@ -161,13 +161,17 @@ def last_dump_path() -> Optional[str]:
 
 
 def dump(reason: str, directory: Optional[str] = None,
-         force: bool = False) -> Optional[str]:
+         force: bool = False, extra: Optional[dict] = None) -> Optional[str]:
     """Write the ring as one JSONL black box; returns the path.
 
     Rate-limited per reason (``FMT_FLIGHT_MIN_S``) unless ``force`` —
     a flapping breaker must not turn the reports dir into a landfill.
-    Returns None when rate-limited, disabled, empty, or unwritable
-    (a black box that throws during a crash hook would eat the crash)."""
+    ``extra`` fields land (redacted) in the dump header alongside the
+    reason — the ``slo_breach`` trigger records the breached SLO's name
+    and burn-rate math there, so the black box says WHY it was cut
+    before a reader opens a single event.  Returns None when
+    rate-limited, disabled, empty, or unwritable (a black box that
+    throws during a crash hook would eat the crash)."""
     global _LAST_DUMP_PATH
     if _capacity() <= 0:
         return None
@@ -195,6 +199,9 @@ def dump(reason: str, directory: Optional[str] = None,
             "pid": os.getpid(),
             "events": len(snapshot),
         }
+        if extra:
+            for k, v in _redact(extra).items():
+                header.setdefault(k, v)
         with open(path, "a") as f:
             f.write(json.dumps(header, sort_keys=True) + "\n")
             for e in snapshot:

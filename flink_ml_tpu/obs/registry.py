@@ -96,15 +96,48 @@ class TimingStat:
         """The ``q``-quantile (0..1) over the retained sample window."""
         return sample_quantile(sorted(self.samples), q)
 
+    def recent(self, k: int) -> list:
+        """The last ``k`` observations in arrival order (fewer when the
+        stat has seen fewer) — the ring's newest slice, so a rolling
+        window consumer (the SLO monitor) can judge exactly the
+        observations its count delta says are new."""
+        if k <= 0:
+            return []
+        if self.count <= len(self.samples):
+            ordered = self.samples
+        else:  # ring wrapped: count % RESERVOIR is the oldest slot
+            i = self.count % self.RESERVOIR
+            ordered = self.samples[i:] + self.samples[:i]
+        return list(ordered[-int(k):])
+
+    def _copy(self) -> "TimingStat":
+        """Cheap field-wise copy (O(reservoir) list slice) — lets
+        :meth:`MetricsRegistry.snapshot` release the registry lock
+        before the O(n log n) quantile sorts, so a telemetry scrape
+        never stalls a hot-path ``observe``/``add`` behind them."""
+        out = TimingStat()
+        out.count = self.count
+        out.total = self.total
+        out.min = self.min
+        out.max = self.max
+        out.samples = list(self.samples)
+        return out
+
     def to_dict(self) -> Dict[str, float]:
+        ordered = sorted(self.samples)
         return {
             "count": self.count,
             "total_s": self.total,
+            # exporter vocabulary (ISSUE 10): the monotonic count/sum an
+            # OpenMetrics summary needs for rate math — ``sum_s`` is
+            # ``total_s`` under the name scrapers expect
+            "sum_s": self.total,
             "min_s": self.min if self.count else 0.0,
             "max_s": self.max,
             "mean_s": self.total / self.count if self.count else 0.0,
-            "p50_s": self.quantile(0.50),
-            "p99_s": self.quantile(0.99),
+            "p50_s": sample_quantile(ordered, 0.50),
+            "p90_s": sample_quantile(ordered, 0.90),
+            "p99_s": sample_quantile(ordered, 0.99),
         }
 
 
@@ -146,14 +179,27 @@ class MetricsRegistry:
             stat = self._timings.get(name)
             return stat.to_dict() if stat is not None else None
 
-    def snapshot(self) -> dict:
-        """Plain-dict view of everything recorded (JSON-serializable)."""
+    def timing_recent(self, name: str, k: int) -> list:
+        """The last ``k`` observations of one timing stat, in arrival
+        order (empty when never observed) — see :meth:`TimingStat.recent`."""
         with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "timings": {k: v.to_dict() for k, v in self._timings.items()},
-            }
+            stat = self._timings.get(name)
+            return stat.recent(k) if stat is not None else []
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of everything recorded (JSON-serializable).
+        The lock covers only shallow copies; the per-stat quantile
+        sorts run outside it (a scraper's snapshot must never block a
+        hot-path record behind an O(n log n) critical section)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            stats = {k: v._copy() for k, v in self._timings.items()}
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "timings": {k: v.to_dict() for k, v in stats.items()},
+        }
 
     def reset(self) -> None:
         with self._lock:

@@ -9,8 +9,8 @@ live instead, durable and diffable.
 
 The CLI::
 
-    python -m flink_ml_tpu.obs [--check] [--json] [--reports DIR]
-                               [--baseline BASELINE.json]
+    python -m flink_ml_tpu.obs [--check] [--json] [--last N]
+                               [--reports DIR] [--baseline BASELINE.json]
 
 (``python -m flink_ml_tpu.obs.report`` also works, at the cost of a runpy
 re-execution warning — the package __init__ already imports this module).
@@ -180,6 +180,7 @@ def _fit_delta_snapshot() -> dict:
                 # fit's own observations, and a p99 is a tail signal, not
                 # an accounting identity
                 "p50_s": t.get("p50_s", 0.0),
+                "p90_s": t.get("p90_s", 0.0),
                 "p99_s": t.get("p99_s", 0.0),
             }
     _PREV_FIT_SNAPSHOT = {
@@ -263,6 +264,7 @@ def _transform_timing_quantiles() -> dict:
         t = reg.timing(k)
         if t is not None and t.get("count"):
             out[k] = {"count": t["count"], "p50_s": t.get("p50_s", 0.0),
+                      "p90_s": t.get("p90_s", 0.0),
                       "p99_s": t.get("p99_s", 0.0)}
     return out
 
@@ -385,21 +387,24 @@ def timing_quantile_summary(reports: List[dict]) -> Dict[str, dict]:
         if kind in latest:
             latest[kind][str(r.get("name", ""))] = r
     out: Dict[str, dict] = {"fit": {}, "transform": {}}
+
+    def quantiles(t: dict) -> dict:
+        return {"p50_s": t.get("p50_s", 0.0), "p90_s": t.get("p90_s", 0.0),
+                "p99_s": t.get("p99_s", 0.0)}
+
     for name, r in latest["fit"].items():
         timings = (r.get("metrics") or {}).get("timings") or {}
         stats = {
-            k: {"p50_s": t.get("p50_s", 0.0), "p99_s": t.get("p99_s", 0.0)}
-            for k, t in timings.items()
-            if k in _FIT_TIMING_KEYS and (t.get("p50_s") or t.get("p99_s"))
+            k: quantiles(t) for k, t in timings.items()
+            if k in _FIT_TIMING_KEYS and any(quantiles(t).values())
         }
         if stats:
             out["fit"][name] = stats
     for name, r in latest["transform"].items():
         timings = (r.get("extra") or {}).get("timings") or {}
         stats = {
-            k: {"p50_s": t.get("p50_s", 0.0), "p99_s": t.get("p99_s", 0.0)}
-            for k, t in sorted(timings.items())
-            if t.get("p50_s") or t.get("p99_s")
+            k: quantiles(t) for k, t in sorted(timings.items())
+            if any(quantiles(t).values())
         }
         if stats:
             out["transform"][name] = stats
@@ -414,6 +419,7 @@ def _timing_lines(summary: Dict[str, dict]) -> List[str]:
         for name, stats in sorted(summary.get(kind, {}).items()):
             parts = [
                 f"{k} p50={t['p50_s'] * unit_scale:.2f}{suffix} "
+                f"p90={t.get('p90_s', 0.0) * unit_scale:.2f}{suffix} "
                 f"p99={t['p99_s'] * unit_scale:.2f}{suffix}"
                 for k, t in sorted(stats.items())
             ]
@@ -601,6 +607,10 @@ def main(argv=None) -> int:
                         help="relative drop that counts as a regression")
     parser.add_argument("--check", action="store_true",
                         help="exit 1 when any regression is flagged")
+    parser.add_argument("--last", type=int, default=0, metavar="N",
+                        help="diff only the newest N RunReports (0 = all) "
+                             "— bounds the cost of an append-only "
+                             "runs.jsonl that has grown for months")
     parser.add_argument("--json", action="store_true",
                         help="emit ONE machine-readable JSON object "
                              "(per-metric pass/fail, direction, margin) "
@@ -611,6 +621,24 @@ def main(argv=None) -> int:
     with open(args.baseline) as f:
         baseline = json.load(f)
     reports = load_reports(args.reports)
+    if not reports:
+        # a missing/empty reports dir is an operator mistake (wrong path,
+        # FMT_OBS never enabled), not a clean diff: one diagnostic line,
+        # never a traceback, and --check fails on it
+        where = args.reports or reports_dir()
+        msg = (f"obs --check: no RunReports under {where} (runs.jsonl "
+               "missing or empty) — run a fit or bench with FMT_OBS=1, "
+               "or point --reports at the right directory")
+        if args.json:
+            print(json.dumps({"ok": not args.check, "check": bool(args.check),
+                              "error": msg, "baselined": 0, "comparable": 0,
+                              "regressions": 0, "metrics": []},
+                             sort_keys=True, indent=1))
+        else:
+            print(msg)
+        return 1 if args.check else 0
+    if args.last > 0:
+        reports = reports[-args.last:]
     fault_assisted = fault_assisted_runs(reports)
     serve_degraded = serve_degraded_runs(reports)
     timing_summary = timing_quantile_summary(reports)

@@ -1,0 +1,286 @@
+"""In-process SLO burn-rate monitor for the serving stack.
+
+A dashboard full of counters is not an alert.  This module declares the
+two SLOs the serving runtime (PR 7) and the pressure layer (PR 9) exist
+to protect, watches them on a rolling window, and turns "the error
+budget is burning" into signals the rest of the plane consumes:
+
+* **serving p99 latency** (``serving_p99_ms``, target
+  ``FMT_SLO_P99_MS``): 99% of requests must complete under the target —
+  the budget is the 1% tail.  Each window the monitor takes the NEW
+  ``serving.request_latency_ms`` observations (the registry's recent
+  reservoir, sliced by the monotonic count delta) and computes
+  ``burn = fraction_over_target / 0.01``;
+* **shed/error ratio** (``shed_error_ratio``, target
+  ``FMT_SLO_ERR_RATIO``): of everything that ARRIVED this window
+  (admitted + shed), at most the target fraction may shed or fail —
+  ``burn = (shed + failed) / arrivals / target``.
+
+A burn rate of 1.0 means the budget is being spent exactly as declared;
+above 1.0 the SLO is breaching.  On each breached sample the monitor
+
+* flips the ``slo.burning.<name>`` gauge to 1 (and records the
+  continuous ``slo.burn_rate.<name>``),
+* records a ``slo.breach`` flight event carrying the burn-rate math
+  (bad/total/target/window), and
+* dumps the flight recorder with reason ``slo_breach`` — the dump
+  header names the breached SLO and its burn rate, rate-limited by
+  ``FMT_FLIGHT_MIN_S`` like every other dump reason;
+
+and while burning the monitor reports ``slo_burning`` to ``/readyz``
+(:mod:`flink_ml_tpu.obs.telemetry`), so an orchestrator stops routing
+to a replica that is eating its error budget.  Recovery flips the gauge
+back and records ``slo.recovered``.
+
+A target of 0 disables that SLO (both default off — the obs
+discipline); windows with fewer than ``FMT_SLO_MIN_EVENTS`` arrivals
+are skipped rather than judged (a 1-request window where that request
+shed is an artifact, not a 100x burn) — but only for ENTERING a
+breach: a burning SLO is re-judged on any window, so a quiet one
+clears it rather than pinning an unrouted replica unready forever.  ``FMT_SLO_WINDOW_S`` (default
+30) paces the sampling thread; tests drive :meth:`SLOMonitor.
+sample_once` directly for determinism.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from flink_ml_tpu.obs import flight
+from flink_ml_tpu.obs.registry import gauge_set, registry
+
+__all__ = [
+    "ERROR_SLO",
+    "LATENCY_SLO",
+    "SLOMonitor",
+    "err_ratio_target",
+    "min_events",
+    "p99_target_ms",
+    "window_s",
+]
+
+LATENCY_SLO = "serving_p99_ms"
+ERROR_SLO = "shed_error_ratio"
+
+#: the registry histogram the latency SLO judges (milliseconds)
+_LATENCY_STAT = "serving.request_latency_ms"
+
+#: a p99 target's error budget: 1% of requests may exceed it
+_LATENCY_BUDGET = 0.01
+
+
+def window_s() -> float:
+    """``FMT_SLO_WINDOW_S`` (default 30): the rolling sample window."""
+    try:
+        return float(os.environ.get("FMT_SLO_WINDOW_S", "30") or 30)
+    except ValueError:
+        return 30.0
+
+
+def p99_target_ms() -> float:
+    """``FMT_SLO_P99_MS`` (default 0 = SLO disabled)."""
+    try:
+        return float(os.environ.get("FMT_SLO_P99_MS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def err_ratio_target() -> float:
+    """``FMT_SLO_ERR_RATIO`` (default 0 = SLO disabled)."""
+    try:
+        return float(os.environ.get("FMT_SLO_ERR_RATIO", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def min_events() -> int:
+    """``FMT_SLO_MIN_EVENTS`` (default 10): windows with fewer arrivals
+    are skipped, not judged."""
+    try:
+        return int(os.environ.get("FMT_SLO_MIN_EVENTS", "10") or 10)
+    except ValueError:
+        return 10
+
+
+class SLOMonitor:
+    """Samples the registry on a rolling window and computes burn rates.
+
+    Constructor arguments override the environment knobs (tests pin
+    them); the zero-target default keeps both SLOs off.  Thread-safe:
+    the sampler thread, readiness probes, and ``status()`` can race.
+    """
+
+    def __init__(self, window: Optional[float] = None,
+                 p99_ms: Optional[float] = None,
+                 err_ratio: Optional[float] = None,
+                 min_arrivals: Optional[int] = None):
+        self.window_s = window_s() if window is None else float(window)
+        self.p99_ms = p99_target_ms() if p99_ms is None else float(p99_ms)
+        self.err_ratio = (err_ratio_target() if err_ratio is None
+                          else float(err_ratio))
+        self.min_arrivals = (min_events() if min_arrivals is None
+                             else int(min_arrivals))
+        self._lock = threading.Lock()
+        self._burning: Dict[str, float] = {}  # slo name -> last burn rate
+        self._prev = self._totals()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._status_key: Optional[str] = None
+
+    @staticmethod
+    def _totals() -> Dict[str, float]:
+        """The monotonic totals the window deltas subtract."""
+        reg = registry()
+        t = reg.timing(_LATENCY_STAT)
+        return {
+            "requests": reg.counter("serving.requests"),
+            "shed": reg.counter("serving.shed"),
+            "failed": reg.counter("serving.failed_requests"),
+            "latency_count": t["count"] if t else 0,
+        }
+
+    def armed(self) -> bool:
+        """Is at least one SLO declared (nonzero target)?"""
+        return self.p99_ms > 0 or self.err_ratio > 0
+
+    def burning(self) -> Dict[str, float]:
+        """Currently-breaching SLOs: ``{name: burn_rate}``."""
+        with self._lock:
+            return dict(self._burning)
+
+    def readiness_reasons(self) -> List[dict]:
+        """The ``/readyz`` feed: one ``slo_burning`` reason per
+        breaching SLO."""
+        return [
+            {"reason": "slo_burning",
+             "detail": f"SLO {name!r} burn rate {rate:.2f}x"}
+            for name, rate in sorted(self.burning().items())
+        ]
+
+    def status(self) -> dict:
+        """The ``/statusz`` contribution."""
+        return {
+            "window_s": self.window_s,
+            "targets": {LATENCY_SLO: self.p99_ms,
+                        ERROR_SLO: self.err_ratio},
+            "burning": self.burning(),
+        }
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample_once(self) -> Dict[str, dict]:
+        """One window evaluation; returns per-SLO burn info (empty for
+        SLOs skipped this window).  The thread loop calls this every
+        ``window_s``; tests call it directly.
+
+        ``min_arrivals`` gates ENTERING a breach, never exiting one: a
+        burning SLO is re-judged on whatever the window holds (zero
+        arrivals = zero burn = recovery).  The asymmetry matters — once
+        ``/readyz`` degrades, an orchestrator stops routing here, so a
+        burning SLO that skips quiet windows would hold the replica
+        unready forever on the very traffic drought it caused."""
+        now = self._totals()
+        with self._lock:
+            prev, self._prev = self._prev, now
+            was_burning = set(self._burning)
+
+        def delta(key: str) -> float:
+            d = now[key] - prev[key]
+            # a registry reset between samples makes totals shrink:
+            # attribute the post-reset totals rather than a negative
+            return now[key] if d < 0 else d
+
+        results: Dict[str, dict] = {}
+        if self.err_ratio > 0:
+            arrivals = delta("requests") + delta("shed")
+            if arrivals >= self.min_arrivals or ERROR_SLO in was_burning:
+                bad = delta("shed") + delta("failed")
+                ratio = bad / arrivals if arrivals else 0.0
+                results[ERROR_SLO] = self._judge(
+                    ERROR_SLO, ratio / self.err_ratio,
+                    bad=bad, total=arrivals, bad_ratio=round(ratio, 6),
+                    target=self.err_ratio,
+                )
+        if self.p99_ms > 0:
+            fresh = int(delta("latency_count"))
+            if fresh >= self.min_arrivals or LATENCY_SLO in was_burning:
+                recent = (registry().timing_recent(_LATENCY_STAT, fresh)
+                          if fresh else [])
+                bad = sum(1 for ms in recent if ms > self.p99_ms)
+                ratio = bad / len(recent) if recent else 0.0
+                results[LATENCY_SLO] = self._judge(
+                    LATENCY_SLO, ratio / _LATENCY_BUDGET,
+                    bad=bad, total=len(recent),
+                    bad_ratio=round(ratio, 6), target=self.p99_ms,
+                )
+        return results
+
+    def _judge(self, name: str, burn: float, **math) -> dict:
+        """Record one SLO's window verdict: gauges always, flight breach
+        event + rate-limited black box while burning, recovery event on
+        the breach clearing."""
+        burning = burn > 1.0
+        gauge_set(f"slo.burn_rate.{name}", burn)
+        gauge_set(f"slo.burning.{name}", 1.0 if burning else 0.0)
+        with self._lock:
+            was_burning = name in self._burning
+            if burning:
+                self._burning[name] = burn
+            else:
+                self._burning.pop(name, None)
+        if burning:
+            flight.record("slo.breach", slo=name,
+                          burn_rate=round(burn, 4),
+                          window_s=self.window_s, **math)
+            # the black box shows WHAT was happening while the budget
+            # burned; FMT_FLIGHT_MIN_S keeps a sustained burn from
+            # turning the reports dir into a landfill
+            flight.dump("slo_breach", extra={
+                "slo": name, "burn_rate": round(burn, 4), **math,
+            })
+        elif was_burning:
+            flight.record("slo.recovered", slo=name,
+                          burn_rate=round(burn, 4))
+        return {"burning": burning, "burn_rate": burn, **math}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "SLOMonitor":
+        """Start the sampling thread and plug into the telemetry plane
+        (readiness + status).  Idempotent; a monitor with no armed SLO
+        still starts (it just never judges) so ``/statusz`` shows the
+        zero targets an operator forgot to set."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        from flink_ml_tpu.obs import telemetry
+
+        telemetry.register_readiness(self.readiness_reasons)
+        self._status_key = telemetry.register_status("slo", self.status)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fmt-slo-monitor", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.window_s):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 - the monitor must outlive
+                pass           # a single bad sample (telemetry never kills)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop sampling and unplug from the telemetry plane."""
+        from flink_ml_tpu.obs import telemetry
+
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)
+        telemetry.unregister_readiness(self.readiness_reasons)
+        if self._status_key is not None:
+            telemetry.unregister_status(self._status_key)
+            self._status_key = None

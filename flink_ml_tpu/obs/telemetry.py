@@ -1,0 +1,606 @@
+"""Live telemetry plane: OpenMetrics exporter + health/readiness endpoints.
+
+Everything observability built so far is *post-hoc* — RunReports, trace
+waterfalls, flight-recorder dumps all land on disk after the fact.  A
+production orchestrator needs the live half: something to scrape, probe,
+and alert on while the process serves.  This module is that half — a
+lightweight embedded HTTP server (stdlib ``http.server``, one daemon
+thread, **off by default**) exposing:
+
+``/metrics``
+    The whole metrics registry rendered as OpenMetrics text: every
+    counter becomes a ``counter`` family (``<name>_total`` sample),
+    every gauge a ``gauge``, every :class:`~flink_ml_tpu.obs.registry.
+    TimingStat` a ``summary`` (p50/p90/p99 quantile series over the
+    stat's recent reservoir plus the monotonic ``_count``/``_sum`` the
+    rate math wants).  :func:`parse_openmetrics` is the matching strict
+    line parser — chaos/bench/tests validate scrapes through it rather
+    than trusting the renderer to certify itself.
+
+``/healthz``
+    Liveness: the process is up and the endpoint thread responds.
+    Always 200 while the server runs — liveness must never couple to
+    load or dependencies, or an orchestrator restarts a busy process.
+
+``/readyz``
+    Readiness: should traffic be routed here NOW?  503 with a
+    machine-readable reason list when any degradation source reports:
+    an OPEN circuit breaker (``breaker_open``), a memory-pressure cap
+    pinned below the floor (``memory_pressure``,
+    ``FMT_READY_PRESSURE_FLOOR``), a deploy in progress
+    (``deploy_in_progress``), a saturated request queue
+    (``queue_saturated``, ``FMT_READY_QUEUE_FRAC``), or a burning SLO
+    (``slo_burning``, :mod:`flink_ml_tpu.obs.slo`).  200 otherwise.
+
+``/statusz``
+    One JSON snapshot for a human (or a dashboard): model version and
+    uptime, per-surface pressure caps, breaker states, the flight
+    recorder's tail, and the readiness verdict with its reasons.
+
+``FMT_TELEMETRY_PORT`` arms it: unset/empty = off (the obs discipline —
+no listener, no thread, zero cost), ``0`` = bind an ephemeral port
+(tests, chaos, bench read it back from :attr:`TelemetryServer.port`),
+``N`` = that port.  ``FMT_TELEMETRY_HOST`` (default ``127.0.0.1``)
+binds loopback-only unless an operator opts into an external interface.
+``ModelServer`` starts/stops an endpoint through its lifecycle; a
+training job can run one standalone via :func:`start`/:func:`stop`.
+
+Readiness and status are EXTENSIBLE: components register callables
+(:func:`register_readiness` / :func:`register_status`) and the built-in
+checks (breakers, pressure caps) ride along, so every endpoint in the
+process tells the whole process's story.  A readiness source that
+throws reports ``probe_error`` and fails CLOSED — a broken probe must
+read as "do not route here", never as a silent green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from flink_ml_tpu.obs.registry import registry
+
+__all__ = [
+    "TelemetryServer",
+    "active_server",
+    "counters_within_bounds",
+    "env_port",
+    "family_name",
+    "parse_openmetrics",
+    "pressure_floor",
+    "queue_saturation_frac",
+    "readiness",
+    "register_readiness",
+    "register_status",
+    "render_openmetrics",
+    "start",
+    "status_snapshot",
+    "stop",
+    "unregister_readiness",
+    "unregister_status",
+]
+
+#: monotonic stamp of module import — the process-uptime anchor statusz
+#: and healthz report (close enough to process start for an operator)
+_START_MONO = time.monotonic()
+_START_WALL = time.time()
+
+_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                 "charset=utf-8")
+
+
+def env_port() -> Optional[int]:
+    """``FMT_TELEMETRY_PORT``: None when unset/empty (telemetry off),
+    ``0`` for an ephemeral port, else the fixed port to bind."""
+    raw = os.environ.get("FMT_TELEMETRY_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    return port if port >= 0 else None
+
+
+def _env_host() -> str:
+    return os.environ.get("FMT_TELEMETRY_HOST", "").strip() or "127.0.0.1"
+
+
+def pressure_floor() -> int:
+    """``FMT_READY_PRESSURE_FLOOR`` (default 8): a memory-pressure cap
+    pinned below this many rows marks the process unready — the AIMD
+    state says the device cannot serve even a token batch."""
+    try:
+        return int(os.environ.get("FMT_READY_PRESSURE_FLOOR", "8") or 8)
+    except ValueError:
+        return 8
+
+
+def queue_saturation_frac() -> float:
+    """``FMT_READY_QUEUE_FRAC`` (default 0.95): the queued-rows fraction
+    of ``queue_cap`` at which a server reports ``queue_saturated`` —
+    readiness should flip BEFORE admission starts shedding, so the
+    balancer stops routing while there is still headroom."""
+    try:
+        return float(os.environ.get("FMT_READY_QUEUE_FRAC", "0.95") or 0.95)
+    except ValueError:
+        return 0.95
+
+
+# -- OpenMetrics rendering ----------------------------------------------------
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def family_name(name: str, prefix: str = "fmt_") -> str:
+    """Registry name -> OpenMetrics metric-family name: invalid chars
+    collapse to ``_``, a leading digit gets guarded, and a trailing
+    ``_total`` is stripped (OpenMetrics reserves it for the counter
+    SAMPLE suffix — a family may not end with it)."""
+    out = prefix + _NAME_BAD.sub("_", name)
+    if out[len(prefix):][:1].isdigit():
+        out = prefix + "_" + out[len(prefix):]
+    while out.endswith("_total"):
+        out = out[:-len("_total")]
+    return out
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_openmetrics(snapshot: Optional[dict] = None,
+                       prefix: str = "fmt_") -> str:
+    """The registry snapshot as one OpenMetrics text exposition.
+
+    Counters -> ``counter`` families (``<family>_total`` samples),
+    gauges -> ``gauge``, timings -> ``summary`` (quantile series over
+    the recent reservoir + monotonic ``_count``/``_sum``).  Families are
+    emitted sorted; a name that sanitizes into an already-used family is
+    skipped (duplicate families are invalid, and dotted registry names
+    make real collisions vanishingly rare).  Ends with ``# EOF``.
+    """
+    snap = snapshot if snapshot is not None else registry().snapshot()
+    lines: List[str] = []
+    used: set = set()
+
+    def claim(name: str) -> Optional[str]:
+        fam = family_name(name, prefix)
+        if fam in used:
+            return None
+        used.add(fam)
+        return fam
+
+    for name, value in sorted(snap.get("counters", {}).items()):
+        fam = claim(name)
+        if fam is None:
+            continue
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam}_total {_fmt_value(value)}")
+    for name, value in sorted(snap.get("gauges", {}).items()):
+        fam = claim(name)
+        if fam is None:
+            continue
+        lines.append(f"# TYPE {fam} gauge")
+        lines.append(f"{fam} {_fmt_value(value)}")
+    for name, stat in sorted(snap.get("timings", {}).items()):
+        fam = claim(name)
+        if fam is None:
+            continue
+        lines.append(f"# TYPE {fam} summary")
+        for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                       ("0.99", "p99_s")):
+            lines.append(
+                f'{fam}{{quantile="{q}"}} {_fmt_value(stat.get(key, 0.0))}'
+            )
+        lines.append(f"{fam}_count {_fmt_value(stat.get('count', 0))}")
+        lines.append(
+            f"{fam}_sum {_fmt_value(stat.get('sum_s', stat.get('total_s', 0.0)))}"
+        )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"        # sample name
+    r'(?:\{quantile="([0-9.]+)"\})?'      # optional quantile label
+    r" (-?(?:[0-9][0-9eE+.\-]*|\.[0-9]+))$"  # value
+)
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|summary)$")
+
+
+def parse_openmetrics(text: str) -> Dict[str, float]:
+    """Strict line parser for the exposition :func:`render_openmetrics`
+    emits — the independent check chaos/bench/tests validate scrapes
+    with.  Enforces: every sample belongs to (and directly follows) a
+    declared ``# TYPE`` family, sample suffixes match the family's type
+    (``_total`` only on counters, ``_count``/``_sum``/quantiles only on
+    summaries), no duplicate families, and a final ``# EOF``.  Returns
+    ``{sample_key: value}`` where a quantile sample's key is
+    ``name{quantile="q"}``.  Raises ``ValueError`` on any violation."""
+    samples: Dict[str, float] = {}
+    families: Dict[str, str] = {}
+    fam: Optional[str] = None
+    kind: Optional[str] = None
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    if not lines or lines[-1] != "# EOF":
+        raise ValueError("exposition does not end with '# EOF'")
+    for i, line in enumerate(lines[:-1], 1):
+        m = _TYPE_RE.match(line)
+        if m:
+            name, t = m.groups()
+            if name in families:
+                raise ValueError(f"line {i}: duplicate family {name!r}")
+            families[name] = t
+            fam, kind = name, t
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {i}: unexpected comment {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: malformed sample {line!r}")
+        name, quantile, value = m.groups()
+        if fam is None:
+            raise ValueError(f"line {i}: sample before any # TYPE")
+        ok = (
+            (kind == "counter" and name == fam + "_total"
+             and quantile is None)
+            or (kind == "gauge" and name == fam and quantile is None)
+            or (kind == "summary" and (
+                (name == fam and quantile is not None)
+                or (name in (fam + "_count", fam + "_sum")
+                    and quantile is None)
+            ))
+        )
+        if not ok:
+            raise ValueError(
+                f"line {i}: sample {name!r} does not belong to the "
+                f"preceding {kind} family {fam!r}"
+            )
+        key = name if quantile is None else f'{name}{{quantile="{quantile}"}}'
+        if key in samples:
+            raise ValueError(f"line {i}: duplicate sample {key!r}")
+        samples[key] = float(value)
+    return samples
+
+
+def counters_within_bounds(snap_before: Dict[str, float],
+                           samples: Dict[str, float],
+                           snap_after: Dict[str, float],
+                           prefix: str = "fmt_") -> int:
+    """Cross-check one scrape against the registry: every exported
+    counter whose source appears in both snapshots must sit within the
+    ``[before, after]`` bounds taken around the scrape — the exporter
+    publishes the registry, not an approximation of it.  Returns how
+    many counters were checked; raises ``ValueError`` on a violation.
+    The ONE copy of the verification contract chaos/bench share."""
+    checked = 0
+    for name, before in sorted(snap_before.items()):
+        key = family_name(name, prefix) + "_total"
+        if key not in samples or name not in snap_after:
+            continue
+        exported = samples[key]
+        if not (before <= exported <= snap_after[name]):
+            raise ValueError(
+                f"{name}: exported {exported} outside the scrape window "
+                f"[{before}, {snap_after[name]}]"
+            )
+        checked += 1
+    return checked
+
+
+# -- readiness / status source registries -------------------------------------
+
+_SOURCES_LOCK = threading.Lock()
+_READINESS_SOURCES: List[Callable[[], List[dict]]] = []
+_STATUS_SOURCES: Dict[str, Callable[[], dict]] = {}
+
+
+def register_readiness(fn: Callable[[], List[dict]]) -> None:
+    """Register a readiness source: a callable returning a list of
+    ``{"reason": ..., "detail": ...}`` dicts (empty = ready)."""
+    with _SOURCES_LOCK:
+        if fn not in _READINESS_SOURCES:
+            _READINESS_SOURCES.append(fn)
+
+
+def unregister_readiness(fn: Callable[[], List[dict]]) -> None:
+    with _SOURCES_LOCK:
+        if fn in _READINESS_SOURCES:
+            _READINESS_SOURCES.remove(fn)
+
+
+def register_status(name: str, fn: Callable[[], dict]) -> str:
+    """Register a status source under ``name`` (unique-ified on
+    collision); returns the key to pass to :func:`unregister_status`."""
+    with _SOURCES_LOCK:
+        key, n = name, 2
+        while key in _STATUS_SOURCES:
+            key = f"{name}-{n}"
+            n += 1
+        _STATUS_SOURCES[key] = fn
+        return key
+
+
+def unregister_status(key: str) -> None:
+    with _SOURCES_LOCK:
+        _STATUS_SOURCES.pop(key, None)
+
+
+def _builtin_reasons() -> List[dict]:
+    """The process-wide degradation checks every endpoint reports:
+    OPEN circuit breakers and memory-pressure caps below the floor."""
+    reasons: List[dict] = []
+    try:
+        from flink_ml_tpu.serve.breaker import open_breaker_names
+
+        for name in sorted(open_breaker_names()):
+            reasons.append({
+                "reason": "breaker_open",
+                "detail": f"circuit breaker {name!r} is open",
+            })
+    except Exception as exc:  # noqa: BLE001 - fail closed, see below
+        reasons.append({"reason": "probe_error",
+                        "detail": f"breaker probe: {type(exc).__name__}"})
+    try:
+        from flink_ml_tpu.fault import pressure
+
+        floor = pressure_floor()
+        for surface, cap in sorted(pressure.current_caps().items()):
+            if cap < floor:
+                reasons.append({
+                    "reason": "memory_pressure",
+                    "detail": (f"{surface} capped at {cap} rows "
+                               f"(floor {floor})"),
+                })
+    except Exception as exc:  # noqa: BLE001
+        reasons.append({"reason": "probe_error",
+                        "detail": f"pressure probe: {type(exc).__name__}"})
+    return reasons
+
+
+def readiness() -> Tuple[bool, List[dict]]:
+    """The process readiness verdict: built-in checks plus every
+    registered source.  A source that raises contributes a
+    ``probe_error`` reason — readiness fails CLOSED.  Identical
+    (reason, detail) pairs dedupe: two servers' SLO monitors judging
+    the same process-global counters must not double-report."""
+    reasons = _builtin_reasons()
+    with _SOURCES_LOCK:
+        sources = list(_READINESS_SOURCES)
+    for fn in sources:
+        try:
+            reasons.extend(fn() or [])
+        except Exception as exc:  # noqa: BLE001 - a broken probe is unready
+            reasons.append({
+                "reason": "probe_error",
+                "detail": f"readiness source raised {type(exc).__name__}",
+            })
+    seen = set()
+    unique = []
+    for r in reasons:
+        key = (r.get("reason"), r.get("detail"))
+        if key not in seen:
+            seen.add(key)
+            unique.append(r)
+    return (not unique), unique
+
+
+def status_snapshot() -> dict:
+    """The ``/statusz`` payload: identity, uptime, readiness verdict,
+    breaker states, pressure caps, the flight recorder's tail, and
+    every registered status source's contribution."""
+    ready, reasons = readiness()
+    out: dict = {
+        "pid": os.getpid(),
+        "uptime_s": round(time.monotonic() - _START_MONO, 3),
+        "started_at": _START_WALL,
+        "ready": ready,
+        "reasons": reasons,
+    }
+    try:
+        from flink_ml_tpu.obs.report import device_topology, git_sha
+
+        out["git_sha"] = git_sha()
+        out["device"] = device_topology()
+    except Exception:  # noqa: BLE001 - status must degrade, not die
+        pass
+    try:
+        from flink_ml_tpu.serve.breaker import breaker_states
+
+        out["breakers"] = breaker_states()
+    except Exception:  # noqa: BLE001
+        out["breakers"] = {}
+    try:
+        from flink_ml_tpu.fault import pressure
+
+        out["pressure_caps"] = pressure.current_caps()
+    except Exception:  # noqa: BLE001
+        out["pressure_caps"] = {}
+    try:
+        from flink_ml_tpu.obs import flight
+
+        out["flight_tail"] = flight.events()[-10:]
+    except Exception:  # noqa: BLE001
+        out["flight_tail"] = []
+    snap = registry().snapshot()
+    out["registry"] = {k: len(v) for k, v in snap.items()}
+    with _SOURCES_LOCK:
+        sources = dict(_STATUS_SOURCES)
+    for key, fn in sorted(sources.items()):
+        try:
+            out[key] = fn()
+        except Exception as exc:  # noqa: BLE001
+            out[key] = {"error": type(exc).__name__}
+    return out
+
+
+# -- the HTTP endpoint --------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one scrape per connection is the norm; keep-alive just pins threads
+    protocol_version = "HTTP/1.0"
+
+    def _send(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, render_openmetrics(), _CONTENT_TYPE)
+            elif path == "/healthz":
+                self._send(200, json.dumps({
+                    "ok": True, "pid": os.getpid(),
+                    "uptime_s": round(time.monotonic() - _START_MONO, 3),
+                }) + "\n", "application/json")
+            elif path == "/readyz":
+                ready, reasons = readiness()
+                self._send(
+                    200 if ready else 503,
+                    json.dumps({"ready": ready, "reasons": reasons},
+                               sort_keys=True) + "\n",
+                    "application/json",
+                )
+            elif path == "/statusz":
+                self._send(
+                    200,
+                    json.dumps(status_snapshot(), sort_keys=True,
+                               default=repr, indent=1) + "\n",
+                    "application/json",
+                )
+            else:
+                self._send(404, json.dumps({
+                    "error": f"unknown path {path!r}",
+                    "paths": ["/metrics", "/healthz", "/readyz",
+                              "/statusz"],
+                }) + "\n", "application/json")
+        except BrokenPipeError:  # scraper hung up mid-response
+            pass
+        except Exception as exc:  # noqa: BLE001 - a scrape must never kill
+            try:
+                self._send(500, f"telemetry error: {type(exc).__name__}\n",
+                           "text/plain")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def log_message(self, *args) -> None:  # silence per-request stderr spam
+        pass
+
+
+class TelemetryServer:
+    """One embedded telemetry endpoint: bind, serve on a daemon thread,
+    stop cleanly.  ``port=0`` binds an ephemeral port (read it back from
+    :attr:`port` once started); ``port=None`` resolves
+    ``FMT_TELEMETRY_PORT`` and raises ``ValueError`` when telemetry is
+    not configured — the caller should have checked :func:`env_port`."""
+
+    def __init__(self, port: Optional[int] = None,
+                 host: Optional[str] = None):
+        if port is None:
+            port = env_port()
+            if port is None:
+                raise ValueError(
+                    "telemetry is not configured: pass port= or set "
+                    "FMT_TELEMETRY_PORT (0 = ephemeral)"
+                )
+        self._port_requested = int(port)
+        self._host = host or _env_host()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The BOUND port (None before start) — with ``port=0`` this is
+        where the ephemeral listener actually landed."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self._host}:{self.port}{path}"
+
+    def start(self) -> "TelemetryServer":
+        """Bind and serve.  Raises ``OSError`` when the port is taken —
+        the caller decides whether that is fatal (a standalone exporter)
+        or survivable (a model server keeps serving without /metrics)."""
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self._host, self._port_requested),
+                                    _Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="fmt-telemetry",
+            daemon=True, kwargs={"poll_interval": 0.1},
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Shut the listener down and join the thread.  Idempotent."""
+        httpd, thread = self._httpd, self._thread
+        self._httpd, self._thread = None, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+
+# -- module-level singleton (standalone processes: training jobs, tools) ------
+
+_SERVER_LOCK = threading.Lock()
+_SERVER: Optional[TelemetryServer] = None
+
+
+def start(port: Optional[int] = None,
+          host: Optional[str] = None) -> Optional[TelemetryServer]:
+    """Start the process-wide standalone endpoint (idempotent).  With
+    ``port=None`` and no ``FMT_TELEMETRY_PORT`` this is a no-op
+    returning None — callers can sprinkle it unconditionally."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        if port is None and env_port() is None:
+            return None
+        _SERVER = TelemetryServer(port=port, host=host).start()
+        return _SERVER
+
+
+def stop() -> None:
+    """Stop the process-wide standalone endpoint (no-op when absent)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        server, _SERVER = _SERVER, None
+    if server is not None:
+        server.stop()
+
+
+def active_server() -> Optional[TelemetryServer]:
+    with _SERVER_LOCK:
+        return _SERVER

@@ -56,6 +56,7 @@ from flink_ml_tpu.fault.retry import is_transient, with_retry
 __all__ = [
     "CircuitBreaker",
     "breaker",
+    "breaker_states",
     "dispatch",
     "open_breaker_names",
     "reset_breakers",
@@ -232,6 +233,16 @@ def reset_breakers() -> None:
     with _BREAKERS_LOCK:
         _BREAKERS.clear()
         _STATE_GEN += 1
+
+
+def breaker_states() -> Dict[str, float]:
+    """Every breaker's current state by name (0.0 closed / 0.5
+    half-open / 1.0 open — the gauge vocabulary).  The telemetry
+    plane's ``/statusz`` snapshot reads this instead of scraping the
+    per-breaker gauges, which only exist while obs is enabled."""
+    with _BREAKERS_LOCK:
+        breakers = list(_BREAKERS.values())
+    return {b.name: b.state for b in breakers}
 
 
 def open_breaker_names() -> list:

@@ -57,10 +57,19 @@ allocator OOM by splitting the coalesced batch at request boundaries
 state capping subsequent coalescing until the AIMD probe restores full
 batches.
 
-Knobs (BASELINE.md round-10/12 tables): ``FMT_SERVING_MAX_BATCH``,
+Live telemetry (ISSUE 10, ``FMT_TELEMETRY_PORT`` / the
+``telemetry_port`` argument): the server brings up an embedded
+OpenMetrics endpoint (``/metrics`` / ``/healthz`` / ``/readyz`` /
+``/statusz``) and the SLO burn-rate monitor with its lifecycle —
+``/readyz`` degrades reason-coded on open breakers, pressure caps,
+deploys in progress, a saturating queue, and burning SLOs
+(:mod:`flink_ml_tpu.obs.telemetry` / :mod:`flink_ml_tpu.obs.slo`).
+
+Knobs (BASELINE.md round-10/12/13 tables): ``FMT_SERVING_MAX_BATCH``,
 ``FMT_SERVING_MAX_WAIT_MS``, ``FMT_SERVING_QUEUE_CAP``,
 ``FMT_SERVING_QUEUE_CAP_MB``, ``FMT_SERVING_DEADLINE_MS``,
-``FMT_SERVING_SHED_ON_BREAKER``.
+``FMT_SERVING_SHED_ON_BREAKER``, ``FMT_TELEMETRY_PORT``,
+``FMT_SLO_WINDOW_S``, ``FMT_SLO_P99_MS``, ``FMT_SLO_ERR_RATIO``.
 """
 
 from __future__ import annotations
@@ -155,6 +164,7 @@ class ModelServer:
                  queue_cap_mb: Optional[float] = None,
                  deadline_ms: Optional[float] = None,
                  shed_on_breaker: Optional[bool] = None,
+                 telemetry_port: Optional[int] = None,
                  start: bool = True):
         if (model is None) == (path is None):
             raise ValueError("pass exactly one of model / path")
@@ -208,6 +218,18 @@ class ModelServer:
         # generation counter — an opening breaker sheds immediately) or
         # after ~50 ms (a cooldown EXPIRING fires no transition)
         self._breaker_memo = (float("-inf"), -1, [])
+        # live telemetry plane (ISSUE 10): the endpoint + SLO monitor
+        # come up with the server — even a paused (start=False) server
+        # is scrapeable, and its saturated queue shows in /readyz
+        self._telemetry = None
+        self._slo = None
+        self._status_key: Optional[str] = None
+        from flink_ml_tpu.obs import telemetry as _telemetry_mod
+
+        port = (telemetry_port if telemetry_port is not None
+                else _telemetry_mod.env_port())
+        if port is not None:
+            self._start_telemetry(port)
         if start:
             self.start()
 
@@ -282,7 +304,93 @@ class ModelServer:
                 if batch is None:
                     break
                 self._serve_batch(batch)
+        self._stop_telemetry()
         self._write_report()
+
+    # -- live telemetry plane (ISSUE 10) -------------------------------------
+
+    @property
+    def telemetry(self):
+        """This server's :class:`~flink_ml_tpu.obs.telemetry.
+        TelemetryServer` (None when telemetry is off or failed to bind)."""
+        return self._telemetry
+
+    def _start_telemetry(self, port: int) -> None:
+        """Bring up the /metrics endpoint + SLO monitor and plug this
+        server's readiness/status into them.  A bind failure warns and
+        leaves the server serving — telemetry must never take down the
+        traffic it observes."""
+        import warnings
+
+        from flink_ml_tpu.obs import slo as slo_mod
+        from flink_ml_tpu.obs import telemetry as telemetry_mod
+
+        try:
+            self._telemetry = telemetry_mod.TelemetryServer(
+                port=port).start()
+        except OSError as exc:
+            warnings.warn(
+                f"telemetry endpoint failed to bind port {port}: {exc}; "
+                "serving continues without /metrics",
+                RuntimeWarning, stacklevel=3,
+            )
+            self._telemetry = None
+            return
+        telemetry_mod.register_readiness(self._readiness_reasons)
+        self._status_key = telemetry_mod.register_status(
+            "server", self._telemetry_status)
+        self._slo = slo_mod.SLOMonitor().start()
+
+    def _stop_telemetry(self) -> None:
+        if self._slo is not None:
+            self._slo.stop()
+            self._slo = None
+        if self._telemetry is not None:
+            from flink_ml_tpu.obs import telemetry as telemetry_mod
+
+            telemetry_mod.unregister_readiness(self._readiness_reasons)
+            if self._status_key is not None:
+                telemetry_mod.unregister_status(self._status_key)
+                self._status_key = None
+            self._telemetry.stop()
+            self._telemetry = None
+
+    def _readiness_reasons(self) -> List[dict]:
+        """This server's /readyz feed: a deploy mid-flight and a
+        saturating queue both mean "stop routing here" BEFORE admission
+        starts shedding.  Plain int reads — no lock: readiness is a
+        heuristic probe, and a stale-by-one row count cannot matter."""
+        from flink_ml_tpu.obs import telemetry as telemetry_mod
+
+        reasons: List[dict] = []
+        if self._versions.deploy_in_progress:
+            reasons.append({
+                "reason": "deploy_in_progress",
+                "detail": f"deploying over {self.active_version!r}",
+            })
+        cap = self.config.queue_cap
+        saturated_at = max(1, int(cap * telemetry_mod.
+                                  queue_saturation_frac()))
+        if self._queued_rows >= saturated_at:
+            reasons.append({
+                "reason": "queue_saturated",
+                "detail": (f"{self._queued_rows} of {cap} queue-cap rows "
+                           f"queued (saturation at {saturated_at})"),
+            })
+        return reasons
+
+    def _telemetry_status(self) -> dict:
+        """This server's /statusz contribution."""
+        return {
+            "active_version": self.active_version,
+            "versions": self.versions,
+            "running": self.running,
+            "deploy_in_progress": self._versions.deploy_in_progress,
+            "queued_rows": self._queued_rows,
+            "queue_cap": self.config.queue_cap,
+            "max_batch": self.config.max_batch,
+            "stats": self.stats(),
+        }
 
     # -- the request path ----------------------------------------------------
 

@@ -72,6 +72,16 @@ class VersionManager:
         self._lock = threading.Lock()
         self._active: Optional[ModelVersion] = None
         self._history: List[str] = []  # version labels in deploy order
+        self._deploying = 0  # deploys currently loading/warming
+
+    @property
+    def deploy_in_progress(self) -> bool:
+        """Is a deploy mid-flight (loading, verifying, pre-warming)?
+        The telemetry plane's ``/readyz`` degrades on this: a replica
+        compiling a new version's plans is about to swap and should not
+        take fresh traffic it would serve with cold-warmup latency."""
+        with self._lock:
+            return self._deploying > 0
 
     def active(self) -> ModelVersion:
         with self._lock:
@@ -100,6 +110,8 @@ class VersionManager:
         without one the first live batch pays them (logged as a counter,
         not an error).  Any failure leaves the previous version active.
         """
+        with self._lock:
+            self._deploying += 1
         try:
             model = (
                 _load_model(model_or_path)
@@ -130,6 +142,9 @@ class VersionManager:
             )
             obs.flight.dump("deploy_failure")
             raise
+        finally:
+            with self._lock:
+                self._deploying -= 1
         with self._lock:
             swapped = self._active is not None
             prev = self._history[-1] if self._history else None

@@ -80,6 +80,31 @@ stacks:
      reason-coded ``memory_pressure`` ``ServerOverloadedError`` and a
      flight-recorder dump must land for it.
 
+**Telemetry mode** (``--telemetry``, ISSUE 10): the live-plane
+counterpart — the OpenMetrics exporter and readiness endpoints under
+real load and a real degradation:
+
+  1. **scrape under load** — with concurrent request traffic flowing
+     through ``ModelServer``, ``GET /metrics`` must parse as valid
+     OpenMetrics text (the strict independent parser, not the
+     renderer), and every exported counter must sit within the
+     ``registry().snapshot()`` bounds taken around the scrape — the
+     exporter publishes the registry, not an approximation of it;
+  2. **readiness degrades and recovers** — a sticky injected
+     ``serve.dispatch`` fault drives the circuit breaker open:
+     ``/readyz`` must flip to 503 with the machine-readable
+     ``breaker_open`` reason (and ``/statusz`` must show the open
+     breaker + the active model version); once the fault clears and
+     the cooldown elapses, a served probe closes the breaker and
+     ``/readyz`` must return 200;
+  3. **SLO burn-rate** — the shed traffic from the open-breaker window
+     must drive the ``shed_error_ratio`` SLO monitor into breach
+     (``slo.burning.*`` gauge set, a ``slo_breach`` flight dump whose
+     header names the SLO and its burn rate), and recover after clean
+     traffic;
+  4. **lifecycle** — ``shutdown`` must take the endpoint down with the
+     server (no orphaned listener).
+
 **Trace mode** (``--trace``, ISSUE 8): the observability counterpart —
 end-to-end request tracing plus the black-box flight recorder:
 
@@ -910,6 +935,168 @@ def pressure_main() -> int:
     return 0
 
 
+def telemetry_main() -> int:
+    """The live-telemetry chaos matrix (``--telemetry``, ISSUE 10)."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+    import warnings
+
+    os.environ["FMT_OBS_REPORTS"] = tempfile.mkdtemp(
+        prefix="chaos_telemetry_reports_"
+    )
+    os.environ["FMT_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos_telemetry_flight_"
+    )
+    os.environ["FMT_FLIGHT_MIN_S"] = "0"  # every dump lands (test mode)
+    os.environ["FMT_SERVE_BREAKER_THRESHOLD"] = "2"
+    os.environ["FMT_SERVE_BREAKER_COOLDOWN_S"] = "0.75"
+    from flink_ml_tpu import fault, obs, serve
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import flight, slo, telemetry
+    from flink_ml_tpu.serving import ModelServer, ServerOverloadedError
+
+    serve.reset_breakers()
+    obs.reset()
+    flight.reset()
+    table = dense_table()
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+
+    server = ModelServer(model, version="v1", max_batch=64,
+                         max_wait_ms=1.0, telemetry_port=0,
+                         warmup=table.slice_rows(0, 4))
+    assert server.telemetry is not None and server.telemetry.port, (
+        "telemetry_port=0 did not bind an ephemeral endpoint"
+    )
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(server.telemetry.url(path),
+                                        timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    # -- leg 1: scrape under concurrent load ----------------------------------
+    stop = threading.Event()
+    served = []
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            lo = (i * 8) % (N - 8)
+            served.append(
+                server.predict(table.slice_rows(lo, lo + 8), timeout=60)
+            )
+            i += 1
+
+    loader = threading.Thread(target=load)
+    loader.start()
+    while len(served) < 4:  # traffic genuinely concurrent with the scrape
+        time.sleep(0.002)
+    snap_before = obs.registry().snapshot()["counters"]
+    status, text = get("/metrics")
+    snap_after = obs.registry().snapshot()["counters"]
+    stop.set()
+    loader.join()
+    assert status == 200, status
+    samples = telemetry.parse_openmetrics(text)  # raises on malformed text
+    checked = telemetry.counters_within_bounds(
+        snap_before, samples, snap_after)  # raises on an out-of-bounds one
+    assert checked >= 5, f"only {checked} counters cross-checked"
+    for probe in ("/healthz", "/readyz"):
+        status, _ = get(probe)
+        assert status == 200, (probe, status)
+    print(f"  scrape: {len(samples)} samples parsed under load, "
+          f"{checked} counters within snapshot bounds")
+
+    # -- leg 2: sticky dispatch fault -> breaker open -> /readyz 503 ---------
+    mon = slo.SLOMonitor(window=60, err_ratio=0.01, min_arrivals=5)
+    sheds = 0
+    fault.configure("serve.dispatch@1+", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for i in range(8):
+                try:
+                    server.predict(table.slice_rows(i * 4, i * 4 + 4),
+                                   timeout=120)
+                except ServerOverloadedError as exc:
+                    assert exc.reason == "breaker_open", exc.reason
+                    sheds += 1
+        assert sheds, "sticky dispatch fault never opened the breaker"
+        status, body = get("/readyz")
+        assert status == 503, (status, body)
+        payload = json.loads(body)
+        assert payload["ready"] is False, payload
+        reasons = {r["reason"] for r in payload["reasons"]}
+        assert "breaker_open" in reasons, payload
+        status, body = get("/statusz")
+        st = json.loads(body)
+        assert any(v == 1.0 for v in st["breakers"].values()), st["breakers"]
+        assert st["server"]["active_version"] == "v1", st["server"]
+        print(f"  readiness: breaker open -> /readyz 503 "
+              f"{sorted(reasons)}, statusz shows "
+              f"{[k for k, v in st['breakers'].items() if v == 1.0]}")
+
+        # -- leg 3: the shed window burns the error-ratio SLO -----------------
+        res = mon.sample_once()
+        verdict = res.get("shed_error_ratio")
+        assert verdict and verdict["burning"], res
+        assert verdict["burn_rate"] > 1.0, verdict
+        gauges = obs.registry().snapshot()["gauges"]
+        assert gauges.get("slo.burning.shed_error_ratio") == 1.0, gauges
+        dump_path = flight.last_dump_path()
+        assert dump_path and os.path.exists(dump_path), (
+            "no slo_breach flight dump landed")
+        header = json.loads(open(dump_path).readline())
+        assert header["reason"] == "slo_breach", header
+        assert header["slo"] == "shed_error_ratio", header
+        assert header["burn_rate"] == round(verdict["burn_rate"], 4), header
+        print(f"  slo: shed window burned at "
+              f"{verdict['burn_rate']:.1f}x, black box "
+              f"{os.path.basename(dump_path)} header names it")
+    finally:
+        fault.configure(None)
+
+    # -- leg 4: recovery ------------------------------------------------------
+    time.sleep(0.8)  # breaker cooldown elapses
+    server.predict(table.slice_rows(0, 8), timeout=60)  # probe closes it
+    status, body = get("/readyz")
+    assert status == 200, (status, body)
+    for _ in range(20):  # clean traffic clears the SLO breach
+        server.predict(table.slice_rows(0, 4), timeout=60)
+    res = mon.sample_once()
+    assert not res["shed_error_ratio"]["burning"], res
+    gauges = obs.registry().snapshot()["gauges"]
+    assert gauges.get("slo.burning.shed_error_ratio") == 0.0, gauges
+    print("  recovery: breaker closed -> /readyz 200, SLO burn cleared")
+
+    # -- leg 5: the endpoint dies with the server -----------------------------
+    url = server.telemetry.url("/healthz")
+    server.shutdown()
+    assert server.telemetry is None
+    try:
+        urllib.request.urlopen(url, timeout=2)
+        raise AssertionError("telemetry endpoint survived shutdown")
+    except (urllib.error.URLError, ConnectionError, OSError):
+        pass
+    serve.reset_breakers()
+    for var in ("FMT_SERVE_BREAKER_THRESHOLD",
+                "FMT_SERVE_BREAKER_COOLDOWN_S", "FMT_FLIGHT_MIN_S"):
+        os.environ.pop(var, None)
+    print("telemetry chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -922,6 +1109,8 @@ def main() -> int:
         return trace_main()
     if "--pressure" in sys.argv:
         return pressure_main()
+    if "--telemetry" in sys.argv:
+        return telemetry_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -93,6 +93,45 @@ class TestRegistry:
         obs.gauge_set("g", 3.0)
         json.dumps(obs.registry().snapshot())
 
+    def test_timingstat_exporter_fields(self):
+        """ISSUE 10: to_dict carries the monotonic count/sum_s and the
+        p90 an OpenMetrics summary wants, alongside the existing stats."""
+        from flink_ml_tpu.obs.registry import TimingStat
+
+        t = TimingStat()
+        for v in range(10):
+            t.observe(float(v))
+        d = t.to_dict()
+        assert d["count"] == 10
+        assert d["sum_s"] == d["total_s"] == pytest.approx(45.0)
+        # nearest-rank over 0..9: p50 -> 4, p90 -> 8, p99 -> 9
+        assert d["p50_s"] == 4.0
+        assert d["p90_s"] == 8.0
+        assert d["p99_s"] == 9.0
+
+    def test_timingstat_recent_is_the_newest_window(self):
+        from flink_ml_tpu.obs.registry import TimingStat
+
+        t = TimingStat()
+        for i in range(5):
+            t.observe(float(i))
+        assert t.recent(3) == [2.0, 3.0, 4.0]
+        assert t.recent(100) == [0.0, 1.0, 2.0, 3.0, 4.0]
+        assert t.recent(0) == []
+        # past the reservoir the ring wraps: recent() must still return
+        # the newest-k in arrival order, not a rotated slice
+        for i in range(5, t.RESERVOIR + 40):
+            t.observe(float(i))
+        want = [float(t.RESERVOIR + 40 - k) for k in range(4, 0, -1)]
+        assert t.recent(4) == want
+
+    def test_registry_timing_recent_accessor(self):
+        obs.enable()
+        for i in range(6):
+            obs.observe("t.win", float(i))
+        assert obs.registry().timing_recent("t.win", 2) == [4.0, 5.0]
+        assert obs.registry().timing_recent("t.never", 2) == []
+
 
 class TestRunReports:
     def test_write_and_load_roundtrip(self, tmp_path):
@@ -130,9 +169,10 @@ class TestRunReports:
         assert b["metrics"]["counters"]["train.epochs"] == 2
         assert b["metrics"]["timings"]["train.dispatch"] == {
             "count": 1, "total_s": 0.25, "mean_s": 0.25,
-            # tail quantiles ride along (ISSUE 8): window quantiles over
-            # the stat's recent reservoir, not delta-exact accounting
-            "p50_s": 0.25, "p99_s": 1.0,
+            # tail quantiles ride along (ISSUE 8, p90 since ISSUE 10):
+            # window quantiles over the stat's recent reservoir, not
+            # delta-exact accounting
+            "p50_s": 0.25, "p90_s": 1.0, "p99_s": 1.0,
         }
         assert c["metrics"]["counters"] == {}
         assert c["metrics"]["timings"] == {}
@@ -357,10 +397,142 @@ class TestBaselineDiff:
         assert report_main(["--reports", d, "--baseline", base]) == 0
 
     def test_cli_empty_baseline_is_not_an_error(self, tmp_path, capsys):
+        # reports exist; the baseline just has no measured section yet
+        d = _reports(tmp_path, [
+            {"metric": "m", "value": 1.0, "unit": "rows/sec"},
+        ])
         base = _baseline(tmp_path, {})
-        assert report_main(["--reports", str(tmp_path), "--baseline",
+        assert report_main(["--reports", d, "--baseline",
                             base, "--check"]) == 0
         assert "nothing to diff" in capsys.readouterr().out
+
+    def test_cli_missing_reports_is_one_line_diagnostic(self, tmp_path,
+                                                        capsys):
+        """ISSUE 10 satellite: a missing or empty reports dir is an
+        operator mistake — --check fails with ONE diagnostic line (no
+        traceback, no silently-green diff)."""
+        base = _baseline(tmp_path, {"a": {"value": 1.0,
+                                          "unit": "rows/sec",
+                                          "backend": "cpu"}})
+        missing = str(tmp_path / "never_written")
+        assert report_main(["--reports", missing, "--baseline", base,
+                            "--check"]) == 1
+        out = capsys.readouterr().out.strip()
+        assert len(out.splitlines()) == 1
+        assert "no RunReports" in out and missing in out
+        # informational mode stays exit 0 (matching the empty-baseline
+        # convention), but still prints the diagnostic
+        assert report_main(["--reports", missing,
+                            "--baseline", base]) == 0
+        assert "no RunReports" in capsys.readouterr().out
+        # --json keeps the machine-readable shape
+        assert report_main(["--reports", missing, "--baseline", base,
+                            "--check", "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["ok"] is False and "no RunReports" in payload["error"]
+
+    def test_cli_last_bounds_the_diffed_reports(self, tmp_path, capsys):
+        """--last N diffs only the newest N RunReports — the bound for
+        an append-only runs.jsonl that has grown for months."""
+        import jax
+
+        backend = jax.default_backend()
+        d = _reports(tmp_path, [
+            {"metric": "a", "value": 100.0, "unit": "rows/sec"},
+            {"metric": "b", "value": 100.0, "unit": "rows/sec"},
+        ])
+        base = _baseline(tmp_path, {
+            "a": {"value": 100.0, "unit": "rows/sec", "backend": backend},
+            "b": {"value": 100.0, "unit": "rows/sec", "backend": backend},
+        })
+        assert report_main(["--reports", d, "--baseline", base,
+                            "--check"]) == 0
+        capsys.readouterr()  # drain the unbounded run's output
+        # bounded to the newest single report, metric a drops out
+        report_main(["--reports", d, "--baseline", base, "--last", "1"])
+        out = capsys.readouterr().out
+        assert "no-report" in out
+        rows = [line for line in out.splitlines()
+                if line.startswith("a ")]
+        assert rows and "no-report" in rows[0]
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+class TestHbmGauges:
+    """ISSUE 10 satellite: record_hbm_gauges was exercised nowhere in
+    tier-1 (the CPU container's devices usually report no memory stats)
+    — pin down both halves of its contract."""
+
+    def test_gauges_appear_under_hbm_prefix(self, monkeypatch):
+        import jax
+
+        obs.enable()
+        monkeypatch.setattr(jax, "local_devices", lambda: [
+            _FakeDevice({"bytes_in_use": 10, "peak_bytes_in_use": 30,
+                         "bytes_limit": 100}),
+            _FakeDevice({"bytes_in_use": 20, "peak_bytes_in_use": 25,
+                         "bytes_limit": 100}),
+        ])
+        obs.record_hbm_gauges()
+        gauges = obs.registry().snapshot()["gauges"]
+        # max over local devices, each key under hbm.*
+        assert gauges["hbm.bytes_in_use"] == 20
+        assert gauges["hbm.peak_bytes_in_use"] == 30
+        assert gauges["hbm.bytes_limit"] == 100
+        assert all(k.startswith("hbm.") for k in gauges)
+
+    def test_custom_prefix(self, monkeypatch):
+        import jax
+
+        obs.enable()
+        monkeypatch.setattr(jax, "local_devices", lambda: [
+            _FakeDevice({"bytes_in_use": 7}),
+        ])
+        obs.record_hbm_gauges(prefix="post_spill")
+        gauges = obs.registry().snapshot()["gauges"]
+        assert gauges == {"post_spill.bytes_in_use": 7}
+
+    def test_noop_when_backend_reports_no_stats(self, monkeypatch):
+        import jax
+
+        obs.enable()
+        monkeypatch.setattr(jax, "local_devices", lambda: [
+            _FakeDevice(None), _FakeDevice({})])
+        obs.record_hbm_gauges()  # must not raise
+        assert obs.registry().snapshot()["gauges"] == {}
+
+    def test_partial_stats_record_what_exists(self, monkeypatch):
+        import jax
+
+        obs.enable()
+        monkeypatch.setattr(jax, "local_devices", lambda: [
+            _FakeDevice({"bytes_in_use": 5}),  # no peak / limit keys
+        ])
+        obs.record_hbm_gauges()
+        assert obs.registry().snapshot()["gauges"] == {
+            "hbm.bytes_in_use": 5}
+
+    def test_real_cpu_backend_never_raises(self):
+        obs.enable()
+        obs.record_hbm_gauges()  # whatever this backend reports: no error
+        gauges = obs.registry().snapshot()["gauges"]
+        assert all(k.startswith("hbm.") for k in gauges)
+
+    def test_disabled_is_a_noop(self, monkeypatch):
+        import jax
+
+        assert not obs.enabled()
+        monkeypatch.setattr(jax, "local_devices", lambda: [
+            _FakeDevice({"bytes_in_use": 10})])
+        obs.record_hbm_gauges()
+        assert obs.registry().snapshot()["gauges"] == {}
 
 
 class TestHotPathWiring:
