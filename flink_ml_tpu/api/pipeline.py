@@ -141,17 +141,31 @@ class PipelineModel(Model):
                 serve0 = serve_counter_snapshot()
             # top-level transforms root a trace (FMT_TRACE); inside a
             # served batch this degrades to a child span under the
-            # dispatcher's handed-off request context(s)
-            with obs.trace.root_span("pipeline", {
-                "stages": len(self.stages),
-            }):
-                if len(inputs) == 1 and isinstance(inputs[0], Table) \
-                        and len(self.stages) > 1 and fused.fusion_enabled():
-                    out = fused.transform_fused(self, inputs)
-                else:
-                    out = inputs
-                    for stage in self.stages:
-                        out = stage.transform(*out)
+            # dispatcher's handed-off request context(s).  Same rule for
+            # the drift scope (FMT_DRIFT, ISSUE 11): the OUTERMOST
+            # transform owns the tap scope, so stage transforms inside
+            # this chain never double-sketch the same rows.
+            with obs.drift.transform_scope() as dscope:
+                with obs.trace.root_span("pipeline", {
+                    "stages": len(self.stages),
+                }):
+                    if len(inputs) == 1 and isinstance(inputs[0], Table) \
+                            and len(self.stages) > 1 \
+                            and fused.fusion_enabled():
+                        out = fused.transform_fused(self, inputs)
+                    else:
+                        out = inputs
+                        for stage in self.stages:
+                            out = stage.transform(*out)
+                if dscope is not None and len(inputs) == 1 \
+                        and isinstance(inputs[0], Table) \
+                        and len(out) == 1 and isinstance(out[0], Table):
+                    # produced (score/prediction) columns into the live
+                    # window, input columns excluded
+                    dscope.observe_scores(
+                        out[0],
+                        exclude=frozenset(inputs[0].schema.field_names),
+                    )
             if serve0 is not None and len(inputs) == 1 \
                     and isinstance(inputs[0], Table):
                 from flink_ml_tpu.obs.report import transform_report

@@ -508,6 +508,13 @@ class FusedRun:
                     if name in self.device_cols
                 }
             else:
+                if self.validators:
+                    # fused-plan-entry drift tap (ISSUE 11): the entry-
+                    # validated survivors, observed on the CONSUMER
+                    # thread (the prefetch producer has no tap scope);
+                    # the scope's owner rule dedupes against the staged
+                    # fallback's per-stage boundary
+                    obs.drift.observe_input(self.validators[0], t)
                 out = serve.dispatch(
                     self.serve_name,
                     device=lambda: self._bisected_batch(

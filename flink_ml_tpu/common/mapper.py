@@ -215,11 +215,20 @@ class Mapper:
             return batch, None
         verdict = self.validate_batch(batch)
         if verdict is None:
+            # every row servable: the drift tap (ISSUE 11) still sees
+            # the batch — the common case IS the live distribution
+            obs.drift.observe_input(self, batch)
             return batch, None
         good_mask, reasons = verdict
         quarantine.emit(self.serve_name(), batch, good_mask, reasons,
                         row_offset=row_offset)
-        return batch.filter_rows(good_mask), np.asarray(good_mask, bool)
+        fb = batch.filter_rows(good_mask)
+        # survivors only: quarantined rows are tracked by the reason-
+        # coded feed (quarantine.emit -> drift.observe_quarantine), and
+        # a NaN masked out of the computation must not poison the
+        # distribution the model actually served
+        obs.drift.observe_input(self, fb)
+        return fb, np.asarray(good_mask, bool)
 
     def _map_checked(self, batch: Table, validated: bool) -> Dict:
         """The compute half: map the (surviving) rows and row-align-check

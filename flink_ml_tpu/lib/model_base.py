@@ -104,11 +104,21 @@ class TableModelBase(Model):
         serve0 = serve_counter_snapshot() if _obs.enabled() else None
         # top-level transforms root a trace (FMT_TRACE); inside an
         # already-traced region (a pipeline stage, a served batch) this
-        # degrades to a child span under the caller's context
-        with _obs.trace.root_span("stage", {
-            "stage": type(self).__name__, "rows": table.num_rows(),
-        }):
-            out = mapper.apply(table, batch_size=batch)
+        # degrades to a child span under the caller's context.  The
+        # drift scope (FMT_DRIFT, ISSUE 11) is a no-op inside a serving
+        # batch or an outer pipeline the same way.
+        with _obs.drift.transform_scope() as dscope:
+            with _obs.trace.root_span("stage", {
+                "stage": type(self).__name__, "rows": table.num_rows(),
+            }):
+                out = mapper.apply(table, batch_size=batch)
+            if dscope is not None:
+                # produced (score/prediction) columns into the live
+                # window — the standalone-transform twin of the serving
+                # demux tap
+                dscope.observe_scores(
+                    out, exclude=frozenset(table.schema.field_names)
+                )
         if serve0 is not None:
             from flink_ml_tpu.obs.report import transform_report
             from flink_ml_tpu.serve import serve_counter_delta

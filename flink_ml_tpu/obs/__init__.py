@@ -33,6 +33,18 @@ ISSUE 8 added the per-request layer on top of the aggregates:
     retries/rollbacks, plan fallbacks) dumped as a redacted JSONL black
     box on breaker-open, deploy failure, guard rollback, or crash.
 
+ISSUE 11 added the DATA plane next to the system plane:
+
+  * :mod:`flink_ml_tpu.obs.sketch` — mergeable fixed-memory streaming
+    distribution sketches (DDSketch-style quantiles + count/mean/var/
+    null/NaN accumulators per column).
+  * :mod:`flink_ml_tpu.obs.drift` — the ``DriftMonitor``: a reference
+    distribution snapshotted at deploy (persisted next to the model),
+    a rolling live window tapped at the quarantine boundary / fused
+    plan entry / serving demux, PSI+KS per column, the third (``drift``)
+    SLO, and the ``python -m flink_ml_tpu.obs drift`` comparison CLI
+    (``FMT_DRIFT``, off by default).
+
 ISSUE 10 added the LIVE plane on top of the post-hoc layers:
 
   * :mod:`flink_ml_tpu.obs.telemetry` — an embedded HTTP endpoint
@@ -47,7 +59,7 @@ ISSUE 10 added the LIVE plane on top of the post-hoc layers:
     and ``/readyz``.
 """
 
-from flink_ml_tpu.obs import flight, slo, telemetry, trace  # noqa: F401
+from flink_ml_tpu.obs import drift, flight, sketch, slo, telemetry, trace  # noqa: F401
 from flink_ml_tpu.obs.registry import (
     MetricsRegistry,
     counter_add,
@@ -78,6 +90,7 @@ __all__ = [
     "bench_report",
     "counter_add",
     "disable",
+    "drift",
     "enable",
     "enabled",
     "fit_report",
@@ -92,6 +105,7 @@ __all__ = [
     "registry",
     "reports_dir",
     "reset",
+    "sketch",
     "slo",
     "telemetry",
     "trace",

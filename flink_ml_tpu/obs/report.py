@@ -269,6 +269,19 @@ def _transform_timing_quantiles() -> dict:
     return out
 
 
+def _drift_report_section() -> Optional[dict]:
+    """The default drift monitor's compact record (ISSUE 11) — rides
+    every transform RunReport while ``FMT_DRIFT`` is on so ``--check``
+    and the ``obs drift`` CLI read drift off the same reports as
+    everything else.  None when drift is off/idle."""
+    try:
+        from flink_ml_tpu.obs.drift import report_section
+
+        return report_section()
+    except Exception:  # noqa: BLE001 - telemetry must never fail a run
+        return None
+
+
 def _current_trace_id() -> Optional[str]:
     """The active trace id (None when tracing is off / nothing active)."""
     try:
@@ -302,6 +315,9 @@ def transform_report(name: str, rows: int, serve_delta: dict,
         timings = _transform_timing_quantiles()
         if timings:
             extra_out.setdefault("timings", timings)
+        drift_section = _drift_report_section()
+        if drift_section is not None:
+            extra_out.setdefault("drift", drift_section)
         tid = _current_trace_id()
         if tid:
             extra_out.setdefault("trace_id", tid)
@@ -369,6 +385,51 @@ def serve_degraded_runs(reports: List[dict]) -> List[dict]:
                  "rows": (r.get("extra") or {}).get("rows")}
             )
     return flagged
+
+
+def drift_runs(reports: List[dict]) -> List[dict]:
+    """Transform/serving reports carrying a drift section (ISSUE 11) —
+    latest per (kind, name), the fault_assisted_runs visibility rule.
+    Each row summarizes the worst column against the recorded threshold;
+    ``breaching`` is True when it crossed — the ``DRIFT`` line
+    ``--check`` prints next to the perf gates, because a model serving a
+    shifted population is degrading before any throughput number
+    moves."""
+    latest: Dict[tuple, dict] = {}
+    for r in reports:
+        if r.get("kind") in ("transform", "serving") and (
+            (r.get("extra") or {}).get("drift")
+        ):
+            latest[(r.get("kind"), str(r.get("name", "")))] = r
+    out = []
+    for (kind, name), r in sorted(latest.items()):
+        section = (r.get("extra") or {}).get("drift") or {}
+        row = {
+            "kind": kind,
+            "name": name,
+            "ts": r.get("ts"),
+            "git_sha": r.get("git_sha"),
+            "reference_complete": bool(section.get("reference_complete")),
+            "live_rows": section.get("live_rows"),
+            "threshold": section.get("threshold"),
+        }
+        cols = section.get("columns") or []
+        if cols:
+            worst = cols[0]
+            row.update(
+                worst_column=worst.get("column"),
+                psi=worst.get("psi"),
+                ks=worst.get("ks"),
+                breaching=bool(
+                    section.get("threshold")
+                    and worst.get("psi", 0) > section["threshold"]
+                ),
+            )
+        else:
+            row.update(worst_column=None, psi=None, ks=None,
+                       breaching=False)
+        out.append(row)
+    return out
 
 
 #: per-fit timing stats worth a tail-quantile line in ``--check`` output
@@ -641,6 +702,7 @@ def main(argv=None) -> int:
         reports = reports[-args.last:]
     fault_assisted = fault_assisted_runs(reports)
     serve_degraded = serve_degraded_runs(reports)
+    drift_rows = drift_runs(reports)
     timing_summary = timing_quantile_summary(reports)
     rows = diff_against_baseline(reports, baseline, args.threshold)
     regressions = sum(r["status"] == "regression" for r in rows)
@@ -664,6 +726,7 @@ def main(argv=None) -> int:
             "metrics": rows,
             "fault_assisted": fault_assisted,
             "serve_degraded": serve_degraded,
+            "drift": drift_rows,
             "timings": timing_summary,
         }, sort_keys=True, indent=1))
         return 1 if failed else 0
@@ -685,6 +748,23 @@ def main(argv=None) -> int:
         )
         print(f"SERVE-DEGRADED transform {sr['name']} "
               f"[{sr.get('git_sha', '')}]: {counters}")
+    # data-plane drift per surface: the worst column against the deploy
+    # reference — same visibility rule as the flags above
+    for dr in drift_rows:
+        if not dr["reference_complete"]:
+            print(f"DRIFT {dr['kind']} {dr['name']} "
+                  f"[{dr.get('git_sha', '')}]: reference filling "
+                  f"({dr.get('live_rows', 0)} rows)")
+        elif dr["worst_column"] is None:
+            print(f"DRIFT {dr['kind']} {dr['name']} "
+                  f"[{dr.get('git_sha', '')}]: no comparable columns")
+        else:
+            verdict = "BREACH" if dr["breaching"] else "ok"
+            print(f"DRIFT {dr['kind']} {dr['name']} "
+                  f"[{dr.get('git_sha', '')}]: worst "
+                  f"{dr['worst_column']} psi={dr['psi']:g} "
+                  f"ks={dr['ks']:g} (threshold {dr['threshold']:g}) "
+                  f"{verdict}")
     # tail-quantile lines for the latest fit/transform per name: the p99
     # lives next to the throughput gate it explains
     for line in _timing_lines(timing_summary):

@@ -1,0 +1,487 @@
+"""Mergeable fixed-memory streaming distribution sketches (ISSUE 11).
+
+The data-plane observability layer needs to answer "what does this
+column's distribution look like right now, and how does that compare to
+what it looked like at deploy?" without holding the rows.  This module
+is the primitive: a DDSketch-style quantile sketch — log-spaced buckets
+with relative accuracy ``alpha``, so ``quantile(q)`` returns a value
+within ``alpha`` (relative) of the true q-quantile — plus the per-column
+count/mean/var/null/NaN accumulators a drift report wants next to the
+quantiles.
+
+Design constraints (the hot-path contract):
+
+* **one numpy pass per batch** — ``update(values)`` bucketizes a whole
+  column with ``log`` + ``unique`` (no per-row Python), because it runs
+  on rows that are already on host at the serving boundary;
+* **fixed memory** — bucket maps are capped at ``max_bins`` by
+  collapsing the lowest-value buckets together (the DDSketch rule:
+  accuracy degrades only at the far low tail, never at the p50..p99 a
+  drift check reads);
+* **mergeable** — ``merge(other)`` is bucket-wise addition, so window
+  rotation (live = previous + current) and multi-process aggregation
+  are exact: ``merge(a, b)`` holds exactly the points ``a + b`` saw
+  (associativity is tested, not assumed);
+* **serializable** — ``to_dict``/``from_dict`` round-trip through JSON,
+  which is how a deploy-time reference persists next to the model.
+
+:class:`ColumnSketch` wraps the quantile sketch with the moment
+accumulators (count/mean/M2 via the parallel Welford merge) and the
+null/NaN/Inf tallies that must agree with the quarantine boundary's
+reason codes — a NaN the quarantine masks out and a NaN the sketch
+counts are the same NaN.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ColumnSketch",
+    "QuantileSketch",
+    "update_matrix",
+]
+
+#: |v| below this is the zero bucket (log-bucketing needs a floor)
+_MIN_ABS = 1e-12
+
+
+class QuantileSketch:
+    """DDSketch-style quantile sketch over one numeric stream.
+
+    Buckets are keyed by ``k = ceil(log_gamma(|v|))`` with
+    ``gamma = (1 + alpha) / (1 - alpha)``; a positive value lands in the
+    bucket covering ``(gamma^(k-1), gamma^k]`` and is estimated by the
+    bucket midpoint ``2 * gamma^k / (gamma + 1)`` — within ``alpha``
+    relative error by construction.  Negative values mirror into their
+    own bucket map; near-zeros get a dedicated zero bucket.
+    """
+
+    __slots__ = ("alpha", "gamma", "_lg", "max_bins",
+                 "zero", "zero_bound", "pos", "neg", "count", "total")
+
+    def __init__(self, alpha: float = 0.01, max_bins: int = 512):
+        if not 0 < alpha < 1:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._lg = math.log(self.gamma)
+        self.max_bins = int(max_bins)
+        self.zero = 0
+        self.zero_bound = _MIN_ABS  # |v| <= this estimates as 0.0
+        self.pos: Dict[int, int] = {}
+        self.neg: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0.0
+
+    # -- ingest ---------------------------------------------------------------
+
+    def update(self, values) -> int:
+        """Fold a batch of FINITE values in (one vectorized pass).
+
+        Returns the number of values absorbed.  Non-finite values are
+        the caller's to count (:class:`ColumnSketch` does) — feeding one
+        here raises, because a silently-dropped NaN would make the
+        sketch's count disagree with the quarantine counters."""
+        v = np.asarray(values, dtype=np.float64).ravel()
+        if v.size == 0:
+            return 0
+        if not np.isfinite(v).all():
+            raise ValueError(
+                "QuantileSketch.update takes finite values only — route "
+                "NaN/Inf through ColumnSketch, which tallies them"
+            )
+        absv = np.abs(v)
+        near_zero = absv < _MIN_ABS
+        self.zero += int(near_zero.sum())
+        live = ~near_zero
+        if live.any():
+            keys = np.ceil(np.log(absv[live]) / self._lg).astype(np.int64)
+            signs = v[live] > 0
+            for store, mask in ((self.pos, signs), (self.neg, ~signs)):
+                if mask.any():
+                    uniq, counts = np.unique(keys[mask], return_counts=True)
+                    for k, c in zip(uniq.tolist(), counts.tolist()):
+                        store[k] = store.get(k, 0) + int(c)
+        self.count += int(v.size)
+        self.total += float(v.sum())
+        self._collapse()
+        return int(v.size)
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into this sketch (bucket-wise add); returns self.
+
+        Exact: the merged sketch holds precisely the union of both
+        streams' bucket counts, so merge order can never change a
+        quantile answer beyond the collapse rule both orders share."""
+        if abs(other.gamma - self.gamma) > 1e-12:
+            raise ValueError("cannot merge sketches with different alpha")
+        self.zero += other.zero
+        self.zero_bound = max(self.zero_bound, other.zero_bound)
+        for store, theirs in ((self.pos, other.pos), (self.neg, other.neg)):
+            for k, c in theirs.items():
+                store[k] = store.get(k, 0) + c
+        self.count += other.count
+        self.total += other.total
+        self._collapse()
+        return self
+
+    def _collapse(self) -> None:
+        """Cap memory: fold the SMALLEST-magnitude buckets into the zero
+        bucket until the bin budget holds.  A near-zero value estimated
+        as 0.0 costs absolute error bounded by the (growing) zero-region
+        bound; both distribution tails — where every drift statistic
+        lives — keep their alpha relative bound.  (The classic DDSketch
+        collapses its lowest buckets instead; that rule assumes one-sided
+        positive data and would erase the whole negative tail here.)"""
+        while len(self.pos) + len(self.neg) + (self.zero > 0) > self.max_bins:
+            kp = min(self.pos) if self.pos else None
+            kn = min(self.neg) if self.neg else None
+            # the most negative key is the smallest |v| bucket
+            if kn is None or (kp is not None and kp <= kn):
+                k, c = kp, self.pos.pop(kp)
+            else:
+                k, c = kn, self.neg.pop(kn)
+            self.zero += c
+            self.zero_bound = max(self.zero_bound, self.gamma ** k)
+
+    # -- bucket geometry ------------------------------------------------------
+
+    def _buckets(self) -> List[Tuple[float, float, int]]:
+        """``(upper_bound, estimate, count)`` triples in ascending value
+        order — the one walk ``quantile``/``cdf``/``histogram`` share."""
+        out: List[Tuple[float, float, int]] = []
+        mid = 2.0 / (self.gamma + 1.0)
+        for k in sorted(self.neg, reverse=True):
+            # bucket holds values in [-gamma^k, -gamma^(k-1)); its upper
+            # bound (closest to zero) is -gamma^(k-1)
+            est = -(self.gamma ** k) * mid
+            out.append((-(self.gamma ** (k - 1)), est, self.neg[k]))
+        if self.zero:
+            out.append((self.zero_bound, 0.0, self.zero))
+        for k in sorted(self.pos):
+            est = (self.gamma ** k) * mid
+            out.append((self.gamma ** k, est, self.pos[k]))
+        return out
+
+    # -- queries --------------------------------------------------------------
+
+    def quantile(self, q: float) -> float:
+        """The estimated ``q``-quantile (0..1); 0.0 on an empty sketch."""
+        if self.count == 0:
+            return 0.0
+        q = min(max(float(q), 0.0), 1.0)
+        rank = q * (self.count - 1)
+        seen = 0
+        buckets = self._buckets()
+        for _bound, est, c in buckets:
+            seen += c
+            if seen > rank:
+                return est
+        return buckets[-1][1]
+
+    def quantiles(self, qs: Sequence[float]) -> List[float]:
+        return [self.quantile(q) for q in qs]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def cdf(self, xs) -> np.ndarray:
+        """Fraction of mass at or below each of ``xs`` (vectorized over
+        the bucket walk; bucket mass sits at its estimate point)."""
+        xs = np.asarray(xs, dtype=np.float64)
+        if self.count == 0:
+            return np.zeros(xs.shape)
+        buckets = self._buckets()
+        ests = np.array([b[1] for b in buckets])
+        cum = np.cumsum([b[2] for b in buckets])
+        idx = np.searchsorted(ests, xs, side="right")
+        out = np.where(idx > 0, cum[np.maximum(idx - 1, 0)], 0)
+        return out / self.count
+
+    def histogram(self, max_buckets: int = 20) -> Tuple[List[float], List[int]]:
+        """``(upper_bounds, cumulative_counts)`` compacted to at most
+        ``max_buckets`` — the OpenMetrics histogram export shape (the
+        final implicit ``+Inf`` bucket is the caller's to append).
+        Adjacent buckets merge toward equal mass so the exposition stays
+        bounded no matter how many internal bins the sketch holds."""
+        buckets = self._buckets()
+        if not buckets:
+            return [], []
+        bounds = [b[0] for b in buckets]
+        cum = np.cumsum([b[2] for b in buckets])
+        if len(bounds) <= max_buckets:
+            return [float(b) for b in bounds], [int(c) for c in cum]
+        # keep the bucket at each ~equal-mass step (always the last)
+        targets = np.linspace(self.count / max_buckets, self.count,
+                              max_buckets)
+        keep_idx = np.unique(np.searchsorted(cum, targets, side="left"))
+        keep_idx[-1] = len(bounds) - 1
+        return ([float(bounds[i]) for i in keep_idx],
+                [int(cum[i]) for i in keep_idx])
+
+    # -- serialization --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "alpha": self.alpha,
+            "max_bins": self.max_bins,
+            "zero": self.zero,
+            "zero_bound": self.zero_bound,
+            "pos": {str(k): v for k, v in self.pos.items()},
+            "neg": {str(k): v for k, v in self.neg.items()},
+            "count": self.count,
+            "total": self.total,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantileSketch":
+        out = cls(alpha=float(d["alpha"]), max_bins=int(d["max_bins"]))
+        out.zero = int(d.get("zero", 0))
+        out.zero_bound = float(d.get("zero_bound", _MIN_ABS))
+        out.pos = {int(k): int(v) for k, v in (d.get("pos") or {}).items()}
+        out.neg = {int(k): int(v) for k, v in (d.get("neg") or {}).items()}
+        out.count = int(d.get("count", 0))
+        out.total = float(d.get("total", 0.0))
+        return out
+
+
+class ColumnSketch:
+    """One column's full distribution record: the quantile sketch over
+    finite values plus count/mean/var (parallel Welford) and the
+    null/NaN/Inf tallies.
+
+    ``update`` takes the column as it arrives (object arrays with None,
+    float arrays with NaN/Inf): non-finite and null entries are COUNTED
+    here — mirroring the quarantine boundary's ``null`` / ``nan_inf``
+    reason codes — and only finite values reach the sketch, so
+    ``n + nulls + nans + infs`` always accounts for every row seen.
+    """
+
+    __slots__ = ("sketch", "n", "mean", "m2", "nulls", "nans", "infs")
+
+    def __init__(self, alpha: float = 0.01, max_bins: int = 512):
+        self.sketch = QuantileSketch(alpha=alpha, max_bins=max_bins)
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+        self.nulls = 0
+        self.nans = 0
+        self.infs = 0
+
+    @property
+    def rows(self) -> int:
+        """Every row this column sketch has seen, servable or not."""
+        return self.n + self.nulls + self.nans + self.infs
+
+    def update(self, values) -> int:
+        """Fold one column batch in; returns rows seen (incl. bad)."""
+        arr = np.asarray(values).ravel()
+        rows = int(arr.shape[0])
+        if arr.dtype == object:
+            null_mask = np.array([v is None for v in arr], dtype=bool)
+            self.nulls += int(null_mask.sum())
+            arr = np.asarray([float(v) for v in arr[~null_mask]],
+                             dtype=np.float64)
+        else:
+            arr = arr.astype(np.float64, copy=False)
+        nan_mask = np.isnan(arr)
+        inf_mask = np.isinf(arr)
+        self.nans += int(nan_mask.sum())
+        self.infs += int(inf_mask.sum())
+        finite = arr[~(nan_mask | inf_mask)]
+        if finite.size:
+            n_b = int(finite.size)
+            mean_b = float(finite.mean())
+            m2_b = float(((finite - mean_b) ** 2).sum())
+            # parallel (Chan) variance merge: exact for batch streams
+            delta = mean_b - self.mean
+            tot = self.n + n_b
+            self.m2 += m2_b + delta * delta * self.n * n_b / tot
+            self.mean += delta * n_b / tot
+            self.n = tot
+            self.sketch.update(finite)
+        return rows
+
+    def merge(self, other: "ColumnSketch") -> "ColumnSketch":
+        if other.n:
+            delta = other.mean - self.mean
+            tot = self.n + other.n
+            self.m2 += other.m2 + delta * delta * self.n * other.n / tot
+            self.mean += delta * other.n / tot
+            self.n = tot
+        self.nulls += other.nulls
+        self.nans += other.nans
+        self.infs += other.infs
+        self.sketch.merge(other.sketch)
+        return self
+
+    @property
+    def var(self) -> float:
+        return self.m2 / self.n if self.n else 0.0
+
+    def quantile(self, q: float) -> float:
+        return self.sketch.quantile(q)
+
+    def summary(self) -> dict:
+        """The compact per-column record statusz/reports/CLI render."""
+        return {
+            "n": self.n,
+            "mean": round(self.mean, 6),
+            "var": round(self.var, 6),
+            "nulls": self.nulls,
+            "nans": self.nans,
+            "infs": self.infs,
+            "p05": round(self.sketch.quantile(0.05), 6),
+            "p50": round(self.sketch.quantile(0.50), 6),
+            "p95": round(self.sketch.quantile(0.95), 6),
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "sketch": self.sketch.to_dict(),
+            "n": self.n, "mean": self.mean, "m2": self.m2,
+            "nulls": self.nulls, "nans": self.nans, "infs": self.infs,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ColumnSketch":
+        out = cls()
+        out.sketch = QuantileSketch.from_dict(d["sketch"])
+        out.n = int(d.get("n", 0))
+        out.mean = float(d.get("mean", 0.0))
+        out.m2 = float(d.get("m2", 0.0))
+        out.nulls = int(d.get("nulls", 0))
+        out.nans = int(d.get("nans", 0))
+        out.infs = int(d.get("infs", 0))
+        return out
+
+
+def update_matrix(sketches: Sequence[ColumnSketch], X) -> None:
+    """Fold an ``(n, k)`` numeric batch into ``k`` column sketches in ONE
+    vectorized pipeline — the hot-path form of the drift tap.
+
+    Per-column ``ColumnSketch.update`` pays ~10 small-array numpy calls
+    per column; at serving batch sizes that fixed overhead dominates the
+    actual work 10:1.  This path runs each numpy op once over the whole
+    matrix (finite masks, moments, log-bucketing) and resolves every
+    column's bucket counts from a single ``np.unique`` over composite
+    ``(column, sign, key)`` codes.  Semantics match the scalar path
+    exactly except the batch variance term, which uses the sum-of-squares
+    form (equal to a few ULPs at drift-relevant scales).
+
+    All sketches must share one ``alpha``; NaN/Inf entries land in the
+    per-column tallies exactly as the scalar path counts them."""
+    if not len(sketches):
+        return
+    X = np.asarray(X, dtype=np.float64)
+    if X.ndim != 2 or X.shape[1] != len(sketches):
+        raise ValueError(
+            f"update_matrix: X is {X.shape}, expected (n, {len(sketches)})"
+        )
+    lg = sketches[0].sketch._lg
+    for cs in sketches:
+        if abs(cs.sketch._lg - lg) > 1e-15:
+            raise ValueError("update_matrix sketches must share one alpha")
+    n, k = X.shape
+    if n == 0:
+        return
+    finite = np.isfinite(X)
+    nan_mask = np.isnan(X)
+    nans = nan_mask.sum(axis=0)
+    infs = (~finite).sum(axis=0) - nans
+    Xf = np.where(finite, X, 0.0)
+    cnt = finite.sum(axis=0)
+    sums = Xf.sum(axis=0)
+    sumsq = np.einsum("ij,ij->j", Xf, Xf)
+    absX = np.abs(Xf)
+    near_zero = absX < _MIN_ABS
+    live = finite & ~near_zero
+    zeros = (finite & near_zero).sum(axis=0)
+    logs = np.zeros_like(Xf)
+    np.log(absX, out=logs, where=live)
+    keys = np.ceil(logs / lg).astype(np.int64)
+    # composite code: (column << 34) | (sign << 33) | (key + 2^32) —
+    # one unique/sort resolves every column's bucket histogram at once
+    code = (
+        np.arange(k, dtype=np.int64)[None, :] * (1 << 34)
+        + (Xf < 0).astype(np.int64) * (1 << 33)
+        + (keys + (1 << 32))
+    )
+    uniq, counts = np.unique(code[live], return_counts=True)
+    cols_u = (uniq >> 34).tolist()
+    negs_u = ((uniq >> 33) & 1).tolist()
+    keys_u = ((uniq & ((1 << 33) - 1)) - (1 << 32)).tolist()
+    for j, cs in enumerate(sketches):
+        cs.nans += int(nans[j])
+        cs.infs += int(infs[j])
+        n_b = int(cnt[j])
+        if n_b == 0:
+            continue
+        mean_b = float(sums[j]) / n_b
+        m2_b = max(float(sumsq[j]) - n_b * mean_b * mean_b, 0.0)
+        delta = mean_b - cs.mean
+        tot = cs.n + n_b
+        cs.m2 += m2_b + delta * delta * cs.n * n_b / tot
+        cs.mean += delta * n_b / tot
+        cs.n = tot
+        sk = cs.sketch
+        sk.zero += int(zeros[j])
+        sk.count += n_b
+        sk.total += float(sums[j])
+    for j, is_neg, key, c in zip(cols_u, negs_u, keys_u, counts.tolist()):
+        sk = sketches[j].sketch
+        store = sk.neg if is_neg else sk.pos
+        store[key] = store.get(key, 0) + int(c)
+    for cs in sketches:
+        cs.sketch._collapse()
+
+
+# -- drift statistics ---------------------------------------------------------
+
+
+def psi(reference: QuantileSketch, live: QuantileSketch,
+        bins: int = 10, eps: float = 1e-4) -> float:
+    """Population Stability Index between two sketches.
+
+    Binned at the REFERENCE's quantile edges (``bins`` equal-mass bins —
+    the classic PSI recipe), with each sketch's bin mass read off its
+    CDF and ``eps``-smoothed so an empty bin contributes a finite term.
+    ``psi < 0.1`` is conventionally stable, ``> 0.2`` shifted."""
+    if reference.count == 0 or live.count == 0:
+        return 0.0
+    edges = np.unique(np.asarray(
+        reference.quantiles([i / bins for i in range(1, bins)])
+    ))
+    if edges.size == 0:
+        return 0.0
+    ref_cdf = np.concatenate([reference.cdf(edges), [1.0]])
+    live_cdf = np.concatenate([live.cdf(edges), [1.0]])
+    p = np.diff(np.concatenate([[0.0], ref_cdf]))
+    q = np.diff(np.concatenate([[0.0], live_cdf]))
+    p = np.clip(p, eps, None)
+    q = np.clip(q, eps, None)
+    p = p / p.sum()
+    q = q / q.sum()
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def ks(reference: QuantileSketch, live: QuantileSketch,
+       max_points: int = 256) -> float:
+    """Two-sample Kolmogorov-Smirnov statistic between two sketches:
+    the max CDF gap evaluated at both sketches' bucket estimates
+    (capped — the sup over bucket points is exact for bucketized
+    CDFs)."""
+    if reference.count == 0 or live.count == 0:
+        return 0.0
+    pts = np.unique(np.concatenate([
+        [b[1] for b in reference._buckets()],
+        [b[1] for b in live._buckets()],
+    ]))
+    if pts.size > max_points:
+        pts = pts[np.linspace(0, pts.size - 1, max_points).astype(int)]
+    return float(np.abs(reference.cdf(pts) - live.cdf(pts)).max())

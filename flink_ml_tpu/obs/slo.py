@@ -1,9 +1,10 @@
 """In-process SLO burn-rate monitor for the serving stack.
 
 A dashboard full of counters is not an alert.  This module declares the
-two SLOs the serving runtime (PR 7) and the pressure layer (PR 9) exist
-to protect, watches them on a rolling window, and turns "the error
-budget is burning" into signals the rest of the plane consumes:
+SLOs the serving runtime (PR 7), the pressure layer (PR 9), and the
+data-drift plane (ISSUE 11) exist to protect, watches them on a rolling
+window, and turns "the error budget is burning" into signals the rest
+of the plane consumes:
 
 * **serving p99 latency** (``serving_p99_ms``, target
   ``FMT_SLO_P99_MS``): 99% of requests must complete under the target —
@@ -14,7 +15,17 @@ budget is burning" into signals the rest of the plane consumes:
 * **shed/error ratio** (``shed_error_ratio``, target
   ``FMT_SLO_ERR_RATIO``): of everything that ARRIVED this window
   (admitted + shed), at most the target fraction may shed or fail —
-  ``burn = (shed + failed) / arrivals / target``.
+  ``burn = (shed + failed) / arrivals / target``;
+* **data drift** (``drift``, threshold ``FMT_DRIFT_PSI``): the third
+  SLO — the worst feature/score column's PSI against the deploy-time
+  reference distribution, judged by an attached
+  :class:`~flink_ml_tpu.obs.drift.DriftMonitor` —
+  ``burn = max_psi / threshold``.  A drift breach additionally dumps a
+  ``drift_breach`` black box whose header (and ring events) name the
+  offending columns with their reference-vs-live quantiles, and its
+  ``/readyz`` reason code is ``drift`` rather than the generic
+  ``slo_burning`` so an orchestrator can tell "the data changed" from
+  "the process is slow".
 
 A burn rate of 1.0 means the budget is being spent exactly as declared;
 above 1.0 the SLO is breaching.  On each breached sample the monitor
@@ -52,6 +63,7 @@ from flink_ml_tpu.obs import flight
 from flink_ml_tpu.obs.registry import gauge_set, registry
 
 __all__ = [
+    "DRIFT_SLO",
     "ERROR_SLO",
     "LATENCY_SLO",
     "SLOMonitor",
@@ -63,6 +75,7 @@ __all__ = [
 
 LATENCY_SLO = "serving_p99_ms"
 ERROR_SLO = "shed_error_ratio"
+DRIFT_SLO = "drift"
 
 #: the registry histogram the latency SLO judges (milliseconds)
 _LATENCY_STAT = "serving.request_latency_ms"
@@ -115,13 +128,17 @@ class SLOMonitor:
     def __init__(self, window: Optional[float] = None,
                  p99_ms: Optional[float] = None,
                  err_ratio: Optional[float] = None,
-                 min_arrivals: Optional[int] = None):
+                 min_arrivals: Optional[int] = None,
+                 drift=None):
         self.window_s = window_s() if window is None else float(window)
         self.p99_ms = p99_target_ms() if p99_ms is None else float(p99_ms)
         self.err_ratio = (err_ratio_target() if err_ratio is None
                           else float(err_ratio))
         self.min_arrivals = (min_events() if min_arrivals is None
                              else int(min_arrivals))
+        #: the attached DriftMonitor (None = no drift SLO); its own
+        #: threshold/min-rows knobs gate the judgment
+        self._drift = drift
         self._lock = threading.Lock()
         self._burning: Dict[str, float] = {}  # slo name -> last burn rate
         self._prev = self._totals()
@@ -143,7 +160,8 @@ class SLOMonitor:
 
     def armed(self) -> bool:
         """Is at least one SLO declared (nonzero target)?"""
-        return self.p99_ms > 0 or self.err_ratio > 0
+        return (self.p99_ms > 0 or self.err_ratio > 0
+                or (self._drift is not None and self._drift.armed()))
 
     def burning(self) -> Dict[str, float]:
         """Currently-breaching SLOs: ``{name: burn_rate}``."""
@@ -152,19 +170,38 @@ class SLOMonitor:
 
     def readiness_reasons(self) -> List[dict]:
         """The ``/readyz`` feed: one ``slo_burning`` reason per
-        breaching SLO."""
-        return [
-            {"reason": "slo_burning",
-             "detail": f"SLO {name!r} burn rate {rate:.2f}x"}
-            for name, rate in sorted(self.burning().items())
-        ]
+        breaching SLO — except drift, which reports under its OWN
+        reason code (``drift``): "the input population changed" needs a
+        different operator response than "the process is slow", and the
+        reason code is the only field an orchestrator switches on."""
+        out = []
+        for name, rate in sorted(self.burning().items()):
+            if name == DRIFT_SLO:
+                worst = None
+                if self._drift is not None:
+                    scores = self._drift.column_scores()
+                    worst = scores[0]["column"] if scores else None
+                out.append({
+                    "reason": "drift",
+                    "detail": (f"data drift burn rate {rate:.2f}x"
+                               + (f" (worst column {worst!r})"
+                                  if worst else "")),
+                })
+            else:
+                out.append({
+                    "reason": "slo_burning",
+                    "detail": f"SLO {name!r} burn rate {rate:.2f}x",
+                })
+        return out
 
     def status(self) -> dict:
         """The ``/statusz`` contribution."""
+        targets = {LATENCY_SLO: self.p99_ms, ERROR_SLO: self.err_ratio}
+        if self._drift is not None:
+            targets[DRIFT_SLO] = self._drift.threshold
         return {
             "window_s": self.window_s,
-            "targets": {LATENCY_SLO: self.p99_ms,
-                        ERROR_SLO: self.err_ratio},
+            "targets": targets,
             "burning": self.burning(),
         }
 
@@ -215,12 +252,61 @@ class SLOMonitor:
                     bad=bad, total=len(recent),
                     bad_ratio=round(ratio, 6), target=self.p99_ms,
                 )
+        drift_mon = self._drift
+        if drift_mon is not None and drift_mon.armed():
+            # the monitor gates itself (reference complete, min live
+            # rows); a burning drift SLO is re-judged on any window —
+            # the same asymmetry as above, or a drained replica would
+            # stay unready on the very traffic drought it caused
+            verdict = drift_mon.judge(
+                allow_small=DRIFT_SLO in was_burning
+            )
+            if verdict is not None:
+                breaching = verdict.get("breaching") or []
+                if verdict["burn"] > 1.0:
+                    # the black box must NAME the shifted data before a
+                    # reader opens one event: one compact ring event per
+                    # offending column with its reference-vs-live
+                    # quantiles, then the reason-coded dump below
+                    for c in breaching:
+                        flight.record(
+                            "drift.column_breach",
+                            monitor=drift_mon.name, column=c["column"],
+                            psi=c["psi"], ks=c["ks"],
+                            ref_p05=c["ref"]["p05"],
+                            ref_p50=c["ref"]["p50"],
+                            ref_p95=c["ref"]["p95"],
+                            live_p05=c["live"]["p05"],
+                            live_p50=c["live"]["p50"],
+                            live_p95=c["live"]["p95"],
+                        )
+                results[DRIFT_SLO] = self._judge(
+                    DRIFT_SLO, verdict["burn"],
+                    dump_reason="drift_breach",
+                    dump_extra={
+                        "worst_column": verdict["worst_column"],
+                        "columns": ",".join(
+                            c["column"] for c in breaching
+                        ),
+                        "max_psi": verdict["max_psi"],
+                        "threshold": verdict["threshold"],
+                        "live_rows": verdict["live_rows"],
+                    },
+                    max_psi=verdict["max_psi"],
+                    worst_column=verdict["worst_column"],
+                    target=verdict["threshold"],
+                    total=verdict["live_rows"],
+                )
         return results
 
-    def _judge(self, name: str, burn: float, **math) -> dict:
+    def _judge(self, name: str, burn: float,
+               dump_reason: str = "slo_breach",
+               dump_extra: Optional[dict] = None, **math) -> dict:
         """Record one SLO's window verdict: gauges always, flight breach
         event + rate-limited black box while burning, recovery event on
-        the breach clearing."""
+        the breach clearing.  ``dump_reason``/``dump_extra`` let a
+        specialized SLO (drift) name its own black box and put its
+        diagnosis in the dump header."""
         burning = burn > 1.0
         gauge_set(f"slo.burn_rate.{name}", burn)
         gauge_set(f"slo.burning.{name}", 1.0 if burning else 0.0)
@@ -237,8 +323,9 @@ class SLOMonitor:
             # the black box shows WHAT was happening while the budget
             # burned; FMT_FLIGHT_MIN_S keeps a sustained burn from
             # turning the reports dir into a landfill
-            flight.dump("slo_breach", extra={
-                "slo": name, "burn_rate": round(burn, 4), **math,
+            flight.dump(dump_reason, extra={
+                "slo": name, "burn_rate": round(burn, 4),
+                **(dump_extra or {}), **math,
             })
         elif was_burning:
             flight.record("slo.recovered", slo=name,

@@ -75,12 +75,14 @@ __all__ = [
     "pressure_floor",
     "queue_saturation_frac",
     "readiness",
+    "register_histograms",
     "register_readiness",
     "register_status",
     "render_openmetrics",
     "start",
     "status_snapshot",
     "stop",
+    "unregister_histograms",
     "unregister_readiness",
     "unregister_status",
 ]
@@ -134,6 +136,50 @@ def queue_saturation_frac() -> float:
 
 # -- OpenMetrics rendering ----------------------------------------------------
 
+#: histogram sources (ISSUE 11): callables returning ``{registry-style
+#: name: (upper_bounds, cumulative_counts, sum, count)}`` — the drift
+#: monitor exports its distribution sketches through this so ``/metrics``
+#: carries proper OpenMetrics histogram families, not opaque gauges.
+#: Same shape as the readiness/status registries: register and ride along.
+_HISTOGRAM_SOURCES: Dict[str, Callable[[], Dict[str, tuple]]] = {}
+
+
+def register_histograms(name: str, fn: Callable[[], Dict[str, tuple]]) -> str:
+    """Register a histogram source under ``name`` (unique-ified on
+    collision); returns the key for :func:`unregister_histograms`.  The
+    callable yields ``{name: (bounds, cumulative_counts, sum, count)}``
+    per scrape — bounds ascending, counts cumulative, the implicit
+    ``+Inf`` bucket appended by the renderer."""
+    with _SOURCES_LOCK:
+        key, n = name, 2
+        while key in _HISTOGRAM_SOURCES:
+            key = f"{name}-{n}"
+            n += 1
+        _HISTOGRAM_SOURCES[key] = fn
+        return key
+
+
+def unregister_histograms(key: str) -> None:
+    with _SOURCES_LOCK:
+        _HISTOGRAM_SOURCES.pop(key, None)
+
+
+def _collect_histograms() -> Dict[str, tuple]:
+    """Every registered source's families, first-registered wins on a
+    name collision; a broken source is skipped (a scrape must render
+    what it can, never die on one provider)."""
+    with _SOURCES_LOCK:
+        sources = list(_HISTOGRAM_SOURCES.values())
+    out: Dict[str, tuple] = {}
+    for fn in sources:
+        try:
+            for name, data in fn().items():
+                out.setdefault(name, data)
+        except Exception:  # noqa: BLE001 - telemetry must never die
+            continue
+    return out
+
+
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -163,7 +209,10 @@ def render_openmetrics(snapshot: Optional[dict] = None,
 
     Counters -> ``counter`` families (``<family>_total`` samples),
     gauges -> ``gauge``, timings -> ``summary`` (quantile series over
-    the recent reservoir + monotonic ``_count``/``_sum``).  Families are
+    the recent reservoir + monotonic ``_count``/``_sum``), registered
+    histogram sources (:func:`register_histograms` — the drift sketches)
+    -> ``histogram`` families (cumulative ``_bucket`` series with ``le``
+    labels ending at ``+Inf``, plus ``_count``/``_sum``).  Families are
     emitted sorted; a name that sanitizes into an already-used family is
     skipped (duplicate families are invalid, and dotted registry names
     make real collisions vanishingly rare).  Ends with ``# EOF``.
@@ -205,17 +254,68 @@ def render_openmetrics(snapshot: Optional[dict] = None,
         lines.append(
             f"{fam}_sum {_fmt_value(stat.get('sum_s', stat.get('total_s', 0.0)))}"
         )
+    for name, (bounds, cum, total, count) in sorted(
+        _collect_histograms().items()
+    ):
+        fam = claim(name)
+        if fam is None:
+            continue
+        lines.append(f"# TYPE {fam} histogram")
+        last = 0
+        for bound, c in zip(bounds, cum):
+            # cumulative by contract; clamp so a racing provider can
+            # never emit a decreasing series (invalid OpenMetrics)
+            last = max(last, int(c))
+            lines.append(f'{fam}_bucket{{le="{_fmt_value(bound)}"}} {last}')
+        lines.append(f'{fam}_bucket{{le="+Inf"}} {max(last, int(count))}')
+        lines.append(f"{fam}_count {max(last, int(count))}")
+        lines.append(f"{fam}_sum {_fmt_value(total)}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
 _SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"        # sample name
-    r'(?:\{quantile="([0-9.]+)"\})?'      # optional quantile label
-    r" (-?(?:[0-9][0-9eE+.\-]*|\.[0-9]+))$"  # value
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"            # sample name
+    r'(?:\{(quantile|le)="([^"]+)"\})?'       # optional quantile/le label
+    r" (-?(?:[0-9][0-9eE+.\-]*|\.[0-9]+))$"   # value
 )
 _TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
-                      r"(counter|gauge|summary)$")
+                      r"(counter|gauge|summary|histogram)$")
+
+
+def _le_value(raw: str) -> float:
+    """A histogram ``le`` label as a float; ``+Inf`` is the OpenMetrics
+    spelling of the mandatory final bucket."""
+    if raw == "+Inf":
+        return float("inf")
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"invalid histogram le label {raw!r}") from None
+
+
+class _HistState:
+    """Per-histogram-family running validation: buckets must be
+    cumulative (non-decreasing counts) over ascending ``le`` bounds,
+    must end at ``le="+Inf"``, and ``_count`` must equal the +Inf
+    bucket — the OpenMetrics histogram invariants, checked so a broken
+    exporter cannot round-trip."""
+
+    __slots__ = ("last_le", "last_count", "inf_count", "count_seen")
+
+    def __init__(self):
+        self.last_le = float("-inf")
+        self.last_count: Optional[float] = None
+        self.inf_count: Optional[float] = None
+        self.count_seen = False
+
+    def close(self, fam: str) -> None:
+        if self.inf_count is None:
+            raise ValueError(
+                f"histogram family {fam!r} has no le=\"+Inf\" bucket"
+            )
+        if not self.count_seen:
+            raise ValueError(f"histogram family {fam!r} has no _count")
 
 
 def parse_openmetrics(text: str) -> Dict[str, float]:
@@ -224,13 +324,17 @@ def parse_openmetrics(text: str) -> Dict[str, float]:
     with.  Enforces: every sample belongs to (and directly follows) a
     declared ``# TYPE`` family, sample suffixes match the family's type
     (``_total`` only on counters, ``_count``/``_sum``/quantiles only on
-    summaries), no duplicate families, and a final ``# EOF``.  Returns
-    ``{sample_key: value}`` where a quantile sample's key is
-    ``name{quantile="q"}``.  Raises ``ValueError`` on any violation."""
+    summaries, ``_bucket``-with-``le`` only on histograms), histogram
+    buckets cumulative over ascending bounds ending at ``+Inf`` with
+    ``_count`` equal to the ``+Inf`` bucket, no duplicate families, and
+    a final ``# EOF``.  Returns ``{sample_key: value}`` where a labeled
+    sample's key is ``name{quantile="q"}`` / ``name{le="x"}``.  Raises
+    ``ValueError`` on any violation."""
     samples: Dict[str, float] = {}
     families: Dict[str, str] = {}
     fam: Optional[str] = None
     kind: Optional[str] = None
+    hist: Optional[_HistState] = None
     lines = text.split("\n")
     if lines and lines[-1] == "":
         lines.pop()
@@ -239,28 +343,38 @@ def parse_openmetrics(text: str) -> Dict[str, float]:
     for i, line in enumerate(lines[:-1], 1):
         m = _TYPE_RE.match(line)
         if m:
+            if hist is not None:
+                hist.close(fam)
+                hist = None
             name, t = m.groups()
             if name in families:
                 raise ValueError(f"line {i}: duplicate family {name!r}")
             families[name] = t
             fam, kind = name, t
+            if t == "histogram":
+                hist = _HistState()
             continue
         if line.startswith("#"):
             raise ValueError(f"line {i}: unexpected comment {line!r}")
         m = _SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"line {i}: malformed sample {line!r}")
-        name, quantile, value = m.groups()
+        name, label, label_value, value = m.groups()
         if fam is None:
             raise ValueError(f"line {i}: sample before any # TYPE")
         ok = (
             (kind == "counter" and name == fam + "_total"
-             and quantile is None)
-            or (kind == "gauge" and name == fam and quantile is None)
+             and label is None)
+            or (kind == "gauge" and name == fam and label is None)
             or (kind == "summary" and (
-                (name == fam and quantile is not None)
+                (name == fam and label == "quantile")
                 or (name in (fam + "_count", fam + "_sum")
-                    and quantile is None)
+                    and label is None)
+            ))
+            or (kind == "histogram" and (
+                (name == fam + "_bucket" and label == "le")
+                or (name in (fam + "_count", fam + "_sum")
+                    and label is None)
             ))
         )
         if not ok:
@@ -268,10 +382,44 @@ def parse_openmetrics(text: str) -> Dict[str, float]:
                 f"line {i}: sample {name!r} does not belong to the "
                 f"preceding {kind} family {fam!r}"
             )
-        key = name if quantile is None else f'{name}{{quantile="{quantile}"}}'
+        if kind == "summary" and label == "quantile":
+            try:
+                float(label_value)
+            except ValueError:
+                raise ValueError(
+                    f"line {i}: invalid quantile label {label_value!r}"
+                ) from None
+        if kind == "histogram":
+            v = float(value)
+            if name == fam + "_bucket":
+                le = _le_value(label_value)
+                if le <= hist.last_le:
+                    raise ValueError(
+                        f"line {i}: histogram {fam!r} bucket bounds not "
+                        f"ascending ({label_value!r})"
+                    )
+                if hist.last_count is not None and v < hist.last_count:
+                    raise ValueError(
+                        f"line {i}: histogram {fam!r} bucket counts not "
+                        f"cumulative ({v} after {hist.last_count})"
+                    )
+                hist.last_le, hist.last_count = le, v
+                if le == float("inf"):
+                    hist.inf_count = v
+            elif name == fam + "_count":
+                if hist.inf_count is None or v != hist.inf_count:
+                    raise ValueError(
+                        f"line {i}: histogram {fam!r} _count {v} does not "
+                        f"equal its +Inf bucket ({hist.inf_count})"
+                    )
+                hist.count_seen = True
+        key = (name if label is None
+               else f'{name}{{{label}="{label_value}"}}')
         if key in samples:
             raise ValueError(f"line {i}: duplicate sample {key!r}")
         samples[key] = float(value)
+    if hist is not None:
+        hist.close(fam)
     return samples
 
 

@@ -321,6 +321,10 @@ def emit(name: str, batch: Table, good_mask: np.ndarray,
             f"serve.quarantined.{reason}",
             int(sum(1 for r in bad_reasons if r == reason)),
         )
+    # the reason-coded machinery doubles as the drift monitor's input-
+    # quality feed (ISSUE 11): per-reason rates, reference window vs
+    # live window (one module-bool check while drift is off)
+    obs.drift.observe_quarantine(bad_reasons)
     rows = np.nonzero(bad_mask)[0] + int(row_offset)
     # always stamped (empty when untraced) so side-table parts keep ONE
     # schema and concat across traced and untraced emissions never splits

@@ -65,11 +65,22 @@ OpenMetrics endpoint (``/metrics`` / ``/healthz`` / ``/readyz`` /
 deploys in progress, a saturating queue, and burning SLOs
 (:mod:`flink_ml_tpu.obs.telemetry` / :mod:`flink_ml_tpu.obs.slo`).
 
-Knobs (BASELINE.md round-10/12/13 tables): ``FMT_SERVING_MAX_BATCH``,
+Data drift (ISSUE 11, ``FMT_DRIFT`` / the ``drift`` argument): the
+server arms a :class:`~flink_ml_tpu.obs.drift.DriftMonitor` whose
+reference distribution snapshots at deploy (persisted next to a
+path-deployed model, reset by redeploys), taps input features at the
+quarantine boundary and output scores at demux, and feeds the third
+(``drift``) SLO — ``slo.burning.drift``, a reason-coded ``drift``
+``/readyz`` entry, per-column ``/statusz``, and ``drift_breach``
+black boxes.
+
+Knobs (BASELINE.md round-10/12/13/14 tables): ``FMT_SERVING_MAX_BATCH``,
 ``FMT_SERVING_MAX_WAIT_MS``, ``FMT_SERVING_QUEUE_CAP``,
 ``FMT_SERVING_QUEUE_CAP_MB``, ``FMT_SERVING_DEADLINE_MS``,
 ``FMT_SERVING_SHED_ON_BREAKER``, ``FMT_TELEMETRY_PORT``,
-``FMT_SLO_WINDOW_S``, ``FMT_SLO_P99_MS``, ``FMT_SLO_ERR_RATIO``.
+``FMT_SLO_WINDOW_S``, ``FMT_SLO_P99_MS``, ``FMT_SLO_ERR_RATIO``,
+``FMT_DRIFT``, ``FMT_DRIFT_REF_ROWS``, ``FMT_DRIFT_PSI``,
+``FMT_DRIFT_WINDOW_S``.
 """
 
 from __future__ import annotations
@@ -165,6 +176,7 @@ class ModelServer:
                  deadline_ms: Optional[float] = None,
                  shed_on_breaker: Optional[bool] = None,
                  telemetry_port: Optional[int] = None,
+                 drift: Optional[bool] = None,
                  start: bool = True):
         if (model is None) == (path is None):
             raise ValueError("pass exactly one of model / path")
@@ -218,6 +230,17 @@ class ModelServer:
         # generation counter — an opening breaker sheds immediately) or
         # after ~50 ms (a cooldown EXPIRING fires no transition)
         self._breaker_memo = (float("-inf"), -1, [])
+        # data-plane drift monitor (ISSUE 11, FMT_DRIFT / the drift
+        # argument): reference snapshotted at deploy — reloaded from the
+        # model dir's persisted baseline when one exists — live window
+        # tapped per coalesced batch; feeds the third SLO below
+        self._drift = None
+        self._drift_status_key: Optional[str] = None
+        from flink_ml_tpu.obs import drift as _drift_mod
+
+        drift_on = _drift_mod.enabled() if drift is None else bool(drift)
+        if drift_on:
+            self._drift = self._make_drift_monitor(deployed)
         # live telemetry plane (ISSUE 10): the endpoint + SLO monitor
         # come up with the server — even a paused (start=False) server
         # is scrapeable, and its saturated queue shows in /readyz
@@ -230,6 +253,13 @@ class ModelServer:
                 else _telemetry_mod.env_port())
         if port is not None:
             self._start_telemetry(port)
+        elif self._drift is not None:
+            # no endpoint, but drift is armed: the SLO monitor still
+            # samples so slo.burning.drift flips and /readyz (from some
+            # other process surface) can consume it
+            from flink_ml_tpu.obs import slo as slo_mod
+
+            self._slo = slo_mod.SLOMonitor(drift=self._drift).start()
         if start:
             self.start()
 
@@ -307,6 +337,70 @@ class ModelServer:
         self._stop_telemetry()
         self._write_report()
 
+    # -- data-plane drift (ISSUE 11) -----------------------------------------
+
+    @property
+    def drift_monitor(self):
+        """This server's :class:`~flink_ml_tpu.obs.drift.DriftMonitor`
+        (None when drift is off)."""
+        return self._drift
+
+    def _make_drift_monitor(self, deployed):
+        """The deploy-time reference contract: a path deploy whose model
+        dir holds a persisted ``drift_reference.json`` restarts with its
+        committed baseline; anything else starts snapshotting a fresh
+        one from the pre-warm sample + the first ``FMT_DRIFT_REF_ROWS``
+        live rows (persisted back to the model dir once frozen, so the
+        NEXT restart keeps it).  A corrupt persisted baseline warns and
+        re-learns — drift is advisory telemetry, and refusing to serve
+        over it would invert the severity."""
+        import warnings
+
+        from flink_ml_tpu.obs import drift as drift_mod
+
+        source = deployed.source_path
+        monitor = drift_mod.DriftMonitor(name="serving",
+                                         persist_path=source)
+        if source:
+            try:
+                monitor.load_reference(source)
+            except Exception as exc:  # noqa: BLE001 - advisory, see above
+                warnings.warn(
+                    f"persisted drift reference under {source!r} is "
+                    f"unusable ({type(exc).__name__}: {exc}); re-learning "
+                    "a baseline from live traffic",
+                    RuntimeWarning, stacklevel=3,
+                )
+                obs.flight.record("drift.reference_corrupt",
+                                  source=source,
+                                  error=type(exc).__name__)
+        if not monitor.reference_complete and self._warmup_sample is not None:
+            monitor.bootstrap(self._warmup_sample)
+        return monitor
+
+    def _reset_drift_for(self, deployed, warmup: Optional[Table]) -> None:
+        """Redeploy semantics: the new version serves a (possibly
+        intentionally different) population, so the baseline resets —
+        unless the NEW model dir already carries its own persisted
+        reference, which is the restart/rollback case and wins."""
+        import warnings
+
+        monitor = self._drift
+        if monitor is None:
+            return
+        source = deployed.source_path
+        if source:
+            try:
+                if monitor.load_reference(source):
+                    return
+            except Exception as exc:  # noqa: BLE001
+                warnings.warn(
+                    f"persisted drift reference under {source!r} is "
+                    f"unusable ({type(exc).__name__}); re-learning",
+                    RuntimeWarning, stacklevel=3,
+                )
+        monitor.reset_reference(persist_path=source, warmup=warmup)
+
     # -- live telemetry plane (ISSUE 10) -------------------------------------
 
     @property
@@ -339,7 +433,11 @@ class ModelServer:
         telemetry_mod.register_readiness(self._readiness_reasons)
         self._status_key = telemetry_mod.register_status(
             "server", self._telemetry_status)
-        self._slo = slo_mod.SLOMonitor().start()
+        if self._drift is not None:
+            # /statusz gains the per-column drift section
+            self._drift_status_key = telemetry_mod.register_status(
+                "drift", self._drift.status)
+        self._slo = slo_mod.SLOMonitor(drift=self._drift).start()
 
     def _stop_telemetry(self) -> None:
         if self._slo is not None:
@@ -352,8 +450,13 @@ class ModelServer:
             if self._status_key is not None:
                 telemetry_mod.unregister_status(self._status_key)
                 self._status_key = None
+            if self._drift_status_key is not None:
+                telemetry_mod.unregister_status(self._drift_status_key)
+                self._drift_status_key = None
             self._telemetry.stop()
             self._telemetry = None
+        if self._drift is not None:
+            self._drift.close()
 
     def _readiness_reasons(self) -> List[dict]:
         """This server's /readyz feed: a deploy mid-flight and a
@@ -565,6 +668,10 @@ class ModelServer:
             raise
         self._tally("serving.swaps")
         self._breaker_scope = _breaker_scope_names(deployed.model)
+        # drift reference reset (ISSUE 11): the new version's population
+        # is the new normal — unless its model dir carries a persisted
+        # baseline (restart/rollback), which is reloaded instead
+        self._reset_drift_for(deployed, warmup)
         return deployed
 
     @property
@@ -719,8 +826,15 @@ class ModelServer:
         pressure clears."""
         if not requests:
             return
+        from flink_ml_tpu.obs import drift as drift_mod
+
         try:
-            self._serve_batch_once(requests)
+            # the drift tap scope (ISSUE 11): deep taps (quarantine
+            # boundary, fused plan entry) inside this batch's transform
+            # feed THIS server's monitor; exit rolls it (reference
+            # freeze/persist + window rotation).  None = no-op context.
+            with drift_mod.active(self._drift):
+                self._serve_batch_once(requests)
         except BaseException as exc:  # noqa: BLE001 - OOM-only, see below
             # _serve_batch_once resolves every other failure into the
             # futures itself; only a splittable OOM escapes it
@@ -786,6 +900,14 @@ class ModelServer:
                             else None
                             for r in requests
                         ],
+                    )
+                if self._drift is not None:
+                    # the demux-side drift tap (ISSUE 11): produced
+                    # score/prediction columns of the whole coalesced
+                    # batch into the live (or still-filling reference)
+                    # window, request input columns excluded
+                    self._drift.observe_scores(
+                        out, exclude=frozenset(table.schema.field_names)
                     )
             except BaseException as exc:  # noqa: BLE001 - futures carry it
                 if (pressure.enabled() and pressure.is_oom(exc)
@@ -877,4 +999,11 @@ class ModelServer:
             return
         from flink_ml_tpu.obs.report import serving_report
 
-        serving_report("ModelServer", extra=self.stats())
+        extra = self.stats()
+        if self._drift is not None:
+            # the drift section `obs --check` flags and the
+            # `obs drift` CLI renders
+            section = self._drift.report_section()
+            if section is not None:
+                extra["drift"] = section
+        serving_report("ModelServer", extra=extra)

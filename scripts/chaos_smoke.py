@@ -105,6 +105,24 @@ real load and a real degradation:
   4. **lifecycle** — ``shutdown`` must take the endpoint down with the
      server (no orphaned listener).
 
+**Drift mode** (``--drift``, ISSUE 11): the data-plane counterpart —
+the full drift-detection loop under an injected distribution shift:
+
+  1. **baseline** — live traffic freezes the deploy-time reference
+     distribution (``FMT_DRIFT_REF_ROWS``); the drift SLO judges the
+     live window at well under 1x burn and ``/readyz`` stays 200;
+  2. **breach** — a 5-sigma covariate shift injected on ONE feature
+     column must burn ``slo.burning.drift`` past 1x, flip ``/readyz``
+     to 503 with the reason-coded ``drift`` entry, surface the shifted
+     column at the top of ``/statusz``'s per-column section, and land a
+     ``drift_breach`` black box whose header AND per-column ring events
+     name exactly that column with its reference-vs-live quantiles;
+  3. **recovery by redeploy** — ``deploy()`` of a new version resets
+     the reference; the shifted population becomes the new baseline,
+     the burn clears, and ``/readyz`` returns 200;
+  4. **CLI** — ``python -m flink_ml_tpu.obs drift`` renders the
+     per-column comparison from the shutdown serving report.
+
 **Trace mode** (``--trace``, ISSUE 8): the observability counterpart —
 end-to-end request tracing plus the black-box flight recorder:
 
@@ -1097,6 +1115,164 @@ def telemetry_main() -> int:
     return 0
 
 
+def drift_main() -> int:
+    """The data-drift chaos matrix (``--drift``, ISSUE 11): the full
+    loop — baseline traffic freezes a reference, an injected covariate
+    shift on ONE column burns the ``drift`` SLO, ``/readyz`` degrades
+    503 with the reason-coded ``drift`` entry, the ``drift_breach``
+    black box names the shifted column with reference-vs-live
+    quantiles, and a redeploy resets the reference so the shifted
+    population becomes the new baseline and the server recovers to
+    200."""
+    import urllib.error
+    import urllib.request
+
+    os.environ["FMT_OBS_REPORTS"] = tempfile.mkdtemp(
+        prefix="chaos_drift_reports_"
+    )
+    os.environ["FMT_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos_drift_flight_"
+    )
+    os.environ["FMT_FLIGHT_MIN_S"] = "0"  # every dump lands (test mode)
+    os.environ["FMT_DRIFT_REF_ROWS"] = "256"
+    os.environ["FMT_DRIFT_MIN_ROWS"] = "64"
+    from flink_ml_tpu import obs, serve
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import flight, slo
+    from flink_ml_tpu.serving import ModelServer
+
+    serve.reset_breakers()
+    obs.reset()
+    flight.reset()
+    rng = np.random.RandomState(23)
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                       ("label", "double"))
+    true_w = rng.randn(DIM).astype(np.float32)
+
+    def traffic(n, shift_col=None, shift=0.0):
+        X = rng.randn(n, DIM).astype(np.float32)
+        if shift_col is not None:
+            X[:, shift_col] += shift
+        y = (X @ true_w > 0).astype(np.float64)
+        return Table.from_columns(schema, {"features": X, "label": y})
+
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(traffic(512))
+
+    server = ModelServer(model, version="v1", max_batch=64,
+                         max_wait_ms=1.0, telemetry_port=0, drift=True)
+    assert server.drift_monitor is not None, "drift=True armed no monitor"
+    assert server._slo is not None, "no SLO monitor came up with drift"
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(server.telemetry.url(path),
+                                        timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    def drive(n_batches, rows=32, **shift_kw):
+        for _ in range(n_batches):
+            server.predict(traffic(rows, **shift_kw), timeout=120)
+
+    try:
+        # -- leg 1: baseline traffic freezes the reference; all green ------
+        drive(10)  # 320 rows > FMT_DRIFT_REF_ROWS
+        mon = server.drift_monitor
+        assert mon.reference_complete, "reference never froze"
+        drive(4)  # live-window rows against the frozen reference
+        res = server._slo.sample_once()
+        verdict = res.get(slo.DRIFT_SLO)
+        assert verdict and not verdict["burning"], verdict
+        status, _ = get("/readyz")
+        assert status == 200, status
+        print(f"  baseline: reference frozen at "
+              f"{mon.status()['reference']['rows']} rows, "
+              f"drift burn {verdict['burn_rate']:.2f}x, /readyz 200")
+
+        # -- leg 2: covariate shift on ONE column -> burn -> 503 -> dump ---
+        shifted_col = 2
+        drive(8, shift_col=shifted_col, shift=5.0)
+        res = server._slo.sample_once()
+        verdict = res.get(slo.DRIFT_SLO)
+        assert verdict and verdict["burning"], verdict
+        assert verdict["burn_rate"] > 1.0, verdict
+        gauges = obs.registry().snapshot()["gauges"]
+        assert gauges.get("slo.burning.drift") == 1.0, gauges
+        status, body = get("/readyz")
+        assert status == 503, (status, body)
+        payload = json.loads(body)
+        reasons = {r["reason"] for r in payload["reasons"]}
+        assert "drift" in reasons, payload
+        status, body = get("/statusz")
+        st = json.loads(body)
+        worst = st["drift"]["columns"][0]
+        assert worst["column"] == f"features[{shifted_col}]", worst
+        dump_path = flight.last_dump_path()
+        assert dump_path and "drift_breach" in os.path.basename(dump_path), (
+            dump_path)
+        lines = [json.loads(ln) for ln in open(dump_path)]
+        header = lines[0]
+        assert header["reason"] == "drift_breach", header
+        assert header["worst_column"] == f"features[{shifted_col}]", header
+        col_events = [e for e in lines[1:]
+                      if e.get("kind") == "drift.column_breach"
+                      and e.get("column") == f"features[{shifted_col}]"]
+        assert col_events, "black box has no event for the shifted column"
+        ev = col_events[0]
+        assert ev["live_p50"] > ev["ref_p50"] + 2.0, ev  # the 5-sigma shift
+        print(f"  breach: shifted features[{shifted_col}] burned at "
+              f"{verdict['burn_rate']:.1f}x -> /readyz 503 {sorted(reasons)}"
+              f", black box {os.path.basename(dump_path)} names it "
+              f"(ref p50 {ev['ref_p50']:.2f} -> live p50 "
+              f"{ev['live_p50']:.2f})")
+
+        # -- leg 3: redeploy resets the reference -> recovery --------------
+        server.deploy(model, "v2")
+        assert not mon.reference_complete, (
+            "redeploy did not reset the drift reference")
+        drive(10, shift_col=shifted_col, shift=5.0)  # new-normal reference
+        assert mon.reference_complete
+        drive(4, shift_col=shifted_col, shift=5.0)   # live, same population
+        res = server._slo.sample_once()
+        verdict = res.get(slo.DRIFT_SLO)
+        assert verdict and not verdict["burning"], verdict
+        gauges = obs.registry().snapshot()["gauges"]
+        assert gauges.get("slo.burning.drift") == 0.0, gauges
+        status, _ = get("/readyz")
+        assert status == 200, status
+        print(f"  recovery: redeploy v2 reset the reference; shifted "
+              f"population is the new baseline (burn "
+              f"{verdict['burn_rate']:.2f}x), /readyz 200")
+    finally:
+        server.shutdown()
+
+    # the serving report carries the drift section the CLI renders
+    out = subprocess.run(
+        [sys.executable, "-m", "flink_ml_tpu.obs", "drift"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "features[" in out.stdout, out.stdout
+    print("  cli: `obs drift` renders the per-column comparison")
+    for var in ("FMT_FLIGHT_MIN_S", "FMT_DRIFT_REF_ROWS",
+                "FMT_DRIFT_MIN_ROWS"):
+        os.environ.pop(var, None)
+    print("drift chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -1111,6 +1287,8 @@ def main() -> int:
         return pressure_main()
     if "--telemetry" in sys.argv:
         return telemetry_main()
+    if "--drift" in sys.argv:
+        return drift_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

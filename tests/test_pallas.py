@@ -19,6 +19,40 @@ def data(n=300, d=28, seed=0):
     return x, y, w, wts, b
 
 
+def _pallas_cpu_unavailable():
+    """Capability probe: can this environment lower the Pallas kernel in
+    interpret mode at all?  Legacy JAX builds reject kernel plumbing the
+    kernels rely on (e.g. ``ShapeDtypeStruct(..., vma=...)`` predates
+    the vma-aware API), which is an ENVIRONMENT limitation, not a
+    regression in this repo — those runs should read as named skips in
+    tier-1 output, not as 8 failures masking real breakage.  Returns the
+    diagnostic string (None when the lowering works).
+
+    Deliberately NARROW: only error signatures known to mean "this JAX
+    build lacks the capability" skip — anything else propagates and
+    fails collection loudly, because a regression in the kernel code
+    itself must never read as an environment skip."""
+    try:
+        glm_grad(*data(n=8, d=4), interpret=True)
+        return None
+    except TypeError as exc:
+        if "vma" in str(exc):  # pre-vma ShapeDtypeStruct/pallas_call API
+            return f"{type(exc).__name__}: {exc}"
+        raise
+    except (ImportError, NotImplementedError) as exc:
+        # no pallas package / no interpret lowering on this backend
+        return f"{type(exc).__name__}: {exc}"
+
+
+_PALLAS_UNAVAILABLE = _pallas_cpu_unavailable()
+
+pytestmark = pytest.mark.skipif(
+    _PALLAS_UNAVAILABLE is not None,
+    reason=("Pallas CPU lowering unavailable in this environment: "
+            f"{_PALLAS_UNAVAILABLE}"),
+)
+
+
 class TestGlmGradKernel:
     @pytest.mark.parametrize("kind", ["logistic", "squared"])
     def test_matches_jnp_reference(self, kind):

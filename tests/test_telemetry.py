@@ -46,6 +46,7 @@ def _telemetry_isolated(monkeypatch, tmp_path):
     with telemetry._SOURCES_LOCK:
         telemetry._READINESS_SOURCES.clear()
         telemetry._STATUS_SOURCES.clear()
+        telemetry._HISTOGRAM_SOURCES.clear()
 
 
 def _get(server, path):
