@@ -1,0 +1,133 @@
+"""``python -m flink_ml_tpu.analysis`` — the fmtlint CLI.
+
+Mirrors ``python -m flink_ml_tpu.obs``: ``--check`` exits nonzero on any
+unsuppressed finding (and writes a machine-readable summary into
+``reports/analysis.json`` so ``obs --check`` can print its ANALYSIS
+line), ``--json`` swaps the human text for one JSON object.  Pure
+stdlib — no JAX, no NumPy — so the CI job runs it on a bare Python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from flink_ml_tpu.analysis.checkers import CHECKERS, RULES
+from flink_ml_tpu.analysis.core import (
+    BASELINE_PATH,
+    REPO_ROOT,
+    apply_baseline,
+    load_baseline,
+    load_project,
+    run_checkers,
+)
+from flink_ml_tpu.utils import knobs
+
+
+def default_report_dir(root=None) -> str:
+    """Where ``--check`` drops ``analysis.json``: the same directory
+    ``obs --check`` reads its reports from (``FMT_OBS_REPORTS`` when
+    set), so the ANALYSIS line surfaces wherever the RunReports went."""
+    return (knobs.raw("FMT_OBS_REPORTS")
+            or os.path.join(root or REPO_ROOT, "reports"))
+
+
+def write_report(path: str, payload: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flink_ml_tpu.analysis",
+        description="fmtlint: AST-based invariant checks for this repo "
+                    "(jit purity, lock discipline, knob registry, "
+                    "scope/metric hygiene)")
+    parser.add_argument("paths", nargs="*",
+                        help="extra .py files to scan on top of "
+                             "flink_ml_tpu/")
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero on unsuppressed findings and "
+                             "write reports/analysis.json")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: this checkout)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"suppression baseline (default: "
+                             f"{os.path.relpath(BASELINE_PATH, REPO_ROOT)})")
+    parser.add_argument("--no-report", action="store_true",
+                        help="do not write reports/analysis.json")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print every rule id and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    t0 = time.perf_counter()
+    project, findings = load_project(args.root, extra_paths=args.paths)
+    findings += run_checkers(project, CHECKERS)
+    entries, baseline_findings = load_baseline(args.baseline)
+    kept, suppressed, unused = apply_baseline(findings, entries)
+    # META001 (malformed baseline) is never suppressible by the baseline
+    kept += baseline_findings
+    kept.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    duration_s = time.perf_counter() - t0
+
+    by_rule: dict = {}
+    for finding in kept:
+        by_rule[finding.rule] = by_rule.get(finding.rule, 0) + 1
+
+    ok = not kept
+    summary = {
+        "kind": "analysis",
+        "ok": ok,
+        "time": time.time(),
+        "findings": len(kept),
+        "suppressed": len(suppressed),
+        "unused_suppressions": len(unused),
+        "files_scanned": len(project.modules),
+        "rules": by_rule,
+        "duration_s": round(duration_s, 3),
+    }
+
+    if args.json:
+        print(json.dumps({
+            **summary,
+            "finding_list": [f.to_dict() for f in kept],
+            "suppressed_list": [f.to_dict() for f in suppressed],
+            "unused_suppression_list": [
+                {"rule": e.rule, "file": e.file, "match": e.match}
+                for e in unused],
+        }, indent=1, sort_keys=True))
+    else:
+        for finding in kept:
+            print(finding.format())
+        for entry in unused:
+            print(f"note: unused suppression {entry.rule} in {entry.file} "
+                  f"(match {entry.match!r}) — baseline can shrink")
+        state = "clean" if ok else f"{len(kept)} finding(s)"
+        print(f"fmtlint: {state} ({len(suppressed)} suppressed, "
+              f"{len(project.modules)} files, {duration_s:.2f}s)")
+
+    if args.check and not args.no_report:
+        write_report(os.path.join(default_report_dir(args.root),
+                                  "analysis.json"), summary)
+
+    if args.check:
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

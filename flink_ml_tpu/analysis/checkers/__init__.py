@@ -1,0 +1,24 @@
+"""fmtlint checker plugins — each module exports ``check`` + ``RULES``."""
+
+from flink_ml_tpu.analysis.checkers import (  # noqa: F401
+    hygiene,
+    jit_purity,
+    knob_registry,
+    lock_discipline,
+)
+
+#: the default checker set ``python -m flink_ml_tpu.analysis`` runs
+CHECKERS = (
+    jit_purity.check,
+    lock_discipline.check,
+    knob_registry.check,
+    hygiene.check,
+)
+
+#: rule id -> one-line description, across every default checker
+RULES = {
+    "META001": "suppression baseline entry is malformed or lacks a reason",
+    "META002": "scanned file does not parse",
+}
+for _mod in (jit_purity, lock_discipline, knob_registry, hygiene):
+    RULES.update(_mod.RULES)

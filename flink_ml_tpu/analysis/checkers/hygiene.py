@@ -1,0 +1,193 @@
+"""SCOPE*/METRIC* — thread-ambient scopes and the metric namespace.
+
+SCOPE001: ``trace.use`` / ``trace.span`` / ``quarantine.capture`` /
+``drift.active`` / ``drift.transform_scope`` / ``obs.phase`` install
+thread-local ambient state and *must* be used as context managers (a
+``with`` item, or handed straight to ``ExitStack.enter_context``) — a
+bare call leaks the scope's setup without its teardown, which on a
+pooled dispatcher thread poisons every later batch on that thread.
+
+METRIC001: counter/gauge/timing names recorded through the obs registry
+are dotted-lowercase (``[a-z0-9_]`` segments joined by dots) — the
+OpenMetrics exporter rewrites anything else per-scrape and dashboards
+end up querying names that don't match the source.
+
+METRIC002: one name, one kind.  The registry keeps counters, gauges,
+and timings in separate maps, so ``counter_add("x")`` in one module and
+``gauge_set("x")`` in another silently coexist as two metrics that
+render as duplicate OpenMetrics families under one name.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from flink_ml_tpu.analysis.core import (
+    Finding,
+    Project,
+    attr_chain,
+    import_sources,
+)
+
+RULES = {
+    "SCOPE001": "thread-ambient scope factory called outside a with "
+                "statement (scopes must be context-managed)",
+    "METRIC001": "metric name is not dotted-lowercase",
+    "METRIC002": "metric name recorded as more than one kind "
+                 "(counter/gauge/timing)",
+}
+
+#: (base, attr) pairs that mint thread-ambient scopes
+_SCOPE_FACTORIES = {
+    ("trace", "use"), ("trace", "span"), ("trace", "root_span"),
+    ("quarantine", "capture"),
+    ("drift", "active"), ("drift", "transform_scope"),
+    ("obs", "phase"),
+}
+#: fully-qualified sources for bare-name imports of the same factories
+_SCOPE_SOURCES = {
+    "flink_ml_tpu.obs.trace.use", "flink_ml_tpu.obs.trace.span",
+    "flink_ml_tpu.obs.trace.root_span",
+    "flink_ml_tpu.serve.quarantine.capture",
+    "flink_ml_tpu.obs.drift.active",
+    "flink_ml_tpu.obs.drift.transform_scope",
+    "flink_ml_tpu.obs.registry.phase",
+}
+#: modules that define the factories (their internals are exempt)
+_DEFINING = {
+    "flink_ml_tpu/obs/trace.py", "flink_ml_tpu/serve/quarantine.py",
+    "flink_ml_tpu/obs/drift.py", "flink_ml_tpu/obs/registry.py",
+    "flink_ml_tpu/obs/__init__.py",
+}
+
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+
+def _is_scope_factory(call: ast.Call, imports: Dict[str, str]) -> str:
+    chain = attr_chain(call.func)
+    if not chain:
+        return ""
+    if len(chain) >= 2 and (chain[-2], chain[-1]) in _SCOPE_FACTORIES:
+        return ".".join(chain[-2:])
+    if len(chain) == 1 and imports.get(chain[0]) in _SCOPE_SOURCES:
+        return chain[0]
+    return ""
+
+
+def _scope_findings(project: Project) -> Iterator[Finding]:
+    for mod in project.modules:
+        if mod.rel in _DEFINING or mod.rel.startswith(
+                "flink_ml_tpu/analysis/"):
+            continue
+        imports = import_sources(mod.tree)
+        allowed: set = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        allowed.add(id(item.context_expr))
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or []
+                if chain[-1:] == ["enter_context"] and node.args:
+                    if isinstance(node.args[0], ast.Call):
+                        allowed.add(id(node.args[0]))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            factory = _is_scope_factory(node, imports)
+            if factory and id(node) not in allowed:
+                yield Finding(
+                    "SCOPE001", mod.rel, node.lineno,
+                    f"{factory}(...) called outside a with statement — "
+                    f"ambient scopes must be context-managed")
+
+
+#: terminal attr -> metric kind; generic terminals are gated on the base
+_RECORDERS = {
+    "counter_add": "counter",
+    "gauge_set": "gauge",
+    "set_gauge": "gauge",
+    "add": "counter",
+    "observe": "timing",
+    "phase": "timing",
+    "phased": "timing",
+}
+_GENERIC = {"add", "observe", "set_gauge", "phase", "phased"}
+
+
+def _recorder_kind(call: ast.Call, imports: Dict[str, str]) -> str:
+    chain = attr_chain(call.func)
+    if not chain:
+        return ""
+    tail = chain[-1]
+    if tail not in _RECORDERS:
+        return ""
+    if tail in _GENERIC:
+        # require an obs-ish base: obs.phase(...), registry().add(...),
+        # self._registry.observe(...) are in; set.add("X") is out
+        base_ok = False
+        if len(chain) >= 2 and chain[-2] in ("obs", "registry", "_registry",
+                                             "_REGISTRY"):
+            base_ok = True
+        elif isinstance(call.func, ast.Attribute) and isinstance(
+                call.func.value, ast.Call):
+            inner = attr_chain(call.func.value.func) or []
+            base_ok = inner[-1:] == ["registry"]
+        elif len(chain) == 1:
+            base_ok = imports.get(chain[0], "").startswith(
+                "flink_ml_tpu.obs")
+        if not base_ok:
+            return ""
+    elif len(chain) == 1 and chain[0] in ("counter_add", "gauge_set"):
+        source = imports.get(chain[0], "")
+        if source and not source.startswith("flink_ml_tpu.obs"):
+            return ""
+    return _RECORDERS[tail]
+
+
+def _metric_findings(project: Project) -> Iterator[Finding]:
+    # name -> kind -> first (file, line) seen
+    seen: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    ordered: List[Tuple[str, str, str, int]] = []
+    for mod in project.modules:
+        if mod.rel.startswith("flink_ml_tpu/analysis/"):
+            continue
+        imports = import_sources(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _recorder_kind(node, imports)
+            if not kind or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue  # f-string/variable names judged at their literals
+            name = first.value
+            if not _NAME_RE.match(name):
+                yield Finding(
+                    "METRIC001", mod.rel, node.lineno,
+                    f"metric name {name!r} is not dotted-lowercase "
+                    f"([a-z0-9_] segments joined by '.')")
+            if kind == "timing" and (attr_chain(node.func) or [])[-1:] in (
+                    ["phase"], ["phased"]):
+                name = f"phase.{name}"  # the runtime prefixes phase timers
+            ordered.append((name, kind, mod.rel, node.lineno))
+            seen.setdefault(name, {}).setdefault(kind, (mod.rel, node.lineno))
+    for name, kind, rel, line in ordered:
+        kinds = seen[name]
+        if len(kinds) > 1 and kinds[kind] == (rel, line):
+            others = {k: v for k, v in kinds.items() if k != kind}
+            desc = ", ".join(f"as a {k} at {f}:{ln}"
+                             for k, (f, ln) in sorted(others.items()))
+            yield Finding(
+                "METRIC002", rel, line,
+                f"metric name {name!r} recorded as a {kind} here but also "
+                f"{desc}")
+
+
+def check(project: Project) -> Iterator[Finding]:
+    yield from _scope_findings(project)
+    yield from _metric_findings(project)

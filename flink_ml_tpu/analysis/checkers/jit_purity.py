@@ -1,0 +1,324 @@
+"""JIT* — host effects must stay off the jit-traced path.
+
+A function traced by ``jax.jit``/``pjit``/``shard_map`` executes ONCE at
+trace time; any host effect inside it (a NumPy call, ``time.*``, RNG,
+``os.environ``, ``threading.local``, metric mutation, ``print``) is
+silently frozen into the compiled program or torn out of it — the bug
+class where a "per-step" counter bumps once per *compile* and a
+``time.time()`` timestamp is constant forever.  The same contract binds
+``fused_kernel()`` device closures (``fn=``/``csr_fn=`` passed to
+``FusedKernel``): jnp-in/jnp-out, no host materialization (the
+``finalize=`` tail is explicitly host-side and exempt).
+
+The walk is call-graph aware: from each traced root it follows calls it
+can resolve statically — local assignments (``sharded = shard_map(f,
+...)``), module-level defs, ``self.method()`` within the class, and
+cross-module ``from flink_ml_tpu.x import f`` imports — so a host
+effect two helpers deep is still attributed to its jit root.
+
+JIT002 checks the donation contract: ``donate_argnames=`` naming a
+parameter the traced function does not have silently donates nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from flink_ml_tpu.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    attr_chain,
+    import_sources,
+    qualname_index,
+)
+
+RULES = {
+    "JIT001": "host effect (np/time/random/os/threading/print/metric "
+              "mutation) reachable from a jit/pjit/shard_map-traced "
+              "function",
+    "JIT002": "jit donation contract names an argument the traced "
+              "function does not take",
+    "JIT003": "host effect inside a fused_kernel device closure "
+              "(fn=/csr_fn= must be pure jnp)",
+}
+
+#: module roots whose *calls* are host effects on a traced path
+_HOST_ROOTS = {"np", "numpy", "time", "random", "os", "threading"}
+#: obs mutators (module-qualified or imported bare)
+_OBS_MUTATORS = {"counter_add", "gauge_set", "observe", "record", "phase",
+                 "add", "set_gauge"}
+_MAX_DEPTH = 5
+
+
+def _module_for(project: Project, dotted: str) -> Optional[Module]:
+    rel = dotted.replace(".", "/")
+    return (project.by_rel.get(rel + ".py")
+            or project.by_rel.get(rel + "/__init__.py"))
+
+
+class _Scope:
+    """Where a function lives: its module, and its class (for self.*)."""
+
+    def __init__(self, module: Module, cls: Optional[str]):
+        self.module = module
+        self.cls = cls
+
+
+def _index_classes(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    return {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+
+
+def _local_assign(fn: ast.AST, name: str) -> Optional[ast.expr]:
+    """The value last assigned to ``name`` inside ``fn`` (single-target)."""
+    value = None
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            value = node.value
+    return value
+
+
+class _PurityWalker:
+    def __init__(self, project: Project, rule: str):
+        self.project = project
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self._visited: Set[Tuple[str, int]] = set()
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, expr: ast.expr, scope: _Scope,
+                enclosing: Optional[ast.AST]) -> Optional[Tuple[
+                    ast.AST, _Scope]]:
+        """Resolve an expression to a (function def/lambda, scope) pair."""
+        if isinstance(expr, ast.Lambda):
+            return expr, scope
+        if isinstance(expr, ast.Call):
+            chain = attr_chain(expr.func) or []
+            tail = chain[-1] if chain else ""
+            # unwrap wrappers whose first argument is the traced callable
+            if tail in ("jit", "pjit", "shard_map", "partial", "wraps",
+                        "phased") and expr.args:
+                return self.resolve(expr.args[0], scope, enclosing)
+            return None
+        if isinstance(expr, ast.Name):
+            # innermost first: a local assignment inside the enclosing fn
+            if enclosing is not None:
+                value = _local_assign(enclosing, expr.id)
+                if value is not None:
+                    return self.resolve(value, scope, enclosing)
+                for node in ast.walk(enclosing):
+                    if (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and node.name == expr.id):
+                        return node, scope
+            index = qualname_index(scope.module.tree)
+            if expr.id in index:
+                return index[expr.id], _Scope(scope.module, None)
+            imports = import_sources(scope.module.tree)
+            dotted = imports.get(expr.id)
+            if dotted and dotted.startswith("flink_ml_tpu."):
+                mod_dotted, _, attr = dotted.rpartition(".")
+                target = _module_for(self.project, mod_dotted)
+                if target is not None:
+                    t_index = qualname_index(target.tree)
+                    if attr in t_index:
+                        return t_index[attr], _Scope(target, None)
+            return None
+        if isinstance(expr, ast.Attribute):
+            chain = attr_chain(expr)
+            if chain and chain[0] == "self" and len(chain) == 2 and scope.cls:
+                classes = _index_classes(scope.module.tree)
+                cls = classes.get(scope.cls)
+                if cls is not None:
+                    for item in cls.body:
+                        if (isinstance(item, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                                and item.name == chain[1]):
+                            return item, scope
+            if chain and len(chain) == 2:
+                imports = import_sources(scope.module.tree)
+                dotted = imports.get(chain[0])
+                if dotted and dotted.startswith("flink_ml_tpu"):
+                    target = _module_for(self.project, dotted)
+                    if target is not None:
+                        t_index = qualname_index(target.tree)
+                        if chain[1] in t_index:
+                            return t_index[chain[1]], _Scope(target, None)
+            return None
+        return None
+
+    # -- the effect scan ---------------------------------------------------
+
+    def scan(self, fn: ast.AST, scope: _Scope, root_desc: str,
+             depth: int = 0) -> None:
+        key = (scope.module.rel, getattr(fn, "lineno", 0))
+        if key in self._visited or depth > _MAX_DEPTH:
+            return
+        self._visited.add(key)
+        name = getattr(fn, "name", "<lambda>")
+        symbol = f"{scope.cls}.{name}" if scope.cls else name
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    self._scan_call(node, fn, scope, symbol, root_desc, depth)
+                elif (isinstance(node, ast.Attribute)
+                      and isinstance(node.ctx, ast.Load)):
+                    chain = attr_chain(node)
+                    if chain and chain[:2] == ["os", "environ"]:
+                        self._emit(scope, node, symbol, root_desc,
+                                   "os.environ read")
+
+    def _scan_call(self, node: ast.Call, fn: ast.AST, scope: _Scope,
+                   symbol: str, root_desc: str, depth: int) -> None:
+        chain = attr_chain(node.func)
+        if chain is None:
+            return
+        dotted = ".".join(chain)
+        if chain == ["print"]:
+            self._emit(scope, node, symbol, root_desc, "print() call")
+            return
+        if chain[0] in _HOST_ROOTS:
+            self._emit(scope, node, symbol, root_desc,
+                       f"host call {dotted}()")
+            return
+        if chain[-1] in _OBS_MUTATORS and self._is_obs(chain, scope):
+            self._emit(scope, node, symbol, root_desc,
+                       f"metric mutation {dotted}()")
+            return
+        resolved = self.resolve(node.func, scope, fn)
+        if resolved is not None:
+            target, t_scope = resolved
+            self.scan(target, t_scope, root_desc, depth + 1)
+
+    def _is_obs(self, chain: List[str], scope: _Scope) -> bool:
+        if chain[0] in ("obs", "flight", "registry") and len(chain) >= 2:
+            return True
+        imports = import_sources(scope.module.tree)
+        dotted = imports.get(chain[0], "")
+        return dotted.startswith("flink_ml_tpu.obs")
+
+    def _emit(self, scope: _Scope, node: ast.AST, symbol: str,
+              root_desc: str, what: str) -> None:
+        self.findings.append(Finding(
+            self.rule, scope.module.rel, node.lineno,
+            f"{what} on the traced path (root: {root_desc})",
+            symbol=symbol))
+
+
+# -- root discovery -----------------------------------------------------------
+
+
+def _is_jit_chain(chain: List[str]) -> bool:
+    return bool(chain) and (chain[-1] in ("jit", "pjit")
+                            or chain == ["jit"] or chain == ["pjit"])
+
+
+def _is_shard_map_chain(chain: List[str]) -> bool:
+    return bool(chain) and chain[-1] == "shard_map"
+
+
+def _donate_findings(call: ast.Call, target: Optional[ast.AST],
+                     mod: Module, symbol: str) -> Iterator[Finding]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnames":
+            continue
+        if not isinstance(kw.value, (ast.Tuple, ast.List)):
+            continue
+        names = [e.value for e in kw.value.elts
+                 if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        if not names or not isinstance(
+                target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = target.args
+        params = {a.arg for a in
+                  args.posonlyargs + args.args + args.kwonlyargs}
+        if args.vararg:
+            params.add(args.vararg.arg)
+        for name in names:
+            if name not in params:
+                yield Finding(
+                    "JIT002", mod.rel, call.lineno,
+                    f"donate_argnames names {name!r} but the traced "
+                    f"function {target.name!r} has no such parameter "
+                    f"(it takes {sorted(params)})", symbol=symbol)
+
+
+def _walk_functions(tree: ast.Module) -> Iterator[Tuple[
+        ast.AST, Optional[str]]]:
+    """Every (function node, enclosing class name) in a module."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, None
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield item, node.name
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for mod in project.modules:
+        if mod.rel.startswith("flink_ml_tpu/analysis/"):
+            continue  # the analyzer itself traces nothing
+        for fn, cls in _walk_functions(mod.tree):
+            scope = _Scope(mod, cls)
+            symbol = f"{cls}.{fn.name}" if cls else fn.name
+            # decorator roots: @jax.jit / @partial(jax.jit, ...)
+            for deco in fn.decorator_list:
+                call = deco if isinstance(deco, ast.Call) else None
+                chain = attr_chain(call.func if call else deco) or []
+                inner_chain: List[str] = []
+                if call and chain and chain[-1] == "partial" and call.args:
+                    inner_chain = attr_chain(call.args[0]) or []
+                if _is_jit_chain(chain) or _is_jit_chain(inner_chain):
+                    root = f"@{'.'.join(chain)} at {mod.rel}:{deco.lineno}"
+                    walker = _PurityWalker(project, "JIT001")
+                    walker.scan(fn, scope, root)
+                    yield from walker.findings
+                    if call is not None:
+                        yield from _donate_findings(call, fn, mod, symbol)
+
+            # call roots inside this function's body
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func) or []
+                if not (_is_jit_chain(chain) or _is_shard_map_chain(chain)):
+                    continue
+                if not node.args:
+                    continue
+                walker = _PurityWalker(project, "JIT001")
+                resolved = walker.resolve(node.args[0], scope, fn)
+                root = (f"{'.'.join(chain)}(...) at "
+                        f"{mod.rel}:{node.lineno}")
+                if resolved is not None:
+                    walker.scan(resolved[0], resolved[1], root)
+                    yield from walker.findings
+                if _is_jit_chain(chain):
+                    yield from _donate_findings(
+                        node, resolved[0] if resolved else None, mod, symbol)
+
+            # fused_kernel device closures (fn= / csr_fn= of FusedKernel)
+            if fn.name != "fused_kernel":
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and (attr_chain(node.func) or [])[-1:]
+                        == ["FusedKernel"]):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg not in ("fn", "csr_fn"):
+                        continue
+                    walker = _PurityWalker(project, "JIT003")
+                    resolved = walker.resolve(kw.value, scope, fn)
+                    if resolved is None:
+                        continue
+                    root = (f"FusedKernel({kw.arg}=...) in {symbol} at "
+                            f"{mod.rel}:{node.lineno}")
+                    walker.scan(resolved[0], resolved[1], root)
+                    yield from walker.findings

@@ -1,0 +1,154 @@
+"""LOCK* — attributes mutated under ``self._lock`` stay under it.
+
+The serving stack is genuinely multi-threaded: the dispatcher thread,
+prefetch producers, the SLO monitor, telemetry scrape handlers, and
+caller threads all share ``ModelServer``/``CircuitBreaker``/``SlabPool``
+instances.  The repo's convention is coarse per-object locking — ``with
+self._lock:`` around every state transition — and this checker infers
+the guarded set per class instead of trusting comments:
+
+* a **lock attribute** is any ``self.X`` assigned a
+  ``threading.Lock/RLock/Condition`` (bare ``Lock()`` counts when
+  imported from threading);
+* a **guarded attribute** is any ``self.Y`` *written* inside a ``with
+  self.X:`` block in any method other than ``__init__`` (construction
+  happens before the object is published to other threads, so
+  ``__init__`` writes don't define the discipline — and aren't held to
+  it);
+* every other read (LOCK002) or write (LOCK001) of a guarded attribute
+  in the same class is a finding, except in ``__init__`` and in methods
+  whose name ends ``_locked`` (the repo's caller-holds-the-lock
+  convention, e.g. ``ModelServer._take_locked``).
+
+Nested functions inherit the lock context of their definition site —
+a closure built under the lock and handed to another thread is rare
+enough to accept as the cost of not flagging every inline helper.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from flink_ml_tpu.analysis.core import (
+    Finding,
+    Project,
+    attr_chain,
+    import_sources,
+)
+
+RULES = {
+    "LOCK001": "write of a lock-guarded attribute outside the lock",
+    "LOCK002": "read of a lock-guarded attribute outside the lock",
+}
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """``self.X`` -> ``"X"`` (empty for anything deeper or non-self)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _lock_attrs(cls: ast.ClassDef, imports: Dict[str, str]) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)):
+            continue
+        chain = attr_chain(node.value.func) or []
+        is_lock = (chain[-1:] and chain[-1] in _LOCK_TYPES
+                   and (chain[0] == "threading"
+                        or imports.get(chain[0], "").startswith("threading")))
+        if not is_lock:
+            continue
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr:
+                locks.add(attr)
+    return locks
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect ``self.Y`` accesses annotated with held-lock context."""
+
+    def __init__(self, locks: Set[str]):
+        self.locks = locks
+        self.held: List[str] = []
+        # (attr, lineno, is_write, held_locks_at_access)
+        self.accesses: List[Tuple[str, int, bool, frozenset]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.locks:
+                acquired.append(attr)
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr and attr not in self.locks:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.append(
+                (attr, node.lineno, is_write, frozenset(self.held)))
+        self.generic_visit(node)
+
+
+def _exempt(method_name: str) -> bool:
+    return method_name == "__init__" or method_name.endswith("_locked")
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for mod in project.modules:
+        imports = import_sources(mod.tree)
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls, imports)
+            if not locks:
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            scans: Dict[str, _MethodScan] = {}
+            for method in methods:
+                scan = _MethodScan(locks)
+                scan.visit(method)
+                scans[method.name] = scan
+
+            # guard inference: attr -> locks it was written under
+            guarded: Dict[str, Set[str]] = {}
+            for name, scan in scans.items():
+                if name == "__init__":
+                    continue
+                for attr, _line, is_write, held in scan.accesses:
+                    if is_write and held:
+                        guarded.setdefault(attr, set()).update(held)
+
+            for method in methods:
+                if _exempt(method.name):
+                    continue
+                scan = scans[method.name]
+                for attr, line, is_write, held in scan.accesses:
+                    if attr not in guarded:
+                        continue
+                    if held & guarded[attr]:
+                        continue
+                    lock_names = "/".join(
+                        f"self.{lk}" for lk in sorted(guarded[attr]))
+                    verb = "written" if is_write else "read"
+                    yield Finding(
+                        "LOCK001" if is_write else "LOCK002",
+                        mod.rel, line,
+                        f"attribute '{attr}' is guarded by {lock_names} "
+                        f"(written under it elsewhere in {cls.name}) but "
+                        f"{verb} bare here",
+                        symbol=f"{cls.name}.{method.name}")

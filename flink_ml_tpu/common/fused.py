@@ -53,7 +53,6 @@ time transform verbatim.
 
 from __future__ import annotations
 
-import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -66,6 +65,7 @@ from flink_ml_tpu.common.mapper import ColumnSink, _kept_indices
 from flink_ml_tpu.fault import pressure
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "FusedInput",
@@ -77,9 +77,7 @@ __all__ = [
 
 def fusion_enabled() -> bool:
     """Is fused pipeline inference on?  ``FMT_FUSE_TRANSFORM`` (default 1)."""
-    return os.environ.get("FMT_FUSE_TRANSFORM", "1").lower() not in (
-        "0", "false", "no", "off",
-    )
+    return knobs.knob_bool("FMT_FUSE_TRANSFORM")
 
 
 @dataclass(frozen=True)

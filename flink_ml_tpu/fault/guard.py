@@ -44,6 +44,7 @@ from typing import Callable, Iterable, Optional
 import numpy as np
 
 from flink_ml_tpu import obs
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "NumericHealthError",
@@ -60,9 +61,7 @@ __all__ = [
 
 def enabled() -> bool:
     """Is the numeric-health sentinel on?  (``FMT_GUARD=0`` disables.)"""
-    return os.environ.get("FMT_GUARD", "1").lower() not in (
-        "0", "false", "off", "no",
-    )
+    return knobs.knob_bool("FMT_GUARD")
 
 
 class NumericHealthError(RuntimeError):
@@ -146,8 +145,8 @@ def _run_guarded(attempt: Callable[[float], object], what: str,
     if not enabled():
         return attempt(1.0)
     if max_retries is None:
-        max_retries = int(os.environ.get("FMT_GUARD_MAX_RETRIES", "2") or 2)
-    backoff = float(os.environ.get("FMT_GUARD_LR_BACKOFF", "0.5") or 0.5)
+        max_retries = knobs.knob_int("FMT_GUARD_MAX_RETRIES")
+    backoff = knobs.knob_float("FMT_GUARD_LR_BACKOFF")
     scale = 1.0
     tried = []
     for k in range(max_retries + 1):

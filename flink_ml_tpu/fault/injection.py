@@ -56,11 +56,11 @@ Planted points (grep ``maybe_fail`` for the live set):
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, Optional
 
 from flink_ml_tpu import obs
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "InjectedFault",
@@ -82,7 +82,7 @@ class InjectedFault(RuntimeError):
     def __init__(self, point: str, call_no: int):
         super().__init__(
             f"injected fault at '{point}' (call #{call_no}; "
-            f"FMT_FAULT_INJECT={os.environ.get('FMT_FAULT_INJECT', '')!r})"
+            f"FMT_FAULT_INJECT={knobs.knob_str('FMT_FAULT_INJECT')!r})"
         )
         self.point = point
         self.call_no = call_no
@@ -184,7 +184,7 @@ def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
     from call 1."""
     global _ACTIVE
     if seed is None:
-        seed = int(os.environ.get("FMT_FAULT_SEED", "0") or 0)
+        seed = knobs.knob_int("FMT_FAULT_SEED")
     with _LOCK:
         _RULES.clear()
         _CALLS.clear()
@@ -196,7 +196,7 @@ def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
 
 def configure_from_env() -> None:
     """(Re)load the schedule from ``FMT_FAULT_INJECT``/``FMT_FAULT_SEED``."""
-    configure(os.environ.get("FMT_FAULT_INJECT", ""))
+    configure(knobs.knob_str("FMT_FAULT_INJECT"))
 
 
 def reset() -> None:

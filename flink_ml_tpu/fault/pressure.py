@@ -52,7 +52,6 @@ within the existing <= 2% disabled-overhead contract.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -61,6 +60,7 @@ import numpy as np
 
 from flink_ml_tpu import obs
 from flink_ml_tpu.fault.injection import InjectedFault, maybe_fail
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "OOM_POINT",
@@ -85,15 +85,13 @@ def enabled() -> bool:
     """Is the pressure-recovery layer on?  ``FMT_PRESSURE=0`` restores
     fail-fast behavior on allocator OOM (classification still applies —
     an OOM is never retried at the same size either way)."""
-    return os.environ.get("FMT_PRESSURE", "1").lower() not in (
-        "0", "false", "no", "off",
-    )
+    return knobs.knob_bool("FMT_PRESSURE")
 
 
 def probe_interval_s() -> float:
     """``FMT_PRESSURE_PROBE_S`` (default 30): seconds of calm before an
     additive probe back toward full batch size."""
-    return float(os.environ.get("FMT_PRESSURE_PROBE_S", "30") or 30)
+    return knobs.knob_float("FMT_PRESSURE_PROBE_S")
 
 
 # -- OOM classification -------------------------------------------------------

@@ -28,7 +28,6 @@ no allocator vocabulary and stays retryable.
 from __future__ import annotations
 
 import errno
-import os
 import random
 import time
 from dataclasses import dataclass
@@ -36,6 +35,7 @@ from typing import Callable, Optional
 
 from flink_ml_tpu import obs
 from flink_ml_tpu.fault.injection import InjectedFault
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "RetryPolicy",
@@ -118,8 +118,8 @@ def default_policy() -> RetryPolicy:
     """The process default, env-tunable: ``FMT_RETRY_ATTEMPTS`` /
     ``FMT_RETRY_BASE_S`` (see BASELINE.md's fault-tolerance knob table)."""
     return RetryPolicy(
-        attempts=int(os.environ.get("FMT_RETRY_ATTEMPTS", "3") or 3),
-        base_delay_s=float(os.environ.get("FMT_RETRY_BASE_S", "0.05") or 0.05),
+        attempts=knobs.knob_int("FMT_RETRY_ATTEMPTS"),
+        base_delay_s=knobs.knob_float("FMT_RETRY_BASE_S"),
     )
 
 

@@ -23,9 +23,9 @@ resume using a mesh with a dead peer.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable
+from flink_ml_tpu.utils import knobs
 
 __all__ = ["CollectiveTimeoutError", "agree_timeout_s", "with_timeout"]
 
@@ -47,7 +47,7 @@ class CollectiveTimeoutError(RuntimeError):
 
 def agree_timeout_s() -> float:
     """The configured watchdog window; 0 disables (wait forever)."""
-    return float(os.environ.get("FMT_AGREE_TIMEOUT_S", "0") or 0.0)
+    return knobs.knob_float("FMT_AGREE_TIMEOUT_S")
 
 
 def with_timeout(fn: Callable, name: str, timeout_s: float = None):

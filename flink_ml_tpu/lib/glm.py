@@ -58,6 +58,7 @@ from flink_ml_tpu.params.shared import (
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
 from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+from flink_ml_tpu.utils import knobs
 
 MODEL_SCHEMA = Schema.of(
     ("coefficients", DataTypes.DENSE_VECTOR), ("intercept", DataTypes.DOUBLE)
@@ -605,16 +606,12 @@ class GlmEstimatorBase(Estimator, GlmTrainParams):
         # 'auto' keeps resident only while the slabs fit the budget.
         mode = self.get_hot_slab_mode()
         if mode == "auto":
-            import os as _os
-
             from flink_ml_tpu.lib.common import (
                 hotcold_hot_k_eff,
                 hotcold_slab_bytes,
             )
 
-            budget = int(
-                _os.environ.get("FMT_HOT_SLAB_BUDGET_MB", "4096")
-            ) * (1 << 20)
+            budget = knobs.knob_int("FMT_HOT_SLAB_BUDGET_MB") * (1 << 20)
             # padded rows = groups x mb; slab width from the plan's own rule
             slab_bytes = hotcold_slab_bytes(
                 sstack.ints.shape[0] * sstack.mb,

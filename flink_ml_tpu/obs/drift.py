@@ -60,6 +60,7 @@ import numpy as np
 from flink_ml_tpu.obs import flight
 from flink_ml_tpu.obs.registry import counter_add, gauge_set
 from flink_ml_tpu.obs.sketch import ColumnSketch, ks, psi, update_matrix
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "DriftMonitor",
@@ -86,17 +87,13 @@ REFERENCE_FILE = "drift_reference.json"
 
 def enabled() -> bool:
     """Is data-drift monitoring armed?  ``FMT_DRIFT`` (default off)."""
-    return os.environ.get("FMT_DRIFT", "").lower() in ("1", "true", "yes",
-                                                       "on")
+    return knobs.knob_bool("FMT_DRIFT")
 
 
 def ref_rows() -> int:
     """``FMT_DRIFT_REF_ROWS`` (default 512): live rows (on top of the
     pre-warm sample) folded into the reference before it freezes."""
-    try:
-        return int(os.environ.get("FMT_DRIFT_REF_ROWS", "512") or 512)
-    except ValueError:
-        return 512
+    return knobs.knob_int("FMT_DRIFT_REF_ROWS")
 
 
 def psi_threshold() -> float:
@@ -104,10 +101,7 @@ def psi_threshold() -> float:
     shifted" PSI bound): the worst column's PSI at which the ``drift``
     SLO burn rate reads 1.0.  0 disables the SLO (sketching and the
     status/report sections still run)."""
-    try:
-        return float(os.environ.get("FMT_DRIFT_PSI", "0.2") or 0.2)
-    except ValueError:
-        return 0.2
+    return knobs.knob_float("FMT_DRIFT_PSI")
 
 
 def window_s() -> float:
@@ -115,20 +109,14 @@ def window_s() -> float:
     Judgment always reads the current PLUS previous window, so a breach
     is visible for at least one full window and a recovered stream stops
     being judged against stale rows after at most two."""
-    try:
-        return float(os.environ.get("FMT_DRIFT_WINDOW_S", "60") or 60)
-    except ValueError:
-        return 60.0
+    return knobs.knob_float("FMT_DRIFT_WINDOW_S")
 
 
 def min_rows() -> int:
     """``FMT_DRIFT_MIN_ROWS`` (default 64): live windows with fewer rows
     are not judged (entering a breach; a burning SLO is re-judged on any
     window — the SLO monitor's asymmetry rule)."""
-    try:
-        return int(os.environ.get("FMT_DRIFT_MIN_ROWS", "64") or 64)
-    except ValueError:
-        return 64
+    return knobs.knob_int("FMT_DRIFT_MIN_ROWS")
 
 
 def max_cols() -> int:
@@ -136,10 +124,7 @@ def max_cols() -> int:
     columns — a vector column contributes its first N dimensions.  The
     hot-path cost is one vectorized pass over the sketched columns per
     batch, so the cap is the knob that bounds its width."""
-    try:
-        return int(os.environ.get("FMT_DRIFT_MAX_COLS", "16") or 16)
-    except ValueError:
-        return 16
+    return knobs.knob_int("FMT_DRIFT_MAX_COLS")
 
 
 def window_rows() -> int:
@@ -150,10 +135,7 @@ def window_rows() -> int:
     signal for real hot-path cost.  Once a window's sample is full,
     further batches cost one counter bump until rotation; quarantine
     reason RATES stay exact (seen-row denominators keep counting)."""
-    try:
-        return int(os.environ.get("FMT_DRIFT_WINDOW_ROWS", "8192") or 8192)
-    except ValueError:
-        return 8192
+    return knobs.knob_int("FMT_DRIFT_WINDOW_ROWS")
 
 
 # -- column extraction --------------------------------------------------------
@@ -300,12 +282,13 @@ class DriftMonitor:
 
     @property
     def reference_complete(self) -> bool:
-        return self._ref_complete
+        with self._lock:
+            return self._ref_complete
 
-    def _target(self) -> Dict[str, ColumnSketch]:
+    def _target_locked(self) -> Dict[str, ColumnSketch]:
         return self._ref if not self._ref_complete else self._cur
 
-    def _window_full(self, n: int) -> bool:
+    def _window_full_locked(self, n: int) -> bool:
         """Past-the-cap check for one live batch (under the lock): a
         full window's further rows are counted (rates stay exact) but
         not sketched — the steady-state hot-path cost is this check."""
@@ -317,7 +300,7 @@ class DriftMonitor:
         return True
 
     def _observe_locked(self, mats, cols: Dict[str, np.ndarray]) -> None:
-        target = self._target()
+        target = self._target_locked()
         updated = 0
         for names, X in mats:
             sketches = []
@@ -343,7 +326,7 @@ class DriftMonitor:
         if n == 0:
             return
         with self._lock:
-            if self._window_full(n):
+            if self._window_full_locked(n):
                 counter_add("drift.rows_skipped", n)
                 return
         mats, cols = _spec_columns(batch, spec, self.cap_cols)
@@ -365,7 +348,7 @@ class DriftMonitor:
         if n == 0:
             return
         with self._lock:
-            if self._window_full(0):  # seen-rows counted by the input tap
+            if self._window_full_locked(0):  # seen-rows counted by the input tap
                 counter_add("drift.rows_skipped", n)
                 return
         cols = _table_columns(table, self.cap_cols, exclude=exclude)
@@ -407,7 +390,8 @@ class DriftMonitor:
         """End-of-batch housekeeping (the scope exit): freeze the
         reference once its row target is met (then persist it), and
         rotate the live window on ``window_s`` expiry."""
-        persist = False
+        persist_to = None
+        announce = False
         with self._lock:
             if not self._ref_complete and max(
                 self._ref_in_rows, self._ref_score_rows
@@ -416,7 +400,18 @@ class DriftMonitor:
                 gauge_set("drift.reference_rows",
                           max(self._ref_in_rows, self._ref_score_rows))
                 gauge_set("drift.reference_columns", len(self._ref))
-                persist = bool(self._persist_path) and not self._persisted
+                if self._persist_path and not self._persisted:
+                    # claim the persist while still holding the lock: two
+                    # dispatcher threads rolling past the freeze together
+                    # must not both write the reference sidecar
+                    self._persisted = True
+                    persist_to = self._persist_path
+                if not self._ref_announced:
+                    # the freezing thread also claims the announce, so a
+                    # racing roll() cannot record reference_complete with
+                    # a persisted flag whose save is still in flight
+                    self._ref_announced = True
+                    announce = True
             now = time.monotonic()
             if self._ref_complete and now - self._rotated_at >= self.window_s:
                 self._prev, self._cur = self._cur, {}
@@ -424,18 +419,25 @@ class DriftMonitor:
                 self._prev_rows, self._cur_rows = self._cur_rows, 0
                 self._prev_seen, self._cur_seen = self._cur_seen, 0
                 self._rotated_at = now
-        if persist:
+        if persist_to:
             try:
-                self.save(self._persist_path)
-                self._persisted = True
+                self.save(persist_to)
             except OSError:  # telemetry must never fail serving
                 counter_add("drift.persist_failures")
-        if self._ref_complete and not self._ref_announced:
-            self._ref_announced = True
+                with self._lock:
+                    self._persisted = False
+        with self._lock:
+            if not announce and self._ref_complete and not self._ref_announced:
+                # reference completed by load() rather than a live freeze:
+                # no persist can be in flight, so _persisted is final
+                self._ref_announced = True
+                announce = True
+            rows = max(self._ref_in_rows, self._ref_score_rows)
+            columns = len(self._ref)
+            persisted = self._persisted
+        if announce:
             flight.record("drift.reference_complete", monitor=self.name,
-                          rows=max(self._ref_in_rows, self._ref_score_rows),
-                          columns=len(self._ref),
-                          persisted=self._persisted)
+                          rows=rows, columns=columns, persisted=persisted)
 
     # -- scoring --------------------------------------------------------------
 
@@ -459,8 +461,9 @@ class DriftMonitor:
         """Per-column drift statistics, worst first: every column the
         reference AND the live window both hold, with PSI, KS, and the
         reference-vs-live quantile summaries the breach dump carries."""
-        if not self._ref_complete:
-            return []
+        with self._lock:
+            if not self._ref_complete:
+                return []
         live, _rows = self._live_merged()
         with self._lock:
             ref = dict(self._ref)
@@ -513,9 +516,11 @@ class DriftMonitor:
         ``allow_small`` is False — the SLO monitor passes True while the
         SLO is already burning), else the burn-rate math plus the
         offending columns."""
-        if not self._ref_complete or self.threshold <= 0:
+        if self.threshold <= 0:
             return None
         with self._lock:
+            if not self._ref_complete:
+                return None
             live_rows = self._cur_rows + self._prev_rows
         if live_rows < self.min_rows and not allow_small:
             return None

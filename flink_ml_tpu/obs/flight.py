@@ -41,6 +41,7 @@ import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "dump",
@@ -63,24 +64,16 @@ _HOOKS_INSTALLED = False
 
 
 def _capacity() -> int:
-    try:
-        return int(os.environ.get("FMT_FLIGHT_EVENTS",
-                                  str(_DEFAULT_CAPACITY))
-                   or _DEFAULT_CAPACITY)
-    except ValueError:
-        return _DEFAULT_CAPACITY
+    return knobs.knob_int("FMT_FLIGHT_EVENTS")
 
 
 def _min_interval_s() -> float:
-    try:
-        return float(os.environ.get("FMT_FLIGHT_MIN_S", "30") or 30)
-    except ValueError:
-        return 30.0
+    return knobs.knob_float("FMT_FLIGHT_MIN_S")
 
 
 def flight_dir() -> str:
     """``FMT_FLIGHT_DIR``, else ``flight/`` under the reports dir."""
-    d = os.environ.get("FMT_FLIGHT_DIR")
+    d = knobs.raw("FMT_FLIGHT_DIR")
     if not d:
         from flink_ml_tpu.obs.report import reports_dir
 

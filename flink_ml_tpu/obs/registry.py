@@ -22,17 +22,13 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 import threading
 import time
 from typing import Dict, Optional
+from flink_ml_tpu.utils import knobs
 
 
-def _env_truthy(name: str) -> bool:
-    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
-
-
-_ENABLED = _env_truthy("FMT_OBS")
+_ENABLED = knobs.knob_bool("FMT_OBS")
 
 
 def enabled() -> bool:

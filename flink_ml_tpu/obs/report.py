@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 from flink_ml_tpu.obs.registry import enabled as _obs_enabled
 from flink_ml_tpu.obs.registry import registry as _obs_registry
 from flink_ml_tpu.obs.registry import reset_generation as _obs_reset_gen
+from flink_ml_tpu.utils import knobs
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -59,7 +60,7 @@ def git_sha() -> str:
     """The repo HEAD SHA (cached; ``unknown`` outside a git checkout)."""
     global _GIT_SHA
     if _GIT_SHA is None:
-        sha = os.environ.get("FMT_GIT_SHA")
+        sha = knobs.raw("FMT_GIT_SHA")
         if not sha:
             try:
                 sha = subprocess.run(
@@ -109,7 +110,7 @@ class RunReport:
 
 def reports_dir() -> str:
     """``FMT_OBS_REPORTS`` if set, else ``<repo>/reports``."""
-    return os.environ.get("FMT_OBS_REPORTS") or os.path.join(
+    return knobs.raw("FMT_OBS_REPORTS") or os.path.join(
         _REPO_ROOT, "reports"
     )
 
@@ -432,6 +433,19 @@ def drift_runs(reports: List[dict]) -> List[dict]:
     return out
 
 
+def analysis_summary(directory: Optional[str] = None) -> Optional[dict]:
+    """The latest fmtlint ``--check`` summary (``analysis.json`` in the
+    reports dir), or None when no analysis report is present — feeds the
+    ANALYSIS line alongside FAULT-ASSISTED/SERVE-DEGRADED/DRIFT."""
+    path = os.path.join(directory or reports_dir(), "analysis.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return data if data.get("kind") == "analysis" else None
+
+
 #: per-fit timing stats worth a tail-quantile line in ``--check`` output
 _FIT_TIMING_KEYS = ("train.dispatch", "train.sync", "train.place")
 
@@ -703,6 +717,7 @@ def main(argv=None) -> int:
     fault_assisted = fault_assisted_runs(reports)
     serve_degraded = serve_degraded_runs(reports)
     drift_rows = drift_runs(reports)
+    analysis = analysis_summary(args.reports)
     timing_summary = timing_quantile_summary(reports)
     rows = diff_against_baseline(reports, baseline, args.threshold)
     regressions = sum(r["status"] == "regression" for r in rows)
@@ -727,9 +742,24 @@ def main(argv=None) -> int:
             "fault_assisted": fault_assisted,
             "serve_degraded": serve_degraded,
             "drift": drift_rows,
+            "analysis": analysis,
             "timings": timing_summary,
         }, sort_keys=True, indent=1))
         return 1 if failed else 0
+
+    # static-analysis state, when fmtlint's --check has left a report —
+    # same visibility rule as the FAULT-ASSISTED/SERVE-DEGRADED/DRIFT
+    # lines: the serving numbers read differently when the invariant
+    # gate behind them is red
+    if analysis is not None:
+        verdict = "clean" if analysis.get("ok") else "FAIL"
+        rules = analysis.get("rules") or {}
+        detail = (" " + ", ".join(f"{r}={n}" for r, n in sorted(rules.items()))
+                  if rules else "")
+        print(f"ANALYSIS fmtlint {verdict}: "
+              f"{analysis.get('findings', 0)} finding(s), "
+              f"{analysis.get('suppressed', 0)} suppressed, "
+              f"{analysis.get('files_scanned', 0)} files{detail}")
 
     # fault-assisted fits are flagged alongside the perf diff: a run that
     # only passed by retrying is one environment blip from not passing

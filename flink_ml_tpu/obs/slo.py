@@ -55,12 +55,12 @@ sample_once` directly for determinism.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional
 
 from flink_ml_tpu.obs import flight
 from flink_ml_tpu.obs.registry import gauge_set, registry
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "DRIFT_SLO",
@@ -86,35 +86,23 @@ _LATENCY_BUDGET = 0.01
 
 def window_s() -> float:
     """``FMT_SLO_WINDOW_S`` (default 30): the rolling sample window."""
-    try:
-        return float(os.environ.get("FMT_SLO_WINDOW_S", "30") or 30)
-    except ValueError:
-        return 30.0
+    return knobs.knob_float("FMT_SLO_WINDOW_S")
 
 
 def p99_target_ms() -> float:
     """``FMT_SLO_P99_MS`` (default 0 = SLO disabled)."""
-    try:
-        return float(os.environ.get("FMT_SLO_P99_MS", "0") or 0)
-    except ValueError:
-        return 0.0
+    return knobs.knob_float("FMT_SLO_P99_MS")
 
 
 def err_ratio_target() -> float:
     """``FMT_SLO_ERR_RATIO`` (default 0 = SLO disabled)."""
-    try:
-        return float(os.environ.get("FMT_SLO_ERR_RATIO", "0") or 0)
-    except ValueError:
-        return 0.0
+    return knobs.knob_float("FMT_SLO_ERR_RATIO")
 
 
 def min_events() -> int:
     """``FMT_SLO_MIN_EVENTS`` (default 10): windows with fewer arrivals
     are skipped, not judged."""
-    try:
-        return int(os.environ.get("FMT_SLO_MIN_EVENTS", "10") or 10)
-    except ValueError:
-        return 10
+    return knobs.knob_int("FMT_SLO_MIN_EVENTS")
 
 
 class SLOMonitor:

@@ -64,6 +64,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from flink_ml_tpu.obs.registry import registry
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "TelemetryServer",
@@ -99,7 +100,7 @@ _CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
 def env_port() -> Optional[int]:
     """``FMT_TELEMETRY_PORT``: None when unset/empty (telemetry off),
     ``0`` for an ephemeral port, else the fixed port to bind."""
-    raw = os.environ.get("FMT_TELEMETRY_PORT", "").strip()
+    raw = knobs.knob_str("FMT_TELEMETRY_PORT").strip()
     if not raw:
         return None
     try:
@@ -110,17 +111,14 @@ def env_port() -> Optional[int]:
 
 
 def _env_host() -> str:
-    return os.environ.get("FMT_TELEMETRY_HOST", "").strip() or "127.0.0.1"
+    return knobs.knob_str("FMT_TELEMETRY_HOST").strip() or "127.0.0.1"
 
 
 def pressure_floor() -> int:
     """``FMT_READY_PRESSURE_FLOOR`` (default 8): a memory-pressure cap
     pinned below this many rows marks the process unready — the AIMD
     state says the device cannot serve even a token batch."""
-    try:
-        return int(os.environ.get("FMT_READY_PRESSURE_FLOOR", "8") or 8)
-    except ValueError:
-        return 8
+    return knobs.knob_int("FMT_READY_PRESSURE_FLOOR")
 
 
 def queue_saturation_frac() -> float:
@@ -128,10 +126,7 @@ def queue_saturation_frac() -> float:
     of ``queue_cap`` at which a server reports ``queue_saturated`` —
     readiness should flip BEFORE admission starts shedding, so the
     balancer stops routing while there is still headroom."""
-    try:
-        return float(os.environ.get("FMT_READY_QUEUE_FRAC", "0.95") or 0.95)
-    except ValueError:
-        return 0.95
+    return knobs.knob_float("FMT_READY_QUEUE_FRAC")
 
 
 # -- OpenMetrics rendering ----------------------------------------------------

@@ -81,9 +81,9 @@ __all__ = [
 ]
 
 
-from flink_ml_tpu.obs.registry import _env_truthy
+from flink_ml_tpu.utils import knobs
 
-_ENABLED = _env_truthy("FMT_TRACE")
+_ENABLED = knobs.knob_bool("FMT_TRACE")
 
 #: the serving shed vocabulary (serving/errors.py SHED_* codes) — spans
 #: ended by an exception carrying one of THESE reasons are load sheds,
@@ -93,10 +93,7 @@ _ENABLED = _env_truthy("FMT_TRACE")
 _SHED_REASONS = frozenset(
     ("queue_full", "deadline_expired", "breaker_open", "shutdown")
 )
-try:
-    _SAMPLE = float(os.environ.get("FMT_TRACE_SAMPLE", "") or 1.0)
-except ValueError:
-    _SAMPLE = 1.0
+_SAMPLE = knobs.knob_float("FMT_TRACE_SAMPLE")
 
 _RNG = random.Random()  # OS-seeded; head-sampling only, never correctness
 
@@ -159,7 +156,7 @@ _WRITE_FAILED = False
 
 def traces_path() -> str:
     """``FMT_TRACE_DIR``'s (or the reports dir's) ``traces.jsonl``."""
-    d = os.environ.get("FMT_TRACE_DIR")
+    d = knobs.raw("FMT_TRACE_DIR")
     if not d:
         from flink_ml_tpu.obs.report import reports_dir
 

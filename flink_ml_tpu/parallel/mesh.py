@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -20,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_ml_tpu.fault.injection import maybe_fail
 from flink_ml_tpu.fault.watchdog import with_timeout
+from flink_ml_tpu.utils import knobs
 
 
 def default_mesh(axis_names: Sequence[str] = ("data",), devices=None) -> Mesh:
@@ -211,7 +211,7 @@ _CHUNKED_MIN_BYTES_DEFAULT = 64 << 20
 
 
 def _placement_chunk_bytes() -> int:
-    return int(os.environ.get("FMT_SLAB_CHUNK_MB", "0") or 0) * (1 << 20) \
+    return knobs.knob_int("FMT_SLAB_CHUNK_MB") * (1 << 20) \
         or _CHUNK_BYTES_DEFAULT
 
 

@@ -43,7 +43,6 @@ default inference contract is process-local and collective-free, so plain
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import warnings
@@ -52,6 +51,7 @@ from typing import Callable, Dict, Optional
 from flink_ml_tpu import obs
 from flink_ml_tpu.fault.injection import maybe_fail
 from flink_ml_tpu.fault.retry import is_transient, with_retry
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "CircuitBreaker",
@@ -68,16 +68,16 @@ _CLOSED, _HALF_OPEN, _OPEN = 0.0, 0.5, 1.0
 
 
 def _threshold() -> int:
-    return int(os.environ.get("FMT_SERVE_BREAKER_THRESHOLD", "3") or 3)
+    return knobs.knob_int("FMT_SERVE_BREAKER_THRESHOLD")
 
 
 def _cooldown_s() -> float:
-    return float(os.environ.get("FMT_SERVE_BREAKER_COOLDOWN_S", "30") or 30)
+    return knobs.knob_float("FMT_SERVE_BREAKER_COOLDOWN_S")
 
 
 def _deadline_ms() -> float:
     """``FMT_SERVE_DEADLINE_MS`` (0 = no deadline accounting)."""
-    return float(os.environ.get("FMT_SERVE_DEADLINE_MS", "0") or 0)
+    return knobs.knob_float("FMT_SERVE_DEADLINE_MS")
 
 
 class CircuitBreaker:
@@ -102,7 +102,7 @@ class CircuitBreaker:
         self._probing = False
         self._probe_started: Optional[float] = None
 
-    def _publish(self) -> None:
+    def _publish_locked(self) -> None:
         global _STATE_GEN
         _STATE_GEN += 1  # invalidates cross-breaker state memos (serving)
         obs.gauge_set(f"serve.breaker_state.{self.name}", self._state)
@@ -156,7 +156,7 @@ class CircuitBreaker:
                 self._state = _HALF_OPEN
                 self._probing = True
                 self._probe_started = now
-                self._publish()
+                self._publish_locked()
                 return True
             return False
 
@@ -186,7 +186,7 @@ class CircuitBreaker:
                 self._opened_at = time.monotonic()
             self._probing = False
             self._probe_started = None
-            self._publish()
+            self._publish_locked()
         if opened:
             # breaker-open is a black-box moment: dump the ring OUTSIDE
             # the breaker lock (the dump does file I/O; rate-limited)
@@ -200,7 +200,7 @@ class CircuitBreaker:
                 self._state = _CLOSED
                 self._probing = False
                 self._probe_started = None
-                self._publish()
+                self._publish_locked()
 
 
 _BREAKERS: Dict[str, CircuitBreaker] = {}

@@ -32,7 +32,6 @@ fail-open behavior — bad rows flow into the computation unchecked.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -43,6 +42,7 @@ from flink_ml_tpu.ops.batch import CsrRows
 from flink_ml_tpu.ops.vector import DenseVector, SparseVector, Vector
 from flink_ml_tpu.table.schema import DataTypes, Schema
 from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "QUARANTINE_REASON_COL",
@@ -76,13 +76,11 @@ REASON_NULL = "null"
 
 def enabled() -> bool:
     """Is the quarantine boundary on?  ``FMT_SERVE_QUARANTINE`` (default 1)."""
-    return os.environ.get("FMT_SERVE_QUARANTINE", "1").lower() not in (
-        "0", "false", "no", "off",
-    )
+    return knobs.knob_bool("FMT_SERVE_QUARANTINE")
 
 
 def _cap() -> int:
-    return int(os.environ.get("FMT_SERVE_QUARANTINE_CAP", "10000") or 10000)
+    return knobs.knob_int("FMT_SERVE_QUARANTINE_CAP")
 
 
 # -- the on-device finite check ----------------------------------------------

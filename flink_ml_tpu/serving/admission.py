@@ -23,7 +23,6 @@ backpressure is visible before it becomes an outage.
 
 from __future__ import annotations
 
-import os
 import time
 from concurrent.futures import InvalidStateError
 from dataclasses import dataclass
@@ -35,6 +34,7 @@ from flink_ml_tpu.serving.errors import (
     SHED_MEMORY_PRESSURE,
     ServerOverloadedError,
 )
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "ServingConfig",
@@ -43,14 +43,6 @@ __all__ = [
     "shed",
     "table_nbytes",
 ]
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
 
 
 @dataclass(frozen=True)
@@ -88,29 +80,27 @@ class ServingConfig:
         shed_on_breaker: Optional[bool] = None,
     ) -> "ServingConfig":
         if shed_on_breaker is None:
-            shed_on_breaker = os.environ.get(
-                "FMT_SERVING_SHED_ON_BREAKER", "1"
-            ).lower() not in ("0", "false", "no", "off")
+            shed_on_breaker = knobs.knob_bool("FMT_SERVING_SHED_ON_BREAKER")
         cfg = cls(
             max_batch=int(
                 max_batch if max_batch is not None
-                else _env_float("FMT_SERVING_MAX_BATCH", 512)
+                else knobs.knob_int("FMT_SERVING_MAX_BATCH")
             ),
             max_wait_ms=float(
                 max_wait_ms if max_wait_ms is not None
-                else _env_float("FMT_SERVING_MAX_WAIT_MS", 2.0)
+                else knobs.knob_float("FMT_SERVING_MAX_WAIT_MS")
             ),
             queue_cap=int(
                 queue_cap if queue_cap is not None
-                else _env_float("FMT_SERVING_QUEUE_CAP", 4096)
+                else knobs.knob_int("FMT_SERVING_QUEUE_CAP")
             ),
             queue_cap_mb=float(
                 queue_cap_mb if queue_cap_mb is not None
-                else _env_float("FMT_SERVING_QUEUE_CAP_MB", 0.0)
+                else knobs.knob_float("FMT_SERVING_QUEUE_CAP_MB")
             ),
             deadline_ms=float(
                 deadline_ms if deadline_ms is not None
-                else _env_float("FMT_SERVING_DEADLINE_MS", 0.0)
+                else knobs.knob_float("FMT_SERVING_DEADLINE_MS")
             ),
             shed_on_breaker=bool(shed_on_breaker),
         )

@@ -297,7 +297,9 @@ class ModelServer:
 
     @property
     def running(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        with self._cond:  # reentrant: _cond wraps an RLock
+            thread = self._thread
+        return thread is not None and thread.is_alive()
 
     def __enter__(self) -> "ModelServer":
         return self.start()
@@ -321,11 +323,12 @@ class ModelServer:
                 self._queue.clear()
                 self._queued_rows = 0
                 self._queued_bytes = 0
+            thread = self._thread  # join OUTSIDE the lock, on a stable ref
             self._cond.notify_all()
         for r in dropped:  # complete futures outside the lock
             self._shed(r, SHED_SHUTDOWN, "server shut down without draining")
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
+        if thread is not None:
+            thread.join(timeout=timeout)
         elif drain:
             # never started: drain inline on the calling thread so queued
             # futures still resolve (submit-before-start is supported)
@@ -484,12 +487,14 @@ class ModelServer:
 
     def _telemetry_status(self) -> dict:
         """This server's /statusz contribution."""
+        with self._cond:
+            queued_rows = self._queued_rows
         return {
             "active_version": self.active_version,
             "versions": self.versions,
             "running": self.running,
             "deploy_in_progress": self._versions.deploy_in_progress,
-            "queued_rows": self._queued_rows,
+            "queued_rows": queued_rows,
             "queue_cap": self.config.queue_cap,
             "max_batch": self.config.max_batch,
             "stats": self.stats(),

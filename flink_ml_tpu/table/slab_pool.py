@@ -49,7 +49,6 @@ bench uses it for the uncached-parity comparison.
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 import warnings
 import weakref
@@ -59,6 +58,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from flink_ml_tpu import obs
+from flink_ml_tpu.utils import knobs
 
 __all__ = [
     "SlabPool",
@@ -76,9 +76,7 @@ __all__ = [
 
 def enabled() -> bool:
     """Pooling on?  ``FMT_SLAB_POOL=0`` turns every lookup into a build."""
-    return os.environ.get("FMT_SLAB_POOL", "1").lower() not in (
-        "0", "false", "off", "no",
-    )
+    return knobs.knob_bool("FMT_SLAB_POOL")
 
 
 #: cross-process agreement on the on/off switch (None = unresolved).  The
@@ -268,7 +266,7 @@ class SlabPool:
         if self._budget is None:
             import jax
 
-            mb = int(os.environ.get("FMT_SLAB_POOL_BUDGET_MB", "4096"))
+            mb = knobs.knob_int("FMT_SLAB_POOL_BUDGET_MB")
             if jax.process_count() > 1 and not collective_ok:
                 return mb << 20  # local, uncached: no collective here
             from flink_ml_tpu.parallel.mesh import agree_max
@@ -301,33 +299,33 @@ class SlabPool:
             )
         return out
 
-    def _drain_dead(self) -> None:
+    def _drain_dead_locked(self) -> None:
         """Reap entries whose source buffers were GC'd (under the lock)."""
         while self._dead_keys:
             key = self._dead_keys.pop()
             entry = self._entries.get(key)
             if entry is not None and not entry.alive() and entry.pins == 0:
-                self._drop(key, entry)
+                self._drop_locked(key, entry)
         if self._displaced:
             self._displaced = [e for e in self._displaced if e.pins > 0]
 
-    def _lookup(self, key) -> Optional[_Entry]:
+    def _lookup_locked(self, key) -> Optional[_Entry]:
         """Hit path under the lock: validates liveness, refreshes LRU."""
-        self._drain_dead()
+        self._drain_dead_locked()
         entry = self._entries.get(key)
         if entry is None:
             return None
         if not entry.alive():
             # dead-but-pinned: a miss, but the pool's reference stays until
             # the in-flight device call releases the pin (the pin invariant
-            # _drain_dead/_evict_over_budget also honor)
+            # _drain_dead_locked/_evict_over_budget_locked also honor)
             if entry.pins == 0:
-                self._drop(key, entry)
+                self._drop_locked(key, entry)
             return None
         self._entries.move_to_end(key)
         return entry
 
-    def _drop(self, key, entry: _Entry) -> None:
+    def _drop_locked(self, key, entry: _Entry) -> None:
         self._entries.pop(key, None)
         self._by_value.pop(id(entry.value), None)
         self.bytes -= entry.nbytes
@@ -357,7 +355,7 @@ class SlabPool:
         try:
             maybe_fail("slab.lookup")
             with self._lock:
-                entry = self._lookup(key)
+                entry = self._lookup_locked(key)
         except Exception as exc:  # noqa: BLE001 - transient-only, see below
             # graceful degradation, for EVERY pool consumer (training
             # wrappers, KNN model load, the batched-apply path): the pool
@@ -421,20 +419,20 @@ class SlabPool:
                 self._entries.pop(key, None)
                 self.bytes -= old.nbytes
             elif old is not None:
-                self._drop(key, old)
+                self._drop_locked(key, old)
             self._entries[key] = _Entry(
                 value, nbytes, self._guarded_refs(key, refs)
             )
             self._by_value[id(value)] = key
             self.bytes += nbytes
-            self._evict_over_budget(keep=key, collective_ok=multi or
+            self._evict_over_budget_locked(keep=key, collective_ok=multi or
                                     jax.process_count() == 1)
             obs.counter_add("slab_pool.misses")
             obs.counter_add("slab_pool.bytes_placed", nbytes)
-            self._record_gauges()
+            self._record_gauges_locked()
         return value
 
-    def _evict_over_budget(self, keep=None, collective_ok: bool = True) -> None:
+    def _evict_over_budget_locked(self, keep=None, collective_ok: bool = True) -> None:
         """LRU eviction down to the budget; pinned entries and ``keep``
         (the entry just produced) are never evicted.  Eviction only drops
         the pool's reference — the runtime frees device memory when the
@@ -446,7 +444,7 @@ class SlabPool:
         # until budget pressure
         for key, entry in list(self._entries.items()):
             if not entry.alive() and entry.pins == 0:
-                self._drop(key, entry)
+                self._drop_locked(key, entry)
         budget = self.budget_bytes(collective_ok)
         if self.bytes <= budget:
             return
@@ -456,11 +454,11 @@ class SlabPool:
             entry = self._entries[key]
             if key == keep or entry.pins > 0:
                 continue
-            self._drop(key, entry)
+            self._drop_locked(key, entry)
             self.evictions += 1
             obs.counter_add("slab_pool.evictions")
 
-    def _record_gauges(self) -> None:
+    def _record_gauges_locked(self) -> None:
         obs.gauge_set("slab_pool.bytes", float(self.bytes))
         obs.gauge_set("slab_pool.entries", float(len(self._entries)))
 
@@ -496,12 +494,12 @@ class SlabPool:
                 if entry.pins > 0:
                     continue
                 dropped += entry.nbytes
-                self._drop(key, entry)
+                self._drop_locked(key, entry)
                 self.evictions += 1
             if dropped:
                 obs.counter_add("slab_pool.pressure_evictions")
                 obs.counter_add("slab_pool.pressure_evicted_bytes", dropped)
-                self._record_gauges()
+                self._record_gauges_locked()
         return dropped
 
     def reap(self) -> None:
@@ -512,14 +510,14 @@ class SlabPool:
         slab cannot sit in device memory for the process lifetime just
         because no later fit happened to run."""
         with self._lock:
-            self._drain_dead()
+            self._drain_dead_locked()
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self._by_value.clear()
             self.bytes = 0
-            self._record_gauges()
+            self._record_gauges_locked()
 
 
 _POOL: Optional[SlabPool] = None

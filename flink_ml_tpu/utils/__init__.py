@@ -1,8 +1,32 @@
-"""utils — environment registry, persistence, metrics."""
+"""utils — environment registry, persistence, metrics, knob registry.
 
-from flink_ml_tpu.utils.persistence import load_table, save_table  # noqa: F401
-from flink_ml_tpu.utils.environment import (  # noqa: F401
-    MLEnvironment,
-    MLEnvironmentFactory,
-)
-from flink_ml_tpu.utils.metrics import StepMetrics  # noqa: F401
+Re-exports resolve lazily (PEP 562): :mod:`flink_ml_tpu.utils.knobs` is
+the leaf module every layer (fault, serve, obs, table) imports for its
+``FMT_*`` environment knobs, so this ``__init__`` must not drag the
+persistence/table/serve import graph in eagerly — that would turn the
+low-level knob import into a circular one.
+"""
+
+_LAZY = {
+    "load_table": ("flink_ml_tpu.utils.persistence", "load_table"),
+    "save_table": ("flink_ml_tpu.utils.persistence", "save_table"),
+    "MLEnvironment": ("flink_ml_tpu.utils.environment", "MLEnvironment"),
+    "MLEnvironmentFactory": (
+        "flink_ml_tpu.utils.environment", "MLEnvironmentFactory"),
+    "StepMetrics": ("flink_ml_tpu.utils.metrics", "StepMetrics"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value  # cache: resolve each re-export once
+    return value

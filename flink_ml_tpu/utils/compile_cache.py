@@ -133,7 +133,12 @@ def enable_compilation_cache(directory: str | None = None, *,
     if env.lower() in ("off", "0", "disable", "disabled"):
         return None
 
-    import jax
+    try:
+        import jax
+    except ImportError:
+        # pure-host tooling (the static analyzer's CLI) imports the
+        # package in images without JAX; no backend means no cache
+        return None
 
     if directory is None and not env and not backend_known:
         platforms = (jax.config.jax_platforms or "").strip()

@@ -1,0 +1,15 @@
+"""SCOPE001/METRIC001/METRIC002 bad cases."""
+from flink_ml_tpu import obs
+from flink_ml_tpu.obs import trace
+from flink_ml_tpu.serve import quarantine
+
+
+def leaky(parents):
+    trace.use(parents)            # SCOPE001: ambient scope never exits
+    quarantine.capture()          # SCOPE001
+
+
+def bad_names():
+    obs.counter_add("Serving.Requests")   # METRIC001: not dotted-lowercase
+    obs.counter_add("fixture.mixed")      # METRIC002 pair: counter...
+    obs.gauge_set("fixture.mixed", 1.0)   # ...and gauge, one name

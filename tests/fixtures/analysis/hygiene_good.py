@@ -1,0 +1,24 @@
+"""SCOPE/METRIC good cases."""
+import contextlib
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.obs import trace
+from flink_ml_tpu.serve import quarantine
+
+
+def scoped(parents):
+    with trace.use(parents):
+        with quarantine.capture() as captured:
+            return captured
+
+
+def scoped_stack(parents):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(trace.use(parents))
+
+
+def good_names():
+    obs.counter_add("serving.requests")
+    obs.gauge_set("serving.queue_depth", 3.0)
+    with obs.phase("pack_csr"):
+        pass

@@ -1,0 +1,32 @@
+"""JIT001/JIT002/JIT003 bad cases: host effects on traced paths."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu import obs
+
+
+def _impure_step(x):
+    obs.counter_add("fixture.steps")   # metric mutation at trace time
+    print("step")                      # host I/O at trace time
+    return jnp.sum(x) + time.time()    # clock frozen into the program
+
+
+@jax.jit
+def decorated_root(x):
+    return _impure_step(x)
+
+
+def call_root(x):
+    fn = jax.jit(_impure_step, donate_argnames=("missing",))
+    return fn(x)
+
+
+class BadMapper:
+    def fused_kernel(self):
+        def fn(x, w):
+            return {"scores": np.asarray(x) @ w}  # host materialization
+
+        return FusedKernel(fn=fn, out_keys=("scores",))  # noqa: F821

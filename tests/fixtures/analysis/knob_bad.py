@@ -1,0 +1,25 @@
+"""KNOB001/KNOB002 bad cases: bypassing or escaping the registry."""
+import os
+from os import environ, getenv
+
+from flink_ml_tpu.utils import knobs
+
+
+def bypass():
+    return os.environ.get("FMT_OBS", "0")          # KNOB001: direct read
+
+
+def bypass_subscript():
+    return os.environ["FMT_TRACE"]                 # KNOB001: direct read
+
+
+def undeclared():
+    return knobs.knob_int("FMT_NOT_A_REAL_KNOB")   # KNOB002: undeclared
+
+
+def bypass_from_import():
+    return environ.get("FMT_GUARD")                # KNOB001: aliased read
+
+
+def bypass_getenv_from_import():
+    return getenv("FMT_DRIFT")                     # KNOB001: aliased read

@@ -1,0 +1,16 @@
+"""KNOB good cases: declared knobs read through the registry."""
+import os
+
+from flink_ml_tpu.utils import knobs
+
+
+def declared_reads():
+    return (knobs.knob_bool("FMT_OBS"), knobs.knob_float("FMT_RETRY_BASE_S"))
+
+
+def env_write_is_fine():
+    os.environ["FMT_OBS"] = "1"    # test-setup idiom: writes are not reads
+
+
+def non_knob_env_read():
+    return os.environ.get("JAX_PLATFORMS", "")     # not an FMT_* knob

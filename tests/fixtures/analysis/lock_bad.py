@@ -1,0 +1,20 @@
+"""LOCK001/LOCK002 bad cases: guarded attributes touched bare."""
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._state = "closed"
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._state = "open"
+
+    def peek(self):
+        return self._count          # LOCK002: bare read
+
+    def reset(self):
+        self._state = "closed"      # LOCK001: bare write

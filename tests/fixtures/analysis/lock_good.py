@@ -1,0 +1,24 @@
+"""LOCK good cases: every touch locked, or the _locked convention."""
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._config = "fixed"      # only ever written in __init__
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._bump_more_locked()
+
+    def _bump_more_locked(self):
+        self._count += 1            # caller holds the lock by convention
+
+    def peek(self):
+        with self._lock:
+            return self._count
+
+    def describe(self):
+        return self._config         # unguarded config read: fine
