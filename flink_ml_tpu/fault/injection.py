@@ -51,6 +51,13 @@ Planted points (grep ``maybe_fail`` for the live set):
                     pressure-aware dispatch (fused plans, staged applies,
                     training placement, serving batches); pair with the
                     value-conditioned ``fault.oom>N`` grammar
+``router.dispatch`` :meth:`~flink_ml_tpu.serving.router.ReplicaRouter.
+                    _route` — before each router->replica forward
+                    (classified like an unreachable replica: retried on
+                    another replica within ``FMT_ROUTER_RETRIES``)
+``router.spawn``    :meth:`~flink_ml_tpu.serving.replica.ReplicaProcess.
+                    spawn` — replica subprocess boot (the respawn path's
+                    bounded-retry lever)
 ==================  =========================================================
 """
 

@@ -71,10 +71,12 @@ __all__ = [
     "active_server",
     "counters_within_bounds",
     "env_port",
+    "env_port_file",
     "family_name",
     "parse_openmetrics",
     "pressure_floor",
     "queue_saturation_frac",
+    "read_port_file",
     "readiness",
     "register_histograms",
     "register_readiness",
@@ -86,6 +88,7 @@ __all__ = [
     "unregister_histograms",
     "unregister_readiness",
     "unregister_status",
+    "write_port_file",
 ]
 
 #: monotonic stamp of module import — the process-uptime anchor statusz
@@ -112,6 +115,41 @@ def env_port() -> Optional[int]:
 
 def _env_host() -> str:
     return knobs.knob_str("FMT_TELEMETRY_HOST").strip() or "127.0.0.1"
+
+
+def env_port_file() -> str:
+    """``FMT_TELEMETRY_PORT_FILE``: a path that atomically receives the
+    BOUND ``host:port`` when an endpoint comes up (empty = off).  The
+    ephemeral-port discovery fix (ISSUE 13): with ``FMT_TELEMETRY_PORT=0``
+    the bound port was only observable in-process — a parent supervising
+    a serving child (the replica router) reads it from this file."""
+    return knobs.knob_str("FMT_TELEMETRY_PORT_FILE").strip()
+
+
+def write_port_file(path: str, host: str, port: int) -> None:
+    """Atomically publish ``host:port`` to ``path``: write a sibling temp
+    file, fsync, ``os.replace`` — a reader never sees a partial address,
+    and a stale file from a previous (crashed or recycled) process is
+    overwritten, never appended to or trusted."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(
+        directory, f".{os.path.basename(path)}.{os.getpid()}.tmp"
+    )
+    with open(tmp, "w") as f:
+        f.write(f"{host}:{port}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_port_file(path: str) -> Tuple[str, int]:
+    """Parse a :func:`write_port_file` address back; raises ``ValueError``
+    on a malformed (e.g. mid-boot empty) file so pollers can retry."""
+    text = open(path).read().strip()
+    host, _, port = text.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"malformed telemetry port file {path!r}: {text!r}")
+    return host, int(port)
 
 
 def pressure_floor() -> int:
@@ -701,6 +739,20 @@ class TelemetryServer:
             daemon=True, kwargs={"poll_interval": 0.1},
         )
         self._thread.start()
+        # ephemeral-port discovery (ISSUE 13): publish the BOUND address
+        # the moment it exists.  A write failure warns and keeps serving —
+        # discovery is for the parent; the endpoint itself is up.
+        port_file = env_port_file()
+        if port_file:
+            try:
+                write_port_file(port_file, self._host, self.port)
+            except OSError as exc:
+                import warnings
+
+                warnings.warn(
+                    f"could not publish telemetry address to "
+                    f"{port_file!r}: {exc}", RuntimeWarning, stacklevel=2,
+                )
         return self
 
     def stop(self, timeout: float = 5.0) -> None:

@@ -21,9 +21,20 @@ into full fused dispatches —
   in-flight requests finish on the old version and a corrupt deploy
   leaves the old version serving.
 
+Horizontal scale-out (ISSUE 13): :class:`~flink_ml_tpu.serving.router.
+ReplicaRouter` fans the same ``submit() -> Future`` contract across N
+``ModelServer`` replica subprocesses — health-aware power-of-two-choices
+balancing off each replica's ``/readyz`` + ``/metrics``, reason-code
+retry classification (:func:`~flink_ml_tpu.serving.errors.shed_policy`),
+drain-aware zero-downtime rolling deploys, and crash supervision with
+respawn (:mod:`flink_ml_tpu.serving.replica` owns the subprocess
+lifecycle and wire protocol).
+
 Entry points: ``bench_all.py serving`` (the >=3x dynamic-batching gate),
-``python scripts/chaos_smoke.py --serving`` (shed / hot-swap / corrupt-
-deploy legs), ``examples/online_serving.py``.
+``bench_all.py router`` (the <=1.25x router-overhead gate),
+``python scripts/chaos_smoke.py --serving`` / ``--router`` (shed /
+hot-swap / corrupt-deploy / replica-kill legs),
+``examples/online_serving.py``, ``examples/router_serving.py``.
 """
 
 from flink_ml_tpu.serving.admission import ServingConfig  # noqa: F401
@@ -34,6 +45,18 @@ from flink_ml_tpu.serving.batcher import (  # noqa: F401
 from flink_ml_tpu.serving.errors import (  # noqa: F401
     ServerClosedError,
     ServerOverloadedError,
+    shed_policy,
+)
+from flink_ml_tpu.serving.replica import (  # noqa: F401
+    ReplicaClient,
+    ReplicaProcess,
+    ReplicaRemoteError,
+    ReplicaUnreachableError,
+)
+from flink_ml_tpu.serving.router import (  # noqa: F401
+    ReplicaRouter,
+    RollingDeployError,
+    RouterConfig,
 )
 from flink_ml_tpu.serving.server import ModelServer  # noqa: F401
 from flink_ml_tpu.serving.versioning import (  # noqa: F401
@@ -44,10 +67,18 @@ from flink_ml_tpu.serving.versioning import (  # noqa: F401
 __all__ = [
     "ModelServer",
     "ModelVersion",
+    "ReplicaClient",
+    "ReplicaProcess",
+    "ReplicaRemoteError",
+    "ReplicaRouter",
+    "ReplicaUnreachableError",
+    "RollingDeployError",
+    "RouterConfig",
     "ServeRequest",
     "ServeResult",
     "ServerClosedError",
     "ServerOverloadedError",
     "ServingConfig",
     "VersionManager",
+    "shed_policy",
 ]

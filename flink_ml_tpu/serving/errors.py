@@ -8,13 +8,18 @@ without dragging the server (or jax) along.
 from __future__ import annotations
 
 __all__ = [
+    "POLICY_FAIL",
+    "POLICY_RETRY",
+    "POLICY_ROUTE_AWAY",
     "SHED_BREAKER_OPEN",
     "SHED_DEADLINE",
     "SHED_MEMORY_PRESSURE",
+    "SHED_NO_REPLICA",
     "SHED_QUEUE_FULL",
     "SHED_SHUTDOWN",
     "ServerClosedError",
     "ServerOverloadedError",
+    "shed_policy",
 ]
 
 #: reason codes (the shed vocabulary — mirrored in ``serving.shed.<reason>``
@@ -27,6 +32,46 @@ SHED_SHUTDOWN = "shutdown"
 #: (ISSUE 9): admission refuses work the device memory budget cannot hold
 #: rather than queueing it onto an allocator already under pressure
 SHED_MEMORY_PRESSURE = "memory_pressure"
+#: the replica router found no routable replica for a request (ISSUE 13):
+#: every replica is dead, draining, or reason-coded unready — the
+#: scale-out analog of ``shutdown``, and like it, terminal for the caller
+SHED_NO_REPLICA = "no_replica"
+
+
+# -- shed-reason retryability (ISSUE 13) --------------------------------------
+#
+# The replica router classifies a shed RESPONSE by its reason code instead
+# of string-matching messages: a shed request was, by contract, never
+# served, so the question is only whether ANOTHER replica could plausibly
+# serve it — and whether the shedding replica should keep taking traffic.
+
+#: another replica can plausibly serve this request right now: the reason
+#: describes ONE replica's transient load (its queue, its memory budget,
+#: its backlog aging requests past deadline), not the request itself
+POLICY_RETRY = "retry_elsewhere"
+#: the shedding replica is degraded as a WHOLE (shutting down, breaker
+#: open): stop routing to it, and retry the request on another replica
+POLICY_ROUTE_AWAY = "route_away"
+#: unknown or terminal reason: hand the shed to the caller unchanged —
+#: retrying what we do not understand turns one error into N
+POLICY_FAIL = "fail"
+
+_SHED_POLICIES = {
+    SHED_QUEUE_FULL: POLICY_RETRY,
+    SHED_MEMORY_PRESSURE: POLICY_RETRY,
+    SHED_DEADLINE: POLICY_RETRY,
+    SHED_SHUTDOWN: POLICY_ROUTE_AWAY,
+    SHED_BREAKER_OPEN: POLICY_ROUTE_AWAY,
+}
+
+
+def shed_policy(reason: str) -> str:
+    """The router-facing classification of one shed reason code:
+    ``POLICY_RETRY`` (retry on another replica), ``POLICY_ROUTE_AWAY``
+    (eject the replica from rotation AND retry elsewhere), or
+    ``POLICY_FAIL`` (shed to the caller).  Unknown reasons fail —
+    the conservative default for a vocabulary that may grow."""
+    return _SHED_POLICIES.get(reason, POLICY_FAIL)
 
 
 class ServerOverloadedError(RuntimeError):
@@ -52,6 +97,14 @@ class ServerOverloadedError(RuntimeError):
         )
         self.reason = reason
         self.trace_id = trace_id
+
+    @property
+    def retryable(self) -> bool:
+        """Could ANOTHER server plausibly serve this request (ISSUE 13)?
+        True for every reason :func:`shed_policy` maps to retry or
+        route-away — a shed request was never served, so retrying it
+        elsewhere is safe whenever the reason is understood."""
+        return shed_policy(self.reason) != POLICY_FAIL
 
 
 class ServerClosedError(RuntimeError):

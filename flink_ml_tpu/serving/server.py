@@ -412,6 +412,18 @@ class ModelServer:
         TelemetryServer` (None when telemetry is off or failed to bind)."""
         return self._telemetry
 
+    @property
+    def telemetry_address(self) -> Optional[str]:
+        """The BOUND ``host:port`` of this server's telemetry endpoint
+        (None when telemetry is off or failed to bind) — with an
+        ephemeral ``telemetry_port=0`` this is where the listener
+        actually landed, the address ``FMT_TELEMETRY_PORT_FILE``
+        publishes for out-of-process discovery (ISSUE 13)."""
+        t = self._telemetry
+        if t is None or t.port is None:
+            return None
+        return f"{t.host}:{t.port}"
+
     def _start_telemetry(self, port: int) -> None:
         """Bring up the /metrics endpoint + SLO monitor and plug this
         server's readiness/status into them.  A bind failure warns and

@@ -93,6 +93,8 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Telemetry HTTP port: unset=off, 0=ephemeral, N=fixed port."),
     Knob("FMT_TELEMETRY_HOST", "127.0.0.1", "str",
          "Bind host for the telemetry HTTP endpoint (loopback by default)."),
+    Knob("FMT_TELEMETRY_PORT_FILE", "", "str",
+         "File that atomically receives host:port when the endpoint binds."),
     Knob("FMT_READY_PRESSURE_FLOOR", "8", "int",
          "/readyz degrades when a pressure cap pins below this row count."),
     Knob("FMT_READY_QUEUE_FRAC", "0.95", "float",
@@ -164,6 +166,21 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Default per-request serving deadline in ms (0 = none)."),
     Knob("FMT_SERVING_SHED_ON_BREAKER", "1", "bool",
          "Refuse requests at the door while a circuit breaker is open."),
+    # -- replica router ---------------------------------------------------
+    Knob("FMT_ROUTER_REPLICAS", "2", "int",
+         "Replica processes a ReplicaRouter spawns by default."),
+    Knob("FMT_ROUTER_POLL_MS", "50", "float",
+         "Router health-poll interval (readyz + metrics scrape) in ms."),
+    Knob("FMT_ROUTER_QUEUE_CAP", "4096", "int",
+         "Max queued rows at the router door before admission sheds."),
+    Knob("FMT_ROUTER_DISPATCH_THREADS", "8", "int",
+         "Concurrent router->replica dispatches (the forwarding pool)."),
+    Knob("FMT_ROUTER_RETRIES", "2", "int",
+         "Cross-replica retries per request before the caller sees the error."),
+    Knob("FMT_ROUTER_SPAWN_TIMEOUT_S", "120", "float",
+         "Seconds a replica subprocess gets to bind its endpoints at boot."),
+    Knob("FMT_ROUTER_DRAIN_TIMEOUT_S", "30", "float",
+         "Seconds a rolling deploy waits for one replica's in-flight work."),
     # -- device data plane ------------------------------------------------
     Knob("FMT_FUSE_TRANSFORM", "1", "bool",
          "Fuse kernel-capable pipeline stages into one dispatch per batch."),

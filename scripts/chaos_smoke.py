@@ -123,6 +123,29 @@ the full drift-detection loop under an injected distribution shift:
   4. **CLI** — ``python -m flink_ml_tpu.obs drift`` renders the
      per-column comparison from the shutdown serving report.
 
+**Router mode** (``--router``, ISSUE 13): the horizontal-scale-out
+counterpart — a 3-replica ``ReplicaRouter`` fleet under sustained
+concurrent load:
+
+  1. **replica kill** — ``kill -9`` of one replica mid-traffic must
+     complete with ZERO failed client requests (in-flight requests
+     retry on the survivors, counted in ``router.retries``), the death
+     must be detected and a replacement respawned
+     (``router.replica_deaths`` / ``router.respawns``), and the fleet
+     must return to 3 ready replicas;
+  2. **rolling deploy under load** — ``router.deploy(v2)`` must drain
+     and swap one replica at a time with ZERO failed requests and zero
+     router sheds, results spanning both versions with per-version
+     solo-transform parity, and every replica finishing on v2;
+  3. **corrupt deploy** — a bit-flipped artifact must stop the roll at
+     the first replica with ``RollingDeployError`` (the replica-side
+     swap contract rolled it back), partial per-replica status
+     preserved at ``router.deploy_status``, and the whole fleet still
+     serving the old version;
+
+plus the ``ReplicaRouter`` RunReport from shutdown carrying the
+death/respawn/deploy accounting and request-latency quantiles.
+
 **Trace mode** (``--trace``, ISSUE 8): the observability counterpart —
 end-to-end request tracing plus the black-box flight recorder:
 
@@ -669,6 +692,170 @@ def serving_main() -> int:
     print(f"  RunReports: {len(serving_reports)} serving report(s), "
           f"swap + p99 recorded")
     print("serving chaos smoke OK")
+    return 0
+
+
+def router_main() -> int:
+    """The replica-router chaos matrix (``--router``, ISSUE 13)."""
+    import glob
+    import threading
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_router_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.serving import ReplicaRouter, RollingDeployError
+
+    table = dense_table()
+
+    def fit(max_iter):
+        return Pipeline([
+            StandardScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(0.5).set_max_iter(max_iter),
+        ]).fit(table)
+
+    m1, m2 = fit(3), fit(5)
+    root = tempfile.mkdtemp(prefix="chaos_router_models_")
+    v1_dir, v2_dir = os.path.join(root, "v1"), os.path.join(root, "v2")
+    m1.save(v1_dir)
+    m2.save(v2_dir)
+    solo = {}
+    for version, model in (("v1", m1), ("v2", m2)):
+        (out,) = model.transform(table)
+        solo[version] = np.asarray(out.col("p"))
+
+    n_replicas = 3
+    router = ReplicaRouter(v1_dir, version="v1", replicas=n_replicas,
+                           poll_ms=30)
+    assert router.ready_count() == n_replicas, router.replicas
+    print(f"  fleet: {n_replicas} replicas up "
+          f"(pids {[r['pid'] for r in router.replicas]})")
+
+    failures, results = [], []
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            lo = (i * 4) % (N - 4)
+            try:
+                res = router.predict(table.slice_rows(lo, lo + 4),
+                                     timeout=120)
+                results.append((lo, res))
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                failures.append(exc)
+            i += 1
+            time.sleep(0.002)  # sustained, not saturating: probes and
+            #                    the respawned child need cycles too
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    while len(results) < 10:
+        time.sleep(0.005)
+
+    # -- leg 1: kill -9 one replica under load -> zero failed requests -------
+    victim = router.replicas[0]["pid"]
+    os.kill(victim, signal.SIGKILL)
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        stats = router.stats()
+        if (stats.get("router.respawns", 0) >= 1
+                and router.ready_count() >= n_replicas):
+            break
+        time.sleep(0.1)
+    stats = router.stats()
+    assert stats.get("router.replica_deaths", 0) >= 1, stats
+    assert stats.get("router.respawns", 0) >= 1, stats
+    assert router.ready_count() == n_replicas, router.replicas
+    assert not failures, (
+        f"{len(failures)} requests failed across the kill: "
+        f"{failures[0]!r}"
+    )
+    served_before_deploy = len(results)
+    print(f"  kill -9 pid {victim}: {served_before_deploy} requests "
+          f"served, zero failures, fleet back to {n_replicas} ready "
+          f"(retries={stats.get('router.retries', 0):g}, "
+          f"respawns={stats.get('router.respawns'):g})")
+
+    # -- leg 2: rolling deploy under load -> zero failures, all on v2 --------
+    sheds_before = router.stats().get("router.shed", 0)
+    status = router.deploy(v2_dir, "v2")
+    time.sleep(0.3)  # post-deploy traffic lands on v2
+    stop.set()
+    loader.join(60)
+    assert not failures, (
+        f"{len(failures)} requests failed across the rolling deploy: "
+        f"{failures[0]!r}"
+    )
+    assert status["ok"] is True, status
+    live = [r for r in status["replicas"] if r["outcome"] == "deployed"]
+    assert len(live) == n_replicas, status
+    assert all(r["active_version"] == "v2" for r in live), status
+    assert router.stats().get("router.shed", 0) == sheds_before, (
+        "the rolling deploy shed traffic"
+    )
+    versions = {res.version for _lo, res in results}
+    assert versions == {"v1", "v2"}, versions
+    for lo, res in results:
+        np.testing.assert_array_equal(
+            np.asarray(res.table.col("p")), solo[res.version][lo:lo + 4],
+            err_msg=f"rows {lo}..{lo + 4} diverge from solo {res.version}",
+        )
+    print(f"  rolling deploy: {len(results)} requests across "
+          f"{sorted(versions)}, zero failures, zero sheds, "
+          f"{len(live)}/{n_replicas} replicas on v2, per-version "
+          "parity exact")
+
+    # -- leg 3: corrupt deploy -> partial status, fleet keeps serving --------
+    bad_dir = os.path.join(root, "bad")
+    m2.save(bad_dir)
+    mdf = glob.glob(os.path.join(bad_dir, "stage_*",
+                                 "model_data.jsonl"))[0]
+    blob = bytearray(open(mdf, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(mdf, "wb") as f:
+        f.write(bytes(blob))
+    try:
+        router.deploy(bad_dir, "v3")
+        raise AssertionError("corrupt rolling deploy was accepted")
+    except RollingDeployError as exc:
+        partial = exc.status
+    assert partial["ok"] is False, partial
+    assert partial["replicas"][0]["outcome"] == "failed", partial
+    assert partial["replicas"][0]["error"] == "ModelIntegrityError", partial
+    assert router.deploy_status == partial
+    assert router.active_version == "v2"
+    res = router.predict(table.slice_rows(0, 8), timeout=120)
+    assert res.version == "v2", res.version
+    np.testing.assert_array_equal(np.asarray(res.table.col("p")),
+                                  solo["v2"][:8])
+    print("  corrupt deploy: RollingDeployError at replica 1/3 "
+          "(ModelIntegrityError), partial status reported, fleet kept "
+          "serving v2")
+
+    # -- the ReplicaRouter RunReport from shutdown ---------------------------
+    router.shutdown()
+    from flink_ml_tpu.obs.report import load_reports
+
+    reports = [r for r in load_reports(reports_dir)
+               if r.get("kind") == "serving"
+               and r.get("name") == "ReplicaRouter"]
+    assert reports, "no ReplicaRouter RunReport written at shutdown"
+    extra = reports[-1]["extra"]
+    assert extra.get("router.replica_deaths", 0) >= 1, extra
+    assert extra.get("router.respawns", 0) >= 1, extra
+    assert extra.get("router.rolling_deploys", 0) == 2, extra
+    assert extra.get("latency_p99_ms", 0) > 0, extra
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("router.rolling_deploys", 0) == 2, c
+    print(f"  RunReport: deaths/respawns/deploys recorded, p99 "
+          f"{extra['latency_p99_ms']:.1f} ms")
+    print("router chaos smoke OK")
     return 0
 
 
@@ -1281,6 +1468,8 @@ def main() -> int:
         return serve_main()
     if "--serving" in sys.argv:
         return serving_main()
+    if "--router" in sys.argv:
+        return router_main()
     if "--trace" in sys.argv:
         return trace_main()
     if "--pressure" in sys.argv:

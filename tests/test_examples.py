@@ -72,6 +72,26 @@ class TestOnlineServingExample:
         assert re.search(r"p99 [\d.]+ ms", out)
 
 
+class TestRouterServingExample:
+    def test_fleet_deploy_and_kill_without_failures(self):
+        from examples import router_serving
+
+        out = run_main(router_serving, ["--requests", "45", "--threads", "4"])
+        m = re.search(r"fleet up: 3/3 replicas ready", out)
+        assert m, out[:400]
+        m = re.search(r"served (\d+) requests \((\d+) rows\)", out)
+        assert m and int(m.group(1)) == 45, out
+        m = re.search(
+            r"rolling deploy: 3/3 replicas on v2; versions served: "
+            r"\['v1', 'v2'\]; failed requests: (\d+)", out)
+        assert m, out
+        assert int(m.group(1)) == 0  # deploy + kill drop nothing
+        m = re.search(r"fleet back to 3/3 ready \(deaths: 1, "
+                      r"respawns: 1", out)
+        assert m, out
+        assert re.search(r"p99 [\d.]+ ms", out)
+
+
 class TestOutOfCoreExample:
     def test_streams_part_files_and_recovers_direction(self):
         from examples import out_of_core_training

@@ -1,0 +1,699 @@
+"""Replica router (ISSUE 13) — scale-out front-end over the telemetry
+plane: shed-reason classification, health-aware power-of-two-choices
+balancing, drain-aware rolling deploys, crash supervision — plus the
+PR's satellites (ephemeral telemetry-port discovery via
+``FMT_TELEMETRY_PORT_FILE`` / ``ModelServer.telemetry_address``, the
+wire table codec's bit-identity).
+
+Two tiers: routing POLICY is tested against in-process fakes speaking
+the ``ReplicaClient`` protocol (scripted sheds, real ``ModelServer``
+backends — fast, deterministic), and the subprocess SUBSTRATE (spawn,
+handshake, wire parity, SIGKILL -> respawn) against real replica
+children.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.pipeline import Pipeline
+from flink_ml_tpu.lib import LogisticRegression
+from flink_ml_tpu.lib.feature import StandardScaler
+from flink_ml_tpu.obs import telemetry
+from flink_ml_tpu.serving import (
+    ModelServer,
+    ReplicaClient,
+    ReplicaProcess,
+    ReplicaRemoteError,
+    ReplicaRouter,
+    ReplicaUnreachableError,
+    RollingDeployError,
+    RouterConfig,
+    ServerClosedError,
+    ServerOverloadedError,
+    shed_policy,
+)
+from flink_ml_tpu.serving.batcher import ServeResult
+from flink_ml_tpu.serving.errors import (
+    POLICY_FAIL,
+    POLICY_RETRY,
+    POLICY_ROUTE_AWAY,
+)
+from flink_ml_tpu.serving.replica import decode_table, encode_table
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+N, D = 256, 5
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+WAIT = 60  # generous future timeout: a hang fails loudly, not flakily
+
+
+@pytest.fixture(scope="module")
+def dense_table():
+    rng = np.random.RandomState(11)
+    X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+    w = rng.randn(D).astype(np.float32)
+    y = ((X - 1.0) @ w > 0).astype(np.float64)
+    return Table.from_columns(SCHEMA, {"features": X, "label": y})
+
+
+def _fit(table, max_iter):
+    return Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_learning_rate(0.5).set_max_iter(max_iter),
+    ]).fit(table)
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory, dense_table):
+    """Two fitted+saved pipeline versions plus their solo predictions —
+    the parity oracle every routed request is judged against."""
+    root = tmp_path_factory.mktemp("router_models")
+    m1, m2 = _fit(dense_table, 3), _fit(dense_table, 5)
+    paths = {"v1": str(root / "v1"), "v2": str(root / "v2")}
+    m1.save(paths["v1"])
+    m2.save(paths["v2"])
+    solo = {}
+    for version, m in (("v1", m1), ("v2", m2)):
+        (out,) = m.transform(dense_table)
+        solo[version] = np.asarray(out.col("pred"))
+    return {"paths": paths, "models": {"v1": m1, "v2": m2}, "solo": solo}
+
+
+# -- shed-reason retryability (satellite) -------------------------------------
+
+
+class TestShedPolicy:
+    def test_transient_load_reasons_retry_elsewhere(self):
+        for reason in ("queue_full", "memory_pressure", "deadline_expired"):
+            assert shed_policy(reason) == POLICY_RETRY, reason
+            assert ServerOverloadedError(reason).retryable is True
+
+    def test_replica_degradation_routes_away(self):
+        for reason in ("shutdown", "breaker_open"):
+            assert shed_policy(reason) == POLICY_ROUTE_AWAY, reason
+            assert ServerOverloadedError(reason).retryable is True
+
+    def test_unknown_reasons_fail_conservatively(self):
+        for reason in ("no_replica", "some_future_reason", ""):
+            assert shed_policy(reason) == POLICY_FAIL, reason
+            assert ServerOverloadedError(reason).retryable is False
+
+
+# -- router config ------------------------------------------------------------
+
+
+class TestRouterConfig:
+    def test_env_defaults(self):
+        cfg = RouterConfig.from_env()
+        assert cfg.replicas == 2
+        assert cfg.queue_cap == 4096
+        assert cfg.retries == 2
+
+    def test_overrides_win(self, monkeypatch):
+        monkeypatch.setenv("FMT_ROUTER_REPLICAS", "7")
+        assert RouterConfig.from_env().replicas == 7
+        assert RouterConfig.from_env(replicas=3).replicas == 3
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RouterConfig.from_env(replicas=0)
+
+
+# -- telemetry port discovery (satellite) -------------------------------------
+
+
+class TestPortFile:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "addr")
+        telemetry.write_port_file(path, "127.0.0.1", 12345)
+        assert telemetry.read_port_file(path) == ("127.0.0.1", 12345)
+
+    def test_stale_file_is_overwritten(self, tmp_path):
+        """A file left by a previous (crashed, recycled) process must be
+        REPLACED on bind — a reader can never see the stale address, a
+        partial write, or a concatenation of the two."""
+        path = str(tmp_path / "addr")
+        with open(path, "w") as f:
+            f.write("127.0.0.1:9\n")  # a previous run's port
+        telemetry.write_port_file(path, "127.0.0.1", 54321)
+        assert telemetry.read_port_file(path) == ("127.0.0.1", 54321)
+        assert open(path).read() == "127.0.0.1:54321\n"
+
+    def test_malformed_file_raises_for_retry(self, tmp_path):
+        path = str(tmp_path / "addr")
+        with open(path, "w") as f:
+            f.write("garbage")
+        with pytest.raises(ValueError):
+            telemetry.read_port_file(path)
+
+    def test_telemetry_server_publishes_on_bind(self, tmp_path,
+                                                monkeypatch):
+        """The ephemeral-port discovery fix: with ``FMT_TELEMETRY_PORT=0``
+        the bound port was only observable in-process — the knob file is
+        how a parent finds its child's endpoint."""
+        path = str(tmp_path / "addr")
+        monkeypatch.setenv("FMT_TELEMETRY_PORT_FILE", path)
+        server = telemetry.TelemetryServer(port=0).start()
+        try:
+            host, port = telemetry.read_port_file(path)
+            assert (host, port) == (server.host, server.port)
+        finally:
+            server.stop()
+
+    def test_model_server_telemetry_address(self, tmp_path, monkeypatch,
+                                            dense_table, saved):
+        path = str(tmp_path / "addr")
+        monkeypatch.setenv("FMT_TELEMETRY_PORT_FILE", path)
+        server = ModelServer(saved["models"]["v1"], telemetry_port=0)
+        try:
+            address = server.telemetry_address
+            assert address is not None
+            host, port = telemetry.read_port_file(path)
+            assert address == f"{host}:{port}"
+        finally:
+            server.shutdown()
+        assert server.telemetry_address is None
+
+
+# -- the wire table codec -----------------------------------------------------
+
+
+class TestWireTables:
+    def test_round_trip_is_bit_identical(self, dense_table):
+        wire = encode_table(dense_table)
+        back = decode_table(wire)
+        assert back.schema.field_names == dense_table.schema.field_names
+        assert back.schema.field_types == dense_table.schema.field_types
+        for name in dense_table.schema.field_names:
+            np.testing.assert_array_equal(
+                np.asarray(back.col(name)),
+                np.asarray(dense_table.col(name)), err_msg=name)
+
+    def test_encode_strips_process_local_state(self, dense_table):
+        names, types, cols = encode_table(dense_table)
+        assert set(cols) == set(names)
+        # the wire tuple carries only schema lists + column buffers — a
+        # pack cache (which may pin device arrays) must never ride along
+        assert all(not hasattr(v, "_pack_cache") for v in cols.values())
+
+
+# -- routing policy against scripted fakes ------------------------------------
+
+
+class _FakeClient:
+    """Scripted ReplicaClient: ``script`` entries are consumed per
+    submit — an exception instance raises, anything else echoes the
+    request back as a served result."""
+
+    def __init__(self, name, script=(), queue_depth=0.0):
+        self.name = name
+        self.script = list(script)
+        self.queue_depth = queue_depth
+        self.submits = 0
+        self.deploys = []
+
+    def submit(self, table, deadline_ms=None, timeout_s=120.0):
+        self.submits += 1
+        if self.script:
+            step = self.script.pop(0)
+            if isinstance(step, BaseException):
+                raise step
+        return ServeResult(table=table, quarantine={}, version="v1")
+
+    def deploy(self, path, version, timeout_s=600.0):
+        self.deploys.append((path, version))
+        return version
+
+    def probe(self, timeout_s=2.0, depth=True):
+        out = {"ready": True, "reasons": []}
+        if depth:
+            out["queue_depth"] = self.queue_depth
+        return out
+
+
+def _fake_router(clients, **kw):
+    table = {f"replica-{i}-g{i + 1}": c for i, c in enumerate(clients)}
+
+    def factory(name, path, version):
+        return table[name], None
+
+    # park the poll loop out of the way: policy tests script the replica
+    # responses and must not race a probe re-admitting a shed replica
+    # (shutdown still returns immediately — the stop event interrupts
+    # the wait)
+    kw.setdefault("poll_ms", 600_000.0)
+    return ReplicaRouter("/nonexistent", replicas=len(clients),
+                         replica_factory=factory, **kw)
+
+
+class TestRoutingPolicy:
+    def test_served_request_resolves(self, dense_table):
+        a, b = _FakeClient("a"), _FakeClient("b")
+        router = _fake_router([a, b])
+        try:
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            assert res.num_rows == 4
+            assert a.submits + b.submits == 1
+        finally:
+            router.shutdown()
+
+    def test_transient_shed_retries_on_another_replica(self, dense_table):
+        a = _FakeClient("a", script=[ServerOverloadedError("queue_full")])
+        b = _FakeClient("b", script=[ServerOverloadedError("queue_full")])
+        router = _fake_router([a, b])
+        try:
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            assert res.num_rows == 4
+            # whichever replica shed first, the OTHER was tried next —
+            # and its own first shed retried back (budget is 2)
+            assert a.submits + b.submits >= 2
+            assert router.stats().get("router.retries", 0) >= 1
+        finally:
+            router.shutdown()
+
+    def test_route_away_ejects_the_replica_from_rotation(self, dense_table):
+        a = _FakeClient("a", script=[
+            ServerOverloadedError("breaker_open")] * 50)
+        b = _FakeClient("b")
+        router = _fake_router([a, b])
+        try:
+            for i in range(10):
+                router.predict(dense_table.slice_rows(i, i + 1),
+                               timeout=WAIT)
+            # after a's first breaker_open shed it left the rotation (no
+            # probe clears it: the poll interval is parked at 1s): every
+            # later request went straight to b
+            assert a.submits == 1
+            assert b.submits == 10
+            snapshot = {r["name"]: r for r in router.replicas}
+            bad = [r for r in snapshot.values()
+                   if r["reasons"] == ["breaker_open"]]
+            assert len(bad) == 1
+        finally:
+            router.shutdown()
+
+    def test_unknown_shed_reason_reaches_the_caller(self, dense_table):
+        a = _FakeClient("a", script=[
+            ServerOverloadedError("mystery_reason")] * 5)
+        b = _FakeClient("b", script=[
+            ServerOverloadedError("mystery_reason")] * 5)
+        router = _fake_router([a, b])
+        try:
+            with pytest.raises(ServerOverloadedError) as excinfo:
+                router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            assert excinfo.value.reason == "mystery_reason"
+            assert excinfo.value.retryable is False
+            assert a.submits + b.submits == 1  # no blind retry
+        finally:
+            router.shutdown()
+
+    def test_remote_error_propagates_without_cross_replica_retry(
+            self, dense_table):
+        a = _FakeClient("a", script=[
+            ReplicaRemoteError("ValueError", "bad rows")] * 5)
+        b = _FakeClient("b", script=[
+            ReplicaRemoteError("ValueError", "bad rows")] * 5)
+        router = _fake_router([a, b])
+        try:
+            with pytest.raises(ReplicaRemoteError) as excinfo:
+                router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            assert excinfo.value.remote_type == "ValueError"
+            assert a.submits + b.submits == 1  # deterministic: no retry
+        finally:
+            router.shutdown()
+
+    def test_unreachable_replica_retries_elsewhere(self, dense_table):
+        a = _FakeClient("a", script=[
+            ReplicaUnreachableError("conn refused")] * 50)
+        b = _FakeClient("b")
+        router = _fake_router([a, b])
+        try:
+            for i in range(6):
+                res = router.predict(dense_table.slice_rows(i, i + 1),
+                                     timeout=WAIT)
+                assert res.num_rows == 1
+        finally:
+            router.shutdown()
+
+    def test_power_of_two_choices_prefers_the_lighter_replica(
+            self, dense_table):
+        """With exactly two candidates P2C samples both every time, so
+        the lower-load replica must win EVERY pick."""
+        heavy = _FakeClient("a", queue_depth=1000.0)
+        light = _FakeClient("b", queue_depth=0.0)
+        router = _fake_router([heavy, light], poll_ms=10.0)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:  # probes import the depths
+                snap = {r["name"]: r["queue_depth"]
+                        for r in router.replicas}
+                if snap.get("replica-0-g1") == 1000.0:
+                    break
+                time.sleep(0.01)
+            for i in range(12):
+                router.predict(dense_table.slice_rows(i, i + 1),
+                               timeout=WAIT)
+            assert light.submits >= 12
+            assert heavy.submits == 0
+        finally:
+            router.shutdown()
+
+    def test_queue_cap_sheds_at_the_door(self, dense_table):
+        router = _fake_router([_FakeClient("a")], queue_cap=8,
+                              dispatch_threads=1, start=False)
+        try:
+            router.submit(dense_table.slice_rows(0, 8))  # fills the cap
+            with pytest.raises(ServerOverloadedError) as excinfo:
+                router.submit(dense_table.slice_rows(8, 16))
+            assert excinfo.value.reason == "queue_full"
+        finally:
+            router.shutdown()
+
+    def test_submit_after_shutdown_raises_closed(self, dense_table):
+        router = _fake_router([_FakeClient("a")])
+        router.shutdown()
+        with pytest.raises(ServerClosedError):
+            router.submit(dense_table.slice_rows(0, 1))
+
+    def test_empty_request_rejected(self, dense_table):
+        router = _fake_router([_FakeClient("a")], start=False)
+        try:
+            with pytest.raises(ValueError):
+                router.submit(dense_table.slice_rows(0, 0))
+        finally:
+            router.shutdown()
+
+
+# -- rolling deploy over in-process ModelServer backends ----------------------
+
+
+class _LocalClient:
+    """The ReplicaClient protocol over an IN-PROCESS ModelServer — full
+    deploy/serve fidelity without subprocess cost.  ``gate`` (optional)
+    blocks deploys so drain interleavings can be scripted."""
+
+    def __init__(self, server, gate=None):
+        self.server = server
+        self.gate = gate
+        self.submits = 0
+        self.deploy_started = threading.Event()
+
+    def submit(self, table, deadline_ms=None, timeout_s=120.0):
+        self.submits += 1
+        return self.server.predict(table, deadline_ms=deadline_ms,
+                                   timeout=timeout_s)
+
+    def deploy(self, path, version, timeout_s=600.0):
+        self.deploy_started.set()
+        if self.gate is not None:
+            assert self.gate.wait(WAIT)
+        self.server.deploy(path, version)
+        return self.server.active_version
+
+    def probe(self, timeout_s=2.0, depth=True):
+        return {"ready": True, "reasons": [], "queue_depth": 0.0}
+
+
+def _local_router(saved, n=2, gates=None, **kw):
+    servers = [ModelServer(path=saved["paths"]["v1"], version="v1")
+               for _ in range(n)]
+    clients = [_LocalClient(s, gate=(gates or {}).get(i))
+               for i, s in enumerate(servers)]
+    table = {f"replica-{i}-g{i + 1}": c for i, c in enumerate(clients)}
+
+    def factory(name, path, version):
+        return table[name], None
+
+    kw.setdefault("poll_ms", 600_000.0)
+    router = ReplicaRouter(saved["paths"]["v1"], version="v1", replicas=n,
+                           replica_factory=factory, **kw)
+    return router, servers, clients
+
+
+class TestRollingDeploy:
+    def test_outputs_bit_identical_across_the_version_boundary(
+            self, dense_table, saved):
+        router, servers, clients = _local_router(saved)
+        try:
+            for i in range(4):
+                res = router.predict(dense_table.slice_rows(i * 8,
+                                                            i * 8 + 8),
+                                     timeout=WAIT)
+                assert res.version == "v1"
+                np.testing.assert_array_equal(
+                    np.asarray(res.table.col("pred")),
+                    saved["solo"]["v1"][i * 8:i * 8 + 8])
+            status = router.deploy(saved["paths"]["v2"], "v2")
+            assert status["ok"] is True
+            assert [r["outcome"] for r in status["replicas"]] == \
+                ["deployed", "deployed"]
+            assert router.active_version == "v2"
+            assert all(s.active_version == "v2" for s in servers)
+            for i in range(4):
+                res = router.predict(dense_table.slice_rows(i * 8,
+                                                            i * 8 + 8),
+                                     timeout=WAIT)
+                assert res.version == "v2"
+                np.testing.assert_array_equal(
+                    np.asarray(res.table.col("pred")),
+                    saved["solo"]["v2"][i * 8:i * 8 + 8])
+        finally:
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_draining_replica_takes_no_new_requests(self, dense_table,
+                                                    saved):
+        """The drain contract: while replica 0 swaps, every new request
+        routes to the rest of the fleet — a deploy sheds nothing."""
+        gate = threading.Event()
+        router, servers, clients = _local_router(saved, gates={0: gate})
+        try:
+            submits_before = clients[0].submits
+            deployer = threading.Thread(
+                target=router.deploy,
+                args=(saved["paths"]["v2"], "v2"), daemon=True)
+            deployer.start()
+            assert clients[0].deploy_started.wait(WAIT)
+            # replica 0 is mid-deploy (drained, gated): traffic flows,
+            # all of it on replica 1
+            for i in range(8):
+                res = router.predict(dense_table.slice_rows(i, i + 4),
+                                     timeout=WAIT)
+                assert res.num_rows == 4
+            assert clients[0].submits == submits_before
+            assert clients[1].submits >= 8
+            gate.set()
+            deployer.join(WAIT)
+            assert not deployer.is_alive()
+            assert router.deploy_status["ok"] is True
+        finally:
+            gate.set()
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_drain_waits_for_in_flight_requests(self, dense_table, saved):
+        """Deploy must not reach a replica while a router-originated
+        request is still in flight on it."""
+        router, servers, clients = _local_router(saved, n=1)
+        release = threading.Event()
+        entered = threading.Event()
+        order = []
+        real_submit = clients[0].submit
+        real_deploy = clients[0].deploy
+
+        def slow_submit(table, **kw):
+            entered.set()
+            assert release.wait(WAIT)
+            order.append("submit_done")
+            return real_submit(table, **kw)
+
+        def tracked_deploy(path, version, **kw):
+            order.append("deploy")
+            return real_deploy(path, version, **kw)
+
+        clients[0].submit = slow_submit
+        clients[0].deploy = tracked_deploy
+        try:
+            fut = router.submit(dense_table.slice_rows(0, 4))
+            assert entered.wait(WAIT)  # request is in flight on replica 0
+            deployer = threading.Thread(
+                target=router.deploy,
+                args=(saved["paths"]["v2"], "v2"), daemon=True)
+            deployer.start()
+            time.sleep(0.2)  # the deploy is draining: no deploy() yet
+            assert order == []
+            release.set()
+            deployer.join(WAIT)
+            assert order == ["submit_done", "deploy"]
+            assert fut.result(WAIT).num_rows == 4
+        finally:
+            release.set()
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_corrupt_deploy_rolls_back_one_replica_and_stops(
+            self, dense_table, saved, tmp_path):
+        """The partial-deploy contract: a corrupt artifact fails on the
+        FIRST replica (which keeps serving its old version — the swap
+        contract is the rollback), the roll stops, the fleet stays on
+        the known-good version, and the router reports partial status."""
+        import glob
+
+        bad_dir = str(tmp_path / "bad")
+        saved["models"]["v2"].save(bad_dir)
+        mdf = glob.glob(os.path.join(bad_dir, "stage_*",
+                                     "model_data.jsonl"))[0]
+        blob = bytearray(open(mdf, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(mdf, "wb") as f:
+            f.write(bytes(blob))
+        router, servers, clients = _local_router(saved)
+        try:
+            with pytest.raises(RollingDeployError) as excinfo:
+                router.deploy(bad_dir, "v2")
+            status = excinfo.value.status
+            assert status["ok"] is False
+            outcomes = [r["outcome"] for r in status["replicas"]]
+            assert outcomes == ["failed"]  # the roll stopped at replica 0
+            assert status["replicas"][0]["error"] == "ModelIntegrityError"
+            assert router.deploy_status == status
+            # the fleet never left the known-good version
+            assert router.active_version == "v1"
+            assert all(s.active_version == "v1" for s in servers)
+            res = router.predict(dense_table.slice_rows(0, 8),
+                                 timeout=WAIT)
+            assert res.version == "v1"
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")),
+                saved["solo"]["v1"][:8])
+        finally:
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+
+# -- the real subprocess substrate --------------------------------------------
+
+
+class TestReplicaSubprocess:
+    def test_spawn_serve_deploy_stop(self, dense_table, saved, tmp_path):
+        """One child, whole lifecycle: handshake publishes both
+        endpoints, wire results are bit-identical to solo transforms,
+        probes answer off the telemetry plane, a wire deploy swaps
+        versions, a corrupt wire deploy raises the remote
+        ModelIntegrityError, SIGTERM stops it cleanly."""
+        import glob
+
+        process = ReplicaProcess.spawn(saved["paths"]["v1"], "v1")
+        try:
+            client = ReplicaClient(process.serve_address,
+                                   process.telemetry_address)
+            # handshake files carry the BOUND addresses
+            host, port = telemetry.read_port_file(
+                os.path.join(process.workdir, "telemetry.addr"))
+            assert f"{host}:{port}" == process.telemetry_address
+            res = client.submit(dense_table.slice_rows(0, 16))
+            assert res.version == "v1"
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")),
+                saved["solo"]["v1"][:16])
+            probe = client.probe()
+            assert probe["ready"] is True
+            assert client.deploy(saved["paths"]["v2"], "v2") == "v2"
+            res = client.submit(dense_table.slice_rows(0, 16))
+            assert res.version == "v2"
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")),
+                saved["solo"]["v2"][:16])
+            # a corrupt artifact is refused REMOTELY, old version serves
+            bad_dir = str(tmp_path / "bad_wire")
+            saved["models"]["v1"].save(bad_dir)
+            mdf = glob.glob(os.path.join(bad_dir, "stage_*",
+                                         "model_data.jsonl"))[0]
+            blob = bytearray(open(mdf, "rb").read())
+            blob[len(blob) // 2] ^= 0xFF
+            with open(mdf, "wb") as f:
+                f.write(bytes(blob))
+            with pytest.raises(ReplicaRemoteError) as excinfo:
+                client.deploy(bad_dir, "v3")
+            assert excinfo.value.remote_type == "ModelIntegrityError"
+            assert client.submit(dense_table.slice_rows(0, 4)
+                                 ).version == "v2"
+        finally:
+            process.stop()
+        assert not process.alive()
+        assert process.poll_dead() == 0  # SIGTERM -> drain -> exit 0
+
+
+class TestRouterLive:
+    def test_parity_kill_respawn(self, dense_table, saved):
+        """The chaos contract, in-suite: routed results are
+        bit-identical to solo transforms; a SIGKILLed replica's traffic
+        retries on the survivor with ZERO caller-visible failures and a
+        replacement rejoins the fleet."""
+        router = ReplicaRouter(saved["paths"]["v1"], version="v1",
+                               replicas=2, poll_ms=25.0)
+        try:
+            futures = [router.submit(dense_table.slice_rows(i * 8,
+                                                            i * 8 + 8))
+                       for i in range(8)]
+            for i, fut in enumerate(futures):
+                res = fut.result(WAIT)
+                assert res.version == "v1"
+                np.testing.assert_array_equal(
+                    np.asarray(res.table.col("pred")),
+                    saved["solo"]["v1"][i * 8:i * 8 + 8])
+            victim = router.replicas[0]["pid"]
+            fails = []
+            stop = threading.Event()
+
+            def load():
+                i = 0
+                while not stop.is_set():
+                    lo = (i * 4) % (N - 4)
+                    try:
+                        res = router.predict(
+                            dense_table.slice_rows(lo, lo + 4),
+                            timeout=WAIT)
+                        np.testing.assert_array_equal(
+                            np.asarray(res.table.col("pred")),
+                            saved["solo"]["v1"][lo:lo + 4])
+                    except BaseException as exc:  # noqa: BLE001
+                        fails.append(exc)
+                    i += 1
+                    time.sleep(0.002)
+
+            loader = threading.Thread(target=load, daemon=True)
+            loader.start()
+            time.sleep(0.2)
+            os.kill(victim, signal.SIGKILL)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                stats = router.stats()
+                if (stats.get("router.respawns", 0) >= 1
+                        and router.ready_count() >= 2):
+                    break
+                time.sleep(0.1)
+            stop.set()
+            loader.join(WAIT)
+            assert not fails, f"{len(fails)} requests failed: {fails[0]!r}"
+            stats = router.stats()
+            assert stats.get("router.replica_deaths", 0) >= 1
+            assert stats.get("router.respawns", 0) >= 1
+            assert router.ready_count() == 2
+            res = router.predict(dense_table.slice_rows(0, 8),
+                                 timeout=WAIT)
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")), saved["solo"]["v1"][:8])
+        finally:
+            router.shutdown()
