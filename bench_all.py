@@ -2191,6 +2191,202 @@ def bench_pressure(n_rows=100_000, n_features=16, batch=4096, sweeps=5):
     })
 
 
+def bench_online_loop(n_rows=16_384, n_features=16, n_requests=192,
+                      sweeps=5, max_batch=256, max_wait_ms=2.0):
+    """Controller-attached serving overhead (ISSUE 14).
+
+    The continuous-learning contract: a ``ContinuousLearningController``
+    attached to a live ``ModelServer`` — window hook armed, probation
+    watcher polling, stream checkpointing configured — must not slow the
+    traffic it retrains behind.  This sweep serves the SAME mixed-size
+    request load through one server with no controller (the off arm) and
+    with the controller attached in its steady state (the on arm): the
+    online fitter has proven itself live (windows trained before the
+    timed phase), then sits blocked on its label stream — the shape of a
+    production loop between label-arrival bursts, and the only regime a
+    single-core container can measure honestly (concurrent SGD steps
+    would measure CPU contention, not the controller's attachment cost).
+    Emits ``online_loop_on_over_off`` = attached wall / off wall, the
+    lower-is-better ratio BASELINE.json gates at <= 1.05.
+
+    The off baseline is a SANDWICH (off sweeps before attach, off sweeps
+    after the controller fully detaches), interpolated: an obs-enabled
+    process slows a few percent per sweep-phase over its lifetime on
+    this container (environmental, controller-independent — the
+    interleaved off/on benches cancel it pairwise), and attachment being
+    one-way means the attached arm always runs later; comparing it
+    against the MIDPOINT of the two off phases cancels the linear drift
+    the attach ordering would otherwise charge to the controller.
+
+    Asserted inside the bench, never just recorded: per-request
+    predictions bit-identical to solo transforms on the attached arm (no
+    deploy lands inside the timed phase), zero failed requests, the
+    trainer genuinely trained windows before the timed phase, and —
+    between the attached and trailing-off phases — feeding more label
+    chunks drives a VALIDATED candidate through the gate and swaps it
+    under the same server (the loop the overhead is buying actually
+    closes).
+    """
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.online import OnlineLogisticRegression
+    from flink_ml_tpu.serving import (
+        ContinuousLearningController,
+        ModelServer,
+    )
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.sources import QueueUnboundedSource
+    from flink_ml_tpu.table.table import Table
+
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                       ("label", "double"))
+    rng = np.random.RandomState(41)
+    true_w = (rng.randn(n_features) / np.sqrt(n_features)).astype(
+        np.float32)
+    X = (2.0 * rng.randn(n_rows, n_features) + 1.0).astype(np.float32)
+    y = ((X - 1.0) @ true_w > 0).astype(np.float64)
+    t = Table.from_columns(schema, {"features": X, "label": y})
+    model = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_learning_rate(0.5).set_max_iter(3).fit(t)
+    )
+
+    sizes = rng.choice([8, 16, 32, 64], size=n_requests)
+    requests, lo = [], 0
+    for s in sizes:
+        requests.append(t.slice_rows(lo, lo + int(s)))
+        lo += int(s)
+    solo = {}
+    for i, req in enumerate(requests):
+        (out,) = model.transform(req)
+        solo[i] = np.asarray(out.col("pred"))
+
+    def chunk(n=100, seed_off=0):
+        """One label-stream chunk as the fed columns dict."""
+        r = np.random.RandomState(43 + seed_off)
+        Xc = (2.0 * r.randn(n, n_features) + 1.0).astype(np.float32)
+        yc = ((Xc - 1.0) @ true_w > 0).astype(np.float64)
+        return {"features": Xc, "label": yc}
+
+    server = None
+    controller = None
+    # blocked get between feeds: the parked trainer costs zero CPU
+    source = QueueUnboundedSource(schema)
+    try:
+        server = ModelServer(model, max_batch=max_batch,
+                             max_wait_ms=max_wait_ms,
+                             queue_cap=4 * int(sizes.sum()),
+                             warmup=t.slice_rows(0, 8))
+        for fut in [server.submit(r) for r in requests[:8]]:
+            fut.result(timeout=120)  # ladder warmup
+
+        def sweep():
+            t0 = time.perf_counter()
+            futs = [server.submit(r) for r in requests]
+            results = [f.result(timeout=120) for f in futs]
+            return time.perf_counter() - t0, results
+
+        # each arm gets unmeasured warm-up sweeps IMMEDIATELY before its
+        # timed ones: sweeps that follow idle time (the ladder warmup
+        # here, the trainer feed-and-park below) run measurably slower on
+        # a scheduler that just parked the process, and that cost belongs
+        # to neither arm
+        sweep(), sweep()
+        walls_off = []
+        for _ in range(sweeps):
+            w, results = sweep()
+            walls_off.append(w)
+
+        # attach the controller; prove the trainer live, then let it
+        # block on the drained label queue for the timed on-arm.
+        # candidate_every=5 with only 4 windows fired keeps deploys out
+        # of the timed phase (same compiled programs on both arms).
+        est = (
+            OnlineLogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_window_ms(1000)
+        )
+        controller = ContinuousLearningController(
+            est, source, t.slice_rows(0, 512), server=server,
+            candidate_every=5,
+        )
+        controller.start()
+        source.feed(chunk())  # 100 rows x 50ms -> 4 fired windows
+        deadline = time.monotonic() + 120
+        while controller.windows < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert controller.windows >= 4, "the attached trainer never trained"
+        time.sleep(0.1)  # drain: trainer parks on the empty label queue
+
+        sweep(), sweep()  # the attached arm's own warm-up (see above)
+        walls_on = []
+        for _ in range(sweeps):
+            w, results = sweep()
+            walls_on.append(w)
+        assert server.active_version == "v1", (
+            "a deploy landed inside the timed phase")
+        for i, res in enumerate(results):
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")), solo[i],
+                err_msg=f"request {i}: attached-arm prediction diverges",
+            )
+
+        # the loop the overhead buys must actually close: more labels ->
+        # a gated candidate -> a zero-downtime swap on this same server
+        for k in range(1, 4):
+            source.feed(chunk(seed_off=k))
+        source.close()
+        controller.join(timeout=240)
+        stats = controller.stats()
+        assert stats.get("lifecycle.swaps", 0) >= 1, stats
+        assert server.active_version.startswith("cl-"), (
+            server.active_version)
+        server_stats = server.stats()
+        assert server_stats.get("serving.failed_requests", 0) == 0
+
+        # the trailing off arm: the controller is fully inert (trainer
+        # thread exited at stream end, probation watcher stopped) — the
+        # same serving pipeline shapes on the swapped version
+        controller.stop()
+        sweep(), sweep()
+        walls_off2 = []
+        for _ in range(sweeps):
+            w, _ = sweep()
+            walls_off2.append(w)
+    finally:
+        if controller is not None:
+            controller.stop()
+        else:
+            source.close()
+        if server is not None:
+            server.shutdown()
+
+    # min-of-sweeps per phase (additive-noise convention), then the
+    # sandwich midpoint as the drift-cancelled off baseline
+    off1_s = float(np.min(walls_off))
+    off2_s = float(np.min(walls_off2))
+    on_s = float(np.min(walls_on))
+    off_s = 0.5 * (off1_s + off2_s)
+    return _emit({
+        "metric": "ModelServer.serve online_loop_on_over_off",
+        "value": round(on_s / off_s, 4),
+        "unit": "ratio (lower is better)",
+        "off_ms": round(off_s * 1e3, 1),
+        "off_before_ms": round(off1_s * 1e3, 1),
+        "off_after_ms": round(off2_s * 1e3, 1),
+        "attached_ms": round(on_s * 1e3, 1),
+        "windows_trained": int(stats["windows"]),
+        "candidates": int(stats.get("lifecycle.candidates", 0)),
+        "swaps": int(stats.get("lifecycle.swaps", 0)),
+        "pred_parity": True,  # asserted above — reaching here proves it
+        "shape": f"{n_requests} mixed-size (8-64 row) requests x "
+                 f"{n_features} features x {sweeps} off/attached/off "
+                 f"sweeps, max_batch={max_batch}, trainer parked between "
+                 "label bursts, min-of-sweeps vs sandwich-midpoint "
+                 "baseline",
+    })
+
+
 def bench_router(n_train=8192, n_features=256, n_requests=32,
                  req_rows=128, sweeps=3, k=5):
     """Replica-router overhead + scale-out sweep (ISSUE 13).
@@ -2351,6 +2547,7 @@ WORKLOADS = {
     "pressure": bench_pressure,
     "telemetry": bench_telemetry,
     "drift": bench_drift,
+    "online_loop": bench_online_loop,
     "router": bench_router,
 }
 

@@ -496,6 +496,27 @@ class _AsyncCheckpointer:
         self._executor.shutdown(wait=True)
 
 
+def _nothing_to_save() -> None:
+    """Preempted before the first window fired (epoch 0): the snapshot
+    format is keyed by completed epochs, and a restart from scratch over
+    replayable sources IS the committed resume point — the emergency
+    epilogue commits nothing and still exits cleanly."""
+
+
+def _preempted() -> bool:
+    """Has a SIGTERM landed in the current preemption scope?  (Lazy
+    import, memoized: the per-record loop polls this per record.)"""
+    global _GUARD
+    if _GUARD is None:
+        from flink_ml_tpu.fault import guard
+
+        _GUARD = guard
+    return _GUARD.preempted()
+
+
+_GUARD = None
+
+
 def _merge_streams(streams: Sequence[Iterator]) -> Iterator:
     """Deterministic k-way merge by (event_time, kind), stream-stable ties.
 
@@ -537,6 +558,37 @@ class StreamingDriver:
         self.allowed_lateness_ms = int(allowed_lateness_ms)
 
     def run(
+        self,
+        initial_state: Any,
+        training_source: UnboundedSource,
+        update: Callable[[Any, Table, int], Any],
+        prediction_source: Optional[UnboundedSource] = None,
+        predict: Optional[Callable[[Any, Table], Sequence]] = None,
+        listeners: Sequence[IterationListener] = (),
+        max_windows: Optional[int] = None,
+        checkpoint=None,
+    ) -> StreamingResult:
+        """Drive the stream to completion (see class docstring).
+
+        With a checkpoint config the run executes inside the preemption
+        scope (the fault layer's contract for every checkpointed driver):
+        a SIGTERM is polled at record/span boundaries, an emergency
+        snapshot commits synchronously, and :class:`~flink_ml_tpu.fault.
+        guard.Preempted` exits the process cleanly — a restarted run over
+        the same (replayable) sources resumes bit-identically.
+        """
+        if checkpoint is None:
+            return self._run(initial_state, training_source, update,
+                             prediction_source, predict, listeners,
+                             max_windows, checkpoint)
+        from flink_ml_tpu.fault import guard
+
+        with guard.preemption_scope():
+            return self._run(initial_state, training_source, update,
+                             prediction_source, predict, listeners,
+                             max_windows, checkpoint)
+
+    def _run(
         self,
         initial_state: Any,
         training_source: UnboundedSource,
@@ -715,9 +767,39 @@ class StreamingDriver:
                     return
                 fire_window(min(ready))
 
+        def record_snapshot():
+            """The snapshot payload at the CURRENT record boundary, as the
+            writer-thread callable — shared by the periodic submit and the
+            preemption path so both commit the same consistent cut."""
+            pred_schema = (
+                prediction_source.schema()
+                if prediction_source is not None else None
+            )
+            pending = None
+            if pending_buf is not None:
+                _, pcols = pending_buf.columns()
+                pending = (np.asarray(pending_ts, np.int64), pcols)
+            return functools.partial(
+                self._snapshot,
+                checkpoint, _own_state(state), epoch, watermark,
+                {end: buf.columns()
+                 for end, buf in open_windows.items()},
+                pending, list(late_records), consumed,
+                consumed_train, consumed_pred, train_schema,
+                pred_schema,
+            )
+
         ckptr = _AsyncCheckpointer() if checkpoint is not None else None
         try:
             for ts, kind, row in merged:
+                if checkpoint is not None and _preempted():
+                    # a record boundary is a consistent cut: commit the
+                    # emergency snapshot synchronously (behind any
+                    # in-flight periodic write) and exit cleanly
+                    ckptr.drain()
+                    self._emergency(
+                        record_snapshot() if epoch > 0 else _nothing_to_save
+                    )
                 consumed += 1
                 new_wm = ts - lateness
                 if watermark is None or new_wm > watermark:
@@ -775,23 +857,7 @@ class StreamingDriver:
                          >= checkpoint.min_interval_s)
                     and ckptr.can_submit()
                 ):
-                    pred_schema = (
-                        prediction_source.schema()
-                        if prediction_source is not None else None
-                    )
-                    pending = None
-                    if pending_buf is not None:
-                        _, pcols = pending_buf.columns()
-                        pending = (np.asarray(pending_ts, np.int64), pcols)
-                    submitted = ckptr.submit(functools.partial(
-                        self._snapshot,
-                        checkpoint, _own_state(state), epoch, watermark,
-                        {end: buf.columns()
-                         for end, buf in open_windows.items()},
-                        pending, list(late_records), consumed,
-                        consumed_train, consumed_pred, train_schema,
-                        pred_schema,
-                    ))
+                    submitted = ckptr.submit(record_snapshot())
                     if submitted:
                         last_snapshot_epoch = epoch
                         last_snapshot_time = time.monotonic()
@@ -961,6 +1027,35 @@ class StreamingDriver:
             if max_windows is not None and epoch >= max_windows:
                 stopped = True
 
+        def span_snapshot(watermark):
+            """The snapshot payload at the CURRENT span boundary, as the
+            writer-thread callable: the open window segments and pending
+            buffer are already columnar — they go into the snapshot npz
+            as-is.  Shared by the periodic submit and the preemption path
+            so both commit the same consistent merge-prefix cut."""
+            windows_cols = {
+                end: (
+                    sum(n for n, _ in segs),
+                    {
+                        name: _concat_col(
+                            [c[name] for _, c in segs],
+                            train_isvec[name],
+                        )
+                        for name in train_schema.field_names
+                    },
+                )
+                for end, segs in win_bufs.items()
+            }
+            return functools.partial(
+                self._snapshot,
+                checkpoint, _own_state(state), epoch, watermark,
+                windows_cols,
+                pend.peek_all() if pend is not None else None,
+                list(late_records), consumed_train + consumed_pred,
+                consumed_train, consumed_pred, train_schema,
+                pend.schema if pend is not None else None,
+            )
+
         ckptr = _AsyncCheckpointer() if checkpoint is not None else None
         try:
             while not stopped:
@@ -1038,34 +1133,19 @@ class StreamingDriver:
                          >= checkpoint.min_interval_s)
                     and ckptr.can_submit()
                 ):
-                    # span boundary = consistent merge-prefix cut: the open
-                    # window segments and pending buffer are already columnar —
-                    # they go into the snapshot npz as-is
-                    windows_cols = {
-                        end: (
-                            sum(n for n, _ in segs),
-                            {
-                                name: _concat_col(
-                                    [c[name] for _, c in segs],
-                                    train_isvec[name],
-                                )
-                                for name in train_schema.field_names
-                            },
-                        )
-                        for end, segs in win_bufs.items()
-                    }
-                    submitted = ckptr.submit(functools.partial(
-                        self._snapshot,
-                        checkpoint, _own_state(state), epoch, watermark,
-                        windows_cols,
-                        pend.peek_all() if pend is not None else None,
-                        list(late_records), consumed_train + consumed_pred,
-                        consumed_train, consumed_pred, train_schema,
-                        pend.schema if pend is not None else None,
-                    ))
+                    submitted = ckptr.submit(span_snapshot(watermark))
                     if submitted:
                         last_snapshot_epoch = epoch
                         last_snapshot_time = time.monotonic()
+                if checkpoint is not None and _preempted():
+                    # a span boundary is a consistent cut too: commit the
+                    # emergency snapshot synchronously (behind any
+                    # in-flight periodic write) and exit cleanly
+                    ckptr.drain()
+                    self._emergency(
+                        span_snapshot(watermark) if epoch > 0
+                        else _nothing_to_save
+                    )
 
             if not stopped:
                 # end of streams: every still-open window fires in event-time
@@ -1092,6 +1172,17 @@ class StreamingDriver:
             metrics=metrics,
             late_records=late_records,
         )
+
+    @staticmethod
+    def _emergency(save_fn) -> None:
+        """The preemption epilogue: commit the caller's snapshot payload
+        synchronously and exit cleanly.  Never returns —
+        :func:`~flink_ml_tpu.fault.guard.emergency_save` raises
+        :class:`~flink_ml_tpu.fault.guard.Preempted` once the save
+        commits, and the run's ``finally`` drains on the way out."""
+        from flink_ml_tpu.fault import guard
+
+        guard.emergency_save(save_fn)
 
     # -- snapshot/restore -----------------------------------------------------
 

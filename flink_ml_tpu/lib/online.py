@@ -31,6 +31,16 @@ from flink_ml_tpu.table.sources import UnboundedSource
 from flink_ml_tpu.table.table import Table
 
 
+def _f64_or_nan(v) -> float:
+    """Coerce one streamed cell to float64; junk (None, 'n/a', anything
+    non-numeric) becomes NaN so the degenerate-row mask drops it instead
+    of the coercion crashing the loop."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return np.nan
+
+
 class _PeekedSource(UnboundedSource):
     """Re-yields a record peeked off a single-pass source, then the remainder
     of the SAME iterator — nothing is lost to the dim probe.  One-shot:
@@ -107,15 +117,72 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
 
     # -- feature packing for a window ---------------------------------------
 
-    def _window_xyw(self, table: Table) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        X, _ = resolve_features(table, self, dim=self._dim)
-        y = np.asarray(table.col(self.get_label_col()), dtype=np.float64)
-        n = X.shape[0]
+    def _window_xyw(
+        self, table: Table
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Padded (X, y, w) for one fired window, or ``None`` for a window
+        with no usable rows.
+
+        A live label stream carries junk — null vectors, wrong-width
+        vectors, NaN labels — and a window must never crash the loop or
+        perturb the model over rows that cannot train.  Degenerate rows
+        are ZEROED and weighted 0 (zeroing matters: a NaN feature times a
+        0 weight is still NaN through the gradient), so the surviving
+        rows' update is bit-identical to a window that never held the bad
+        rows; a window with nothing usable returns ``None`` and the
+        update skips it (counted, never an all-zero dispatch: with L2 on,
+        a zero-weight dispatch would still decay the params toward an
+        all-zero candidate).
+        """
+        n = table.num_rows()
+        if n == 0:
+            return None
+        try:
+            X, _ = resolve_features(table, self, dim=self._dim)
+            X = np.asarray(X, dtype=np.float64)
+            row_ok = np.ones(n, dtype=bool)
+        except Exception:  # noqa: BLE001 - degenerate rows: rebuild row-wise
+            if self.get_vector_col() is not None:
+                dim = self._dim
+                col = table.col(self.get_vector_col())
+                X = np.zeros((n, dim), dtype=np.float64)
+                row_ok = np.zeros(n, dtype=bool)
+                for i, v in enumerate(col):
+                    try:
+                        arr = np.asarray(v.to_dense().values,
+                                         dtype=np.float64)
+                    except Exception:  # noqa: BLE001 - null / not a vector
+                        continue
+                    if arr.shape != (dim,):
+                        continue
+                    X[i] = arr
+                    row_ok[i] = True
+            else:
+                # featureCols layout: junk cells coerce to NaN and the
+                # finite-row mask below drops them
+                X = np.column_stack([
+                    [_f64_or_nan(v) for v in table.col(c)]
+                    for c in self.get_feature_cols()
+                ])
+                row_ok = np.ones(n, dtype=bool)
+        raw_y = table.col(self.get_label_col())
+        if isinstance(raw_y, np.ndarray) and raw_y.dtype != object:
+            y = np.asarray(raw_y, dtype=np.float64)
+        else:
+            y = np.array([_f64_or_nan(v) for v in raw_y], dtype=np.float64)
+        mask = row_ok & np.isfinite(y) & np.all(np.isfinite(X), axis=1)
+        kept = int(mask.sum())
+        if kept < n:
+            obs.counter_add("online.dropped_rows", n - kept)
+        if kept == 0:
+            return None
+        X[~mask] = 0.0
+        y = np.where(mask, y, 0.0)
         b = bucket_rows(n, 64)
         Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
         yp = np.zeros((b,), dtype=np.float32)
         wp = np.zeros((b,), dtype=np.float32)
-        Xp[:n], yp[:n], wp[:n] = X, y, 1.0
+        Xp[:n], yp[:n], wp[:n] = X, y, mask.astype(np.float32)
         return Xp, yp, wp
 
     def _infer_dim(self, source: UnboundedSource) -> Tuple[int, UnboundedSource]:
@@ -176,6 +243,7 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
         max_windows: Optional[int] = None,
         keep_model_history: bool = False,
         checkpoint=None,
+        window_hook=None,
     ) -> Tuple[LogisticRegressionModel, StreamingResult]:
         # the streaming path compiles bare jits without building a mesh, so
         # it must finish the deferred compile-cache decision itself (the
@@ -203,8 +271,26 @@ class OnlineLogisticRegression(Estimator, GlmTrainParams, HasWindowMs, HasAllowe
             return x @ w + b
 
         def update(state, window_table: Table, epoch: int):
-            x, y, w = self._window_xyw(window_table)
-            return sgd_step(state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+            xyw = self._window_xyw(window_table)
+            if xyw is None:
+                # nothing trainable in the window: skip, count, keep
+                # streaming — the returned state is the SAME object, which
+                # window hooks use to tell a skip from a real step
+                obs.counter_add("online.skipped_windows")
+                new_state = state
+            else:
+                x, y, w = xyw
+                new_state = sgd_step(
+                    state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+                )
+            if window_hook is not None:
+                # the continuous-learning controller's tap (ISSUE 14): a
+                # non-None return REPLACES the trainer state — how a
+                # poisoned run is reset to the last good candidate
+                replacement = window_hook(epoch, new_state)
+                if replacement is not None:
+                    new_state = replacement
+            return new_state
 
         # host mirror of the freshest reachable params for the CPU fallback:
         # the live ``state`` is a device pytree, and pulling it during an

@@ -30,6 +30,15 @@ drain-aware zero-downtime rolling deploys, and crash supervision with
 respawn (:mod:`flink_ml_tpu.serving.replica` owns the subprocess
 lifecycle and wire protocol).
 
+Continuous learning (ISSUE 14): :class:`~flink_ml_tpu.serving.lifecycle.
+ContinuousLearningController` closes the reference's second topology —
+an online fitter consumes a label stream beside the live server,
+periodically cuts a candidate, pushes it through a hard validation gate
+(numeric health, holdout no-regression, score quarantine/PSI sanity),
+auto-deploys passing candidates through the swap contract, and watches a
+post-swap probation window that rolls back automatically on an SLO or
+drift burn (``ModelServer.rollback`` / ``VersionManager.rollback``).
+
 Entry points: ``bench_all.py serving`` (the >=3x dynamic-batching gate),
 ``bench_all.py router`` (the <=1.25x router-overhead gate),
 ``python scripts/chaos_smoke.py --serving`` / ``--router`` (shed /
@@ -46,6 +55,9 @@ from flink_ml_tpu.serving.errors import (  # noqa: F401
     ServerClosedError,
     ServerOverloadedError,
     shed_policy,
+)
+from flink_ml_tpu.serving.lifecycle import (  # noqa: F401
+    ContinuousLearningController,
 )
 from flink_ml_tpu.serving.replica import (  # noqa: F401
     ReplicaClient,
@@ -65,6 +77,7 @@ from flink_ml_tpu.serving.versioning import (  # noqa: F401
 )
 
 __all__ = [
+    "ContinuousLearningController",
     "ModelServer",
     "ModelVersion",
     "ReplicaClient",

@@ -691,13 +691,46 @@ class ModelServer:
         self._reset_drift_for(deployed, warmup)
         return deployed
 
+    def rollback(self, warmup: Optional[Table] = None):
+        """Redeploy the previous retained version through the same
+        integrity-verified swap path as :meth:`deploy` (ISSUE 14) — the
+        continuous-learning controller's answer to a post-swap SLO/drift
+        breach, and an operator's big red button.  The drift baseline
+        follows the rollback: the restored version's model dir usually
+        carries its persisted reference, which wins over re-learning."""
+        if warmup is None:
+            warmup = self._warmup_sample
+        deployed = self._versions.rollback(warmup=warmup)
+        self._tally("serving.rollbacks")
+        self._breaker_scope = _breaker_scope_names(deployed.model)
+        self._reset_drift_for(deployed, warmup)
+        return deployed
+
     @property
     def active_version(self) -> Optional[str]:
         return self._versions.active_version
 
     @property
+    def active_model(self):
+        """The model object currently serving (the active version's)."""
+        return self._versions.active().model
+
+    @property
+    def previous_version(self) -> Optional[str]:
+        """Label a :meth:`rollback` would reactivate (None when no
+        previous version is retained)."""
+        return self._versions.previous_version
+
+    @property
     def versions(self) -> List[str]:
         return self._versions.history
+
+    @property
+    def slo_monitor(self):
+        """This server's :class:`~flink_ml_tpu.obs.slo.SLOMonitor` (None
+        when neither telemetry nor drift armed one) — the burn-rate
+        signal the continuous-learning probation window watches."""
+        return self._slo
 
     # -- dispatcher ----------------------------------------------------------
 

@@ -22,6 +22,15 @@ A deploy that fails at ANY step (integrity, warmup compile, a broken
 transform) leaves the previous version serving, counted in
 ``serving.deploy_failures``; a successful swap counts in
 ``serving.swaps``.
+
+Rollback (ISSUE 14): the manager retains the last ``FMT_LIFECYCLE_
+HISTORY`` deployed versions, and :meth:`VersionManager.rollback`
+redeploys the previous one THROUGH :meth:`deploy` — a path-sourced
+version is re-loaded and integrity-re-verified (the artifact may have
+rotted since its first deploy), the warmup batch pre-warms it again,
+``deploy_in_progress`` (and so ``/readyz``) degrades for the duration,
+and only then does the pointer swap.  A bare pointer flip would skip
+every one of those guarantees.  Counted in ``serving.rollbacks``.
 """
 
 from __future__ import annotations
@@ -68,11 +77,30 @@ def _load_model(path: str):
 class VersionManager:
     """The server's model registry: one active version, swap under lock."""
 
-    def __init__(self):
+    #: version LABELS kept for history/statusz — a continuous-learning
+    #: loop deploys forever, so even the label trail must stay bounded
+    #: (the total-deploys gauge keeps the true count)
+    HISTORY_LABELS = 1024
+
+    def __init__(self, keep: Optional[int] = None):
+        from collections import deque
+
+        from flink_ml_tpu.utils import knobs
+
         self._lock = threading.Lock()
         self._active: Optional[ModelVersion] = None
-        self._history: List[str] = []  # version labels in deploy order
+        # version labels in deploy order, newest last (bounded window)
+        self._history: "deque[str]" = deque(maxlen=self.HISTORY_LABELS)
+        self._deploys = 0  # total successful deploys (gauge source)
         self._deploying = 0  # deploys currently loading/warming
+        # retained ModelVersion objects, newest last (the rollback
+        # targets); bounded so a long-lived continuous-learning loop
+        # cannot pin every model it ever deployed in memory
+        self._retained: List[ModelVersion] = []
+        self._keep = max(
+            2, keep if keep is not None
+            else knobs.knob_int("FMT_LIFECYCLE_HISTORY")
+        )
 
     @property
     def deploy_in_progress(self) -> bool:
@@ -150,12 +178,61 @@ class VersionManager:
             prev = self._history[-1] if self._history else None
             self._active = candidate
             self._history.append(candidate.version)
+            self._deploys += 1
+            deploys = self._deploys
+            self._retained.append(candidate)
+            while len(self._retained) > self._keep:
+                self._retained.pop(0)
         obs.flight.record("serving.swap", version=candidate.version,
                           previous=prev, warmed=warmup is not None)
         if swapped:
             obs.counter_add("serving.swaps")
-        obs.gauge_set("serving.versions_deployed", len(self.history))
+        obs.gauge_set("serving.versions_deployed", deploys)
         return candidate
+
+    @property
+    def previous_version(self) -> Optional[str]:
+        """Label of the version a :meth:`rollback` would reactivate."""
+        with self._lock:
+            if len(self._retained) < 2:
+                return None
+            return self._retained[-2].version
+
+    def rollback(self, warmup: Optional[Table] = None) -> ModelVersion:
+        """Redeploy the previously retained version through the full swap
+        contract.
+
+        NOT a pointer flip: the previous version re-enters through
+        :meth:`deploy` — a path-sourced version is re-loaded and
+        integrity-re-verified from its artifact (which may have rotted on
+        disk since it first served), ``warmup`` pre-warms its plans off
+        the hot path, ``deploy_in_progress`` degrades ``/readyz`` for the
+        duration, and the pointer swaps atomically.  On success the
+        rolled-away-from version is dropped from the retained set (a
+        second rollback steps FURTHER back, not onto the version just
+        rejected); on failure the current version keeps serving and the
+        retained set is untouched.
+        """
+        with self._lock:
+            if len(self._retained) < 2:
+                raise RuntimeError(
+                    "no previous version retained to roll back to"
+                )
+            bad = self._retained[-1]
+            prev = self._retained[-2]
+        target = prev.source_path if prev.source_path else prev.model
+        deployed = self.deploy(target, prev.version, warmup=warmup)
+        with self._lock:
+            # deploy() appended the fresh redeploy; drop the version we
+            # rolled away from AND the stale copy of the target so the
+            # retained tail reads [..., older, redeployed]
+            self._retained = [
+                v for v in self._retained if v is not bad and v is not prev
+            ]
+        obs.counter_add("serving.rollbacks")
+        obs.flight.record("serving.rollback", version=deployed.version,
+                          rolled_back=bad.version)
+        return deployed
 
     def snapshot(self) -> Dict[str, Optional[str]]:
         with self._lock:

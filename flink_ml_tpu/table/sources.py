@@ -549,6 +549,78 @@ class ColumnarUnboundedSource(UnboundedSource):
             yield from chunk_row_iter(ts, cols, self._schema)
 
 
+class QueueUnboundedSource(UnboundedSource):
+    """Live queue-fed chunk source — the unbounded stream a PROCESS feeds
+    while a consumer (the streaming driver, a continuous-learning loop)
+    trains from it concurrently.
+
+    ``feed(cols)`` enqueues one time-ordered chunk, auto-timestamped on a
+    fixed ``interval_ms`` grid continuing from the previous feed
+    (``feed_chunk(ts, cols)`` takes explicit timestamps); ``close()``
+    ends the stream.  A consumer blocked between feeds parks on the
+    queue — zero CPU — which is what makes this the label-stream shape
+    for serving-adjacent training loops.  One-shot, single-consumer.
+    """
+
+    def __init__(self, schema: Schema, interval_ms: int = 50):
+        import queue
+
+        if interval_ms <= 0:
+            raise ValueError("interval_ms must be positive")
+        self._schema = schema
+        self._interval_ms = int(interval_ms)
+        self._q: "queue.Queue" = queue.Queue()
+        self._next_ts = 0
+
+    def feed(self, cols: dict) -> None:
+        """Enqueue one chunk, timestamped after everything fed so far."""
+        n = len(next(iter(cols.values())))
+        ts = self._next_ts + np.arange(n, dtype=np.int64) * self._interval_ms
+        self.feed_chunk(ts, cols)
+
+    def feed_chunk(self, ts, cols: dict) -> None:
+        """Enqueue one chunk with explicit (non-decreasing) timestamps."""
+        ts = np.asarray(ts, np.int64)
+        if len(ts) == 0:
+            return
+        if int(ts[0]) < self._next_ts or np.any(np.diff(ts) < 0):
+            raise ValueError(
+                "fed timestamps must be non-decreasing across feeds "
+                "(the chunk protocol's time-order contract)"
+            )
+        self._next_ts = int(ts[-1]) + self._interval_ms
+        self._q.put((ts, cols))
+
+    def close(self) -> None:
+        """End the stream: the consumer's iterator finishes after
+        draining everything fed before the close."""
+        self._q.put(None)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def stream_chunks(self, max_rows: Optional[int] = None):
+        def chunks():
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                ts, cols = item
+                if max_rows is None:
+                    yield ts, cols
+                    continue
+                step = int(max_rows)
+                for a in range(0, len(ts), step):
+                    b = a + step
+                    yield ts[a:b], {k: v[a:b] for k, v in cols.items()}
+
+        return chunks()
+
+    def stream(self) -> Iterator[Tuple[int, Tuple]]:
+        for ts, cols in self.stream_chunks():
+            yield from chunk_row_iter(ts, cols, self._schema)
+
+
 # -- helpers -----------------------------------------------------------------
 
 

@@ -181,6 +181,19 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Seconds a replica subprocess gets to bind its endpoints at boot."),
     Knob("FMT_ROUTER_DRAIN_TIMEOUT_S", "30", "float",
          "Seconds a rolling deploy waits for one replica's in-flight work."),
+    # -- continuous learning ----------------------------------------------
+    Knob("FMT_LIFECYCLE_EVERY_WINDOWS", "8", "int",
+         "Effective training windows between candidate checkpoints."),
+    Knob("FMT_LIFECYCLE_REGRESSION_TOL", "0.02", "float",
+         "Holdout-AUC regression a candidate may show vs the incumbent."),
+    Knob("FMT_LIFECYCLE_SCORE_PSI", "0.25", "float",
+         "Candidate-vs-incumbent holdout score PSI above which a swap blocks."),
+    Knob("FMT_LIFECYCLE_PROBATION_S", "60", "float",
+         "Post-swap probation window watching live SLO/drift burn."),
+    Knob("FMT_LIFECYCLE_HISTORY", "3", "int",
+         "Model versions the VersionManager retains for rollback."),
+    Knob("FMT_LIFECYCLE_DIR", "", "str",
+         "Default candidate-checkpoint directory for the lifecycle loop."),
     # -- device data plane ------------------------------------------------
     Knob("FMT_FUSE_TRANSFORM", "1", "bool",
          "Fuse kernel-capable pipeline stages into one dispatch per batch."),

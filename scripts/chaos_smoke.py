@@ -123,6 +123,26 @@ the full drift-detection loop under an injected distribution shift:
   4. **CLI** — ``python -m flink_ml_tpu.obs drift`` renders the
      per-column comparison from the shutdown serving report.
 
+**Online mode** (``--online``, ISSUE 14): the continuous-learning
+counterpart — an online fitter training beside the live server through
+the ``ContinuousLearningController``'s validation gate:
+
+  1. **loop demo** — a clean label stream beside live request traffic
+     must swap >= 2 validated candidates through the zero-downtime
+     deploy contract with ZERO failed requests;
+  2. **poisoned label burst** — hugely mis-scaled labels drive the
+     online SGD non-finite; the gate must block the swap reason-coded
+     (``numeric_health``/``score_quarantine``) with a black-box dump
+     while the OLD model keeps serving BIT-IDENTICALLY with zero
+     caller-visible failures, the trainer must reset to the last good
+     candidate, and once clean labels resume a later candidate must
+     validate and swap again (the self-healing loop);
+  3. **post-swap drift burn** — a 5-sigma covariate shift on the live
+     request stream inside the probation window must burn
+     ``slo.burning.drift`` and the controller must automatically roll
+     the server back to the prior version through the
+     integrity-verified swap path (``lifecycle.rollbacks``, black box).
+
 **Router mode** (``--router``, ISSUE 13): the horizontal-scale-out
 counterpart — a 3-replica ``ReplicaRouter`` fleet under sustained
 concurrent load:
@@ -1460,6 +1480,247 @@ def drift_main() -> int:
     return 0
 
 
+def online_main() -> int:
+    """The continuous-learning chaos matrix (``--online``, ISSUE 14):
+    the guarded train->validate->deploy loop under live traffic, a
+    poisoned label burst, and a post-swap drift breach."""
+    import time
+
+    os.environ["FMT_OBS_REPORTS"] = tempfile.mkdtemp(
+        prefix="chaos_online_reports_"
+    )
+    os.environ["FMT_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos_online_flight_"
+    )
+    os.environ["FMT_FLIGHT_MIN_S"] = "0"  # every dump lands (test mode)
+    os.environ["FMT_DRIFT_REF_ROWS"] = "256"
+    os.environ["FMT_DRIFT_MIN_ROWS"] = "64"
+    os.environ["FMT_SLO_WINDOW_S"] = "0.5"
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.online import OnlineLogisticRegression
+    from flink_ml_tpu.obs import flight
+    from flink_ml_tpu.serving import (
+        ContinuousLearningController,
+        ModelServer,
+    )
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.sources import QueueUnboundedSource
+    from flink_ml_tpu.table.table import Table
+
+    obs.reset()
+    flight.reset()
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                       ("label", "double"))
+    dim = 4
+    rng = np.random.RandomState(37)
+    true_w = rng.randn(dim).astype(np.float64)
+
+    def batch(n, shift_col=None, shift=0.0, poison_labels=False):
+        X = rng.randn(n, dim).astype(np.float32)
+        if shift_col is not None:
+            X[:, shift_col] += shift
+        y = (X.astype(np.float64) @ true_w > 0).astype(np.float64)
+        if poison_labels:
+            # finite in f64 (so the window's degenerate-row mask cannot
+            # save us — this is adversarial data, not a null row) but an
+            # overflow in the f32 training pipeline: the SGD goes
+            # non-finite within one window and only the GATE stands
+            # between the poisoned params and traffic
+            y = y * 1e39 + 1e39
+        return X, y
+
+    def table_of(X, y):
+        return Table.from_columns(schema, {"features": X, "label": y})
+
+    Xi, yi = batch(256)
+    init_model = (
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(2).fit(table_of(Xi, yi))
+    )
+    Xh, yh = batch(400)
+    holdout = table_of(Xh, yh)
+    Xp, yp = batch(32)
+    probe = table_of(Xp, yp)
+
+    server = ModelServer(init_model, version="v1", max_batch=64,
+                         max_wait_ms=1.0, drift=True,
+                         warmup=holdout.slice_rows(0, 8))
+    source = QueueUnboundedSource(schema)
+
+    def feed_labels(**kw):
+        """One 100-row training chunk onto the label stream (~5 windows
+        at 50ms spacing under the 1000ms window)."""
+        X, y = batch(100, **kw)
+        source.feed({"features": X, "label": y})
+    estimator = (
+        OnlineLogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_window_ms(1000)
+    )
+    controller = ContinuousLearningController(
+        estimator, source, holdout, server=server,
+        candidate_dir=tempfile.mkdtemp(prefix="chaos_online_cands_"),
+        candidate_every=5, probation_s=120.0,
+    )
+    failures = []
+
+    def serve(n_batches=4, rows=32, **kw):
+        """Concurrent live traffic; every caller-visible failure is
+        fatal to the leg."""
+        futs = []
+        for _ in range(n_batches):
+            X, y = batch(rows, **kw)
+            futs.append(server.submit(table_of(X, y)))
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result(timeout=120))
+            except Exception as exc:  # noqa: BLE001 - counted, asserted 0
+                failures.append(exc)
+        return out
+
+    def wait_for(cond, what, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            serve(1)
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    try:
+        controller.start()
+
+        # -- leg 1: the loop demo — >= 2 validated swaps, zero downtime ----
+        # 5 chunks x 100 rows x 50ms = windows 1..24 fired: candidates
+        # cut at windows 5/10/15/20 — waiting for all 4 quiesces the
+        # loop at a KNOWN boundary (windows 21-24 pending), so leg 2's
+        # first candidate after the baseline deterministically holds the
+        # poisoned window
+        for _ in range(5):
+            feed_labels()
+            serve(2)
+        wait_for(lambda: controller.stats().get("lifecycle.swaps", 0) >= 4
+                 and controller.windows >= 24,
+                 ">= 4 validated candidate swaps")
+        stats = controller.stats()
+        assert stats["lifecycle.swaps"] >= 2  # the acceptance bar
+        assert server.active_version.startswith("cl-"), (
+            server.active_version)
+        assert not failures, failures
+        print(f"  loop: {stats['lifecycle.swaps']} validated candidates "
+              f"swapped under live traffic (active "
+              f"{server.active_version}), 0 failed requests")
+
+        # -- leg 2: poisoned label burst -> swap blocked, old model exact --
+        def quiesce():
+            """Wait until the trainer drained everything fed so far (the
+            queue is empty and the window count stops moving)."""
+            deadline = time.monotonic() + 60
+            last, stable = -1, 0
+            while stable < 5 and time.monotonic() < deadline:
+                w = controller.windows
+                stable = stable + 1 if w == last else 0
+                last = w
+                time.sleep(0.05)
+
+        def blocked_count():
+            c = controller.stats()
+            return (c.get("lifecycle.blocked.numeric_health", 0)
+                    + c.get("lifecycle.blocked.score_quarantine", 0))
+
+        quiesce()
+        swaps_before = controller.stats().get("lifecycle.swaps", 0)
+        for _ in range(2):
+            feed_labels(poison_labels=True)
+        wait_for(lambda: blocked_count() >= 1,
+                 "the gate to block the poisoned candidate")
+        stats = controller.stats()
+        # a window straddling the clean/poison boundary may cut ONE more
+        # all-clean candidate (stream pipelining, gate-validated); every
+        # candidate holding a poisoned window must have been blocked
+        assert stats.get("lifecycle.swaps", 0) - swaps_before <= 1, stats
+        dump = flight.last_dump_path()
+        assert dump and "lifecycle_blocked" in os.path.basename(dump), dump
+        header = json.loads(open(dump).readline())
+        assert header["reason"] == "lifecycle_blocked", header
+        # the burst continues: serving must stay BIT-IDENTICAL on the
+        # incumbent from here on while further poisoned candidates block
+        incumbent = server.active_version
+        probe_a = np.asarray(
+            server.predict(probe, timeout=120).table.col("p"))
+        swaps_at_probe = controller.stats().get("lifecycle.swaps", 0)
+        feed_labels(poison_labels=True)
+        wait_for(lambda: blocked_count() >= 2,
+                 "the gate to block the continued burst")
+        stats = controller.stats()
+        assert stats.get("lifecycle.swaps", 0) == swaps_at_probe, (
+            "a poisoned candidate reached traffic", stats)
+        assert server.active_version == incumbent
+        probe_b = np.asarray(
+            server.predict(probe, timeout=120).table.col("p"))
+        np.testing.assert_array_equal(probe_b, probe_a)
+        assert not failures, failures
+        reason = next(k for k in sorted(stats)
+                      if k.startswith("lifecycle.blocked."))
+        print(f"  poison: burst blocked at the gate "
+              f"({blocked_count()}x {reason.split('.')[-1]}, black box "
+              f"{os.path.basename(dump)}), incumbent {incumbent} served "
+              "bit-identically, 0 failures")
+
+        # the self-healing half: the trainer reset to the last good
+        # candidate, so clean labels must produce a validating swap again
+        assert stats.get("lifecycle.trainer_resets", 0) >= 1, stats
+        for _ in range(3):
+            feed_labels()
+            serve(2)
+        wait_for(lambda: controller.stats().get("lifecycle.swaps", 0)
+                 > swaps_at_probe, "a post-burst candidate to swap")
+        print(f"  recovery: trainer reset "
+              f"({stats.get('lifecycle.trainer_resets')}x) and a clean "
+              f"candidate swapped (active {server.active_version})")
+
+        # -- leg 3: post-swap drift burn -> automatic rollback -------------
+        swapped_to = server.active_version
+        prev_version = server.previous_version
+        assert prev_version is not None
+        monitor = server.drift_monitor
+        # freeze the new version's reference on clean traffic first
+        wait_for(lambda: monitor.reference_complete,
+                 "the drift reference to freeze")
+        for _ in range(10):
+            serve(2, shift_col=2, shift=5.0)  # the 5-sigma live shift
+        wait_for(lambda: server.active_version == prev_version,
+                 "the probation window to roll the swap back")
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("lifecycle.rollbacks", 0) >= 1, c
+        assert c.get("serving.rollbacks", 0) >= 1, c
+        dump = flight.last_dump_path()
+        assert dump and ("lifecycle_rollback" in os.path.basename(dump)
+                         or "drift_breach" in os.path.basename(dump)), dump
+        assert controller.incumbent_version == prev_version
+        assert not failures, failures
+        print(f"  probation: drift burn on the live stream rolled "
+              f"{swapped_to} back to {prev_version} automatically "
+              f"(lifecycle.rollbacks={c.get('lifecycle.rollbacks'):g}), "
+              "0 failed requests")
+    finally:
+        source.close()
+        try:
+            controller.join(120)
+        finally:
+            controller.stop()
+            server.shutdown()
+    for var in ("FMT_FLIGHT_MIN_S", "FMT_DRIFT_REF_ROWS",
+                "FMT_DRIFT_MIN_ROWS", "FMT_SLO_WINDOW_S"):
+        os.environ.pop(var, None)
+    assert not failures, failures
+    print("online chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -1478,6 +1739,8 @@ def main() -> int:
         return telemetry_main()
     if "--drift" in sys.argv:
         return drift_main()
+    if "--online" in sys.argv:
+        return online_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -86,6 +86,133 @@ class TestOnlineLogisticRegression:
         assert np.mean((probs > 0.5) == (y == 1)) > 0.88
 
 
+class TestDegenerateWindows:
+    """ISSUE 14 satellite: empty/degenerate training windows must not
+    crash the loop or emit an all-zero candidate — skip, count, keep
+    streaming."""
+
+    def test_empty_window_returns_none(self):
+        est = make_estimator()
+        est._dim = 3
+        empty = Table.from_columns(SCHEMA, {"features": [], "label": []})
+        assert est._window_xyw(empty) is None
+
+    def test_all_null_vector_window_returns_none_and_counts(self):
+        from flink_ml_tpu import obs
+
+        obs.enable()
+        obs.reset()
+        try:
+            est = make_estimator()
+            est._dim = 3
+            bad = Table.from_columns(
+                SCHEMA, {"features": [None, None], "label": [1.0, 0.0]}
+            )
+            # red before the fix: AttributeError out of features_dense
+            assert est._window_xyw(bad) is None
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("online.dropped_rows") == 2
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_degenerate_window_mid_stream_skips_and_keeps_training(self):
+        """A whole window of null-vector rows lands mid-stream: the loop
+        must survive it, count the skip, and still converge — never an
+        all-zero model."""
+        from flink_ml_tpu import obs
+
+        obs.enable()
+        obs.reset()
+        try:
+            rows, X, y = stream_rows(400, seed=6)
+            poisoned = list(rows)
+            # window [1000, 2000) becomes all-degenerate: null vectors
+            for i in range(20, 40):
+                poisoned[i] = (None, rows[i][1])
+            source = GeneratorSource.linear_timestamps(poisoned, 50, SCHEMA)
+            model, result = make_estimator().fit_unbounded(source)
+            assert result.windows_fired == 20
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("online.skipped_windows") == 1
+            assert c.get("online.dropped_rows") == 20
+            w = model.coefficients()
+            assert np.any(w != 0.0)
+            t = Table.from_rows([(DenseVector(x),) for x in X], QSCHEMA)
+            acc = np.mean((model.predict_proba(t) > 0.5) == (y == 1))
+            assert acc > 0.85
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_feature_cols_degenerate_rows_masked_not_crashed(self):
+        """The row-wise fallback must also work for featureCols-configured
+        estimators (no vector column to re-densify) — junk cells coerce
+        to NaN and mask out."""
+        from flink_ml_tpu.lib.online import OnlineLogisticRegression
+        from flink_ml_tpu.table.schema import Schema
+
+        schema = Schema(["f0", "f1", "label"],
+                        ["double", "double", "double"])
+        est = (
+            OnlineLogisticRegression().set_feature_cols(["f0", "f1"])
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_window_ms(1000)
+        )
+        est._dim = 2
+        bad = Table.from_columns(schema, {
+            "f0": [1.0, None, 3.0], "f1": [2.0, 2.0, None],
+            "label": [1.0, 0.0, 1.0],
+        })
+        xyw = est._window_xyw(bad)
+        assert xyw is not None
+        _, _, wp = xyw
+        np.testing.assert_array_equal(wp[:3], [1.0, 0.0, 0.0])
+
+    def test_junk_label_cells_coerce_to_nan(self):
+        """Object-dtype label columns (nullable paths) coerce cell-wise:
+        junk becomes NaN for the mask, never a coercion crash.  (A
+        string in a typed double column is rejected at Table
+        construction — this guards the object-column route.)"""
+        from flink_ml_tpu.lib.online import _f64_or_nan
+
+        assert _f64_or_nan(3) == 3.0
+        assert np.isnan(_f64_or_nan(None))
+        assert np.isnan(_f64_or_nan("n/a"))
+        assert np.isnan(_f64_or_nan(object()))
+
+    def test_masked_poison_row_is_bit_identical_to_its_absence(self):
+        """A NaN-label row appended at a window's tail is zeroed and
+        weight-0 masked — exactly a padding row, so the fitted params
+        EQUAL the clean stream's bit for bit (weight-0 masking alone
+        would let NaN * 0 poison the gradient)."""
+        from flink_ml_tpu.table.sources import ColumnarUnboundedSource
+
+        rng = np.random.RandomState(8)
+        X = rng.randn(200, 3).astype(np.float32)
+        y = ((X @ np.array([2.0, -1.5, 1.0], np.float32)) > 0).astype(
+            np.float64)
+        ts = np.arange(200, dtype=np.int64) * 50
+
+        clean = ColumnarUnboundedSource(
+            ts, {"features": X, "label": y}, SCHEMA)
+        model_a, _ = make_estimator().fit_unbounded(clean)
+
+        # the poison row rides at the END of window [0, 1000): ts 999
+        cut = 20
+        Xp = np.concatenate([X[:cut], rng.randn(1, 3).astype(np.float32),
+                             X[cut:]])
+        yp = np.concatenate([y[:cut], [np.nan], y[cut:]])
+        tsp = np.concatenate([ts[:cut], [999], ts[cut:]])
+        poisoned = ColumnarUnboundedSource(
+            tsp, {"features": Xp, "label": yp}, SCHEMA)
+        model_b, _ = make_estimator().fit_unbounded(poisoned)
+
+        np.testing.assert_array_equal(
+            model_b.coefficients(), model_a.coefficients())
+        assert model_b.intercept() == model_a.intercept()
+
+
 class TestSinglePassSource:
     def test_dim_probe_keeps_first_record(self):
         """Regression: _infer_dim peeks the first record off the stream; a
