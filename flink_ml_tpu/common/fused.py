@@ -42,17 +42,43 @@ values (the staged path would), so a kernel that *manufactures* NaNs from
 clean inputs flows them onward — the same contract as any single fused
 device program.
 
+SPMD multi-chip serving (ISSUE 15): every fused dispatch is sharded over
+the session mesh's ``data`` axis through :func:`~flink_ml_tpu.parallel.
+collectives.shard_map` — dense batches place row-sharded
+(``P('data')``), segment-CSR batches re-lay out shard-major
+(:class:`~flink_ml_tpu.ops.batch.ShardedCsrBatch`: per-shard nnz padded
+to one agreed width, the ``agree_max`` idiom from the sparse training
+pack), and every batch pads to a bucket divisible by the data-axis size
+with weight-0 pad rows (zero features -> zero contributions, sliced off
+before finalize), so outputs, quarantine side-table offsets, and
+bisection sub-ranges are identical to the 1-device path.  The per-device
+outputs come back in the ONE bundled fetch and demux by row position —
+contiguous row sharding keeps output row i = input row i.  The fused
+kernels are row-aligned by contract (no collectives), so the serving
+mesh never gathers; a mesh that spans processes (never the default
+``inference_mesh``) agrees its breaker verdict open-wins through
+``serve.dispatch(agreed=True)``.
+
 Telemetry: ``pipeline.fused_dispatches`` (exactly one per batch per fused
 run), ``pipeline.fused_rows``, ``pipeline.plan_fallback_batches``, the
-``pipeline.fusion_ratio`` gauge (fused stages / total stages) and the
-``pipeline.fused_call_ms`` timing histogram.
+``pipeline.fusion_ratio`` gauge (fused stages / total stages), the
+``pipeline.fused_call_ms`` timing histogram, and the mesh plane:
+``fused.mesh_devices`` gauge, ``fused.shard_map_dispatches`` counter
+(the proof the sharded path ran — the bench gate's bypass detector),
+``fused.padded_rows`` per-batch pad accounting, and the per-device
+row-share breakdown ``/statusz`` renders (:func:`mesh_status`).
 
-Knob: ``FMT_FUSE_TRANSFORM`` (default on).  Off restores the stage-at-a-
-time transform verbatim.
+Knobs: ``FMT_FUSE_TRANSFORM`` (default on; off restores the stage-at-a-
+time transform verbatim), ``FMT_SERVE_MESH`` (default on; off pins fused
+serving to a single logical device — plain jit, no row sharding),
+``FMT_SERVE_CSR_PAD`` (per-shard nnz pad multiple for sharded CSR),
+``FMT_FUSE_DONATE`` (donate placed batch buffers to the dispatch;
+ignored on the CPU backend).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -71,6 +97,9 @@ __all__ = [
     "FusedInput",
     "FusedKernel",
     "fusion_enabled",
+    "mesh_status",
+    "reset_mesh_stats",
+    "serve_mesh_enabled",
     "transform_fused",
 ]
 
@@ -78,6 +107,58 @@ __all__ = [
 def fusion_enabled() -> bool:
     """Is fused pipeline inference on?  ``FMT_FUSE_TRANSFORM`` (default 1)."""
     return knobs.knob_bool("FMT_FUSE_TRANSFORM")
+
+
+def serve_mesh_enabled() -> bool:
+    """Is SPMD fused serving over the mesh on?  ``FMT_SERVE_MESH``
+    (default 1).  Off pins every fused dispatch to one logical device —
+    the pre-ISSUE-15 single-device behavior, kept as an escape hatch."""
+    return knobs.knob_bool("FMT_SERVE_MESH")
+
+
+# -- per-device row-share accounting (ISSUE 15) -------------------------------
+#
+# Contiguous row sharding means device d of a width-D dispatch serves rows
+# [d*b/D, (d+1)*b/D) of the padded bucket; the tally below records how many
+# REAL rows each data-axis position received, which /statusz renders as the
+# mesh row-share breakdown (a chronically starved tail device means batches
+# are too small for the mesh).
+
+_MESH_ROWS_LOCK = threading.Lock()
+_MESH_ROWS: Dict[int, int] = {}
+
+
+def _note_device_rows(n: int, b: int, width: int) -> None:
+    if width <= 1 or b <= 0:
+        return
+    share = b // width
+    with _MESH_ROWS_LOCK:
+        for d in range(width):
+            real = max(0, min(n - d * share, share))
+            _MESH_ROWS[d] = _MESH_ROWS.get(d, 0) + real
+
+
+def mesh_status() -> dict:
+    """The ``/statusz`` mesh section: per-device REAL-row counts and
+    shares over every sharded fused dispatch since process start (or
+    :func:`reset_mesh_stats`)."""
+    with _MESH_ROWS_LOCK:
+        rows = {str(d): int(r) for d, r in sorted(_MESH_ROWS.items())}
+    total = sum(rows.values())
+    return {
+        "devices": len(rows),
+        "device_rows": rows,
+        "device_row_share": {
+            d: round(r / total, 4) if total else 0.0
+            for d, r in rows.items()
+        },
+    }
+
+
+def reset_mesh_stats() -> None:
+    """Drop the per-device row tally (tests; per-run scoping)."""
+    with _MESH_ROWS_LOCK:
+        _MESH_ROWS.clear()
 
 
 @dataclass(frozen=True)
@@ -202,11 +283,19 @@ class FusedRun:
     # -- the one jitted program ----------------------------------------------
 
     def _fused_fn(self):
+        from flink_ml_tpu.ops.batch import ShardedCsrBatch
+
         device_stages = self.device_stages
         n_data = len(self.data_descs)
 
         def fused(*args):
-            data = args[:n_data]
+            # inside a shard_map a ShardedCsrBatch's leaves are this
+            # shard's slice with local row ids: reassemble the ordinary
+            # local CsrBatch the kernels consume
+            data = tuple(
+                a.local() if isinstance(a, ShardedCsrBatch) else a
+                for a in args[:n_data]
+            )
             margs = args[n_data:]
             env: Dict[str, object] = {}
             outs = []
@@ -224,24 +313,55 @@ class FusedRun:
 
         return fused
 
+    def _mesh_width(self, mesh) -> int:
+        """The dispatch's row-shard count: the mesh's data-axis size, or
+        1 when ``FMT_SERVE_MESH`` pins serving to one logical device."""
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
+
+        if not serve_mesh_enabled():
+            return 1
+        return data_parallel_size(mesh)
+
+    def _donate_argnums(self) -> tuple:
+        """Data-arg positions donated to the fused program (ISSUE 15,
+        dispatch-cost satellite): the placed batch buffers are built
+        fresh per batch by :meth:`_extract` — never slab-pooled, so no
+        pin can alias them — and nothing reads them after the dispatch,
+        so XLA may reuse their device memory for the outputs instead of
+        holding input + output live simultaneously.  Model args are
+        NEVER donated (they persist across batches).  CPU ignores
+        donation (and would warn per call), so the list is empty there —
+        same contract as mesh._concat_placed_fn."""
+        import jax
+
+        if not knobs.knob_bool("FMT_FUSE_DONATE"):
+            return ()
+        if jax.default_backend() == "cpu":
+            return ()
+        return tuple(range(len(self.data_descs)))
+
     def _apply_fn(self, mesh):
-        fn = self._apply_fns.get(mesh)
+        width = self._mesh_width(mesh)
+        donate = self._donate_argnums()
+        key = (mesh, width > 1, donate)
+        fn = self._apply_fns.get(key)
         if fn is not None:
             return fn
         import jax
 
-        from flink_ml_tpu.parallel.mesh import data_parallel_size
-
         fused = self._fused_fn()
-        if self.has_csr or data_parallel_size(mesh) == 1:
-            # sparse inputs follow the staged sparse-score contract (plain
-            # jit, process-local); a 1-wide data axis degenerates anyway
-            fn = jax.jit(fused)
+        if width == 1:
+            # a 1-wide data axis (or FMT_SERVE_MESH=0) degenerates to the
+            # plain single-logical-device program
+            fn = jax.jit(fused, donate_argnums=donate)
         else:
             from jax.sharding import PartitionSpec as P
 
             from flink_ml_tpu.parallel.collectives import shard_map
 
+            # P('data') is a pytree-prefix spec: a dense batch shards its
+            # rows, a ShardedCsrBatch shards each flat (n_shards*nnz_pad,)
+            # leaf — handing every device exactly its rows' entries
             in_specs = tuple(
                 [P("data")] * len(self.data_descs)
                 + [P()] * len(self.model_args)
@@ -250,22 +370,20 @@ class FusedRun:
             fn = jax.jit(shard_map(
                 fused, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
-            ))
-        self._apply_fns[mesh] = fn
+            ), donate_argnums=donate)
+        self._apply_fns[key] = fn
         return fn
 
     # -- per-batch execution --------------------------------------------------
 
     def _bucket(self, n: int, row_multiple: int) -> int:
-        from flink_ml_tpu.lib.common import _bucket_for, bucket_rows
+        from flink_ml_tpu.lib.common import _bucket_for
 
-        if self.has_csr:
-            # staged sparse scoring buckets without a row-multiple (plain
-            # jit); the whole run follows so every input shares one bucket
-            return bucket_rows(max(n, 1))
-        # dense inputs ride the shared batch-shape ladder
-        # (utils/compile_cache.bucket_batch_rows, via _bucket_for): fused
-        # plans, staged applies, and serving micro-batches pad identically
+        # every input — dense AND segment-CSR — rides the shared batch-
+        # shape ladder (utils/compile_cache.bucket_batch_rows, via
+        # _bucket_for), rounded up to the mesh's data-axis size: fused
+        # plans, staged applies, and serving micro-batches pad
+        # identically, and every shard_map sees equal row shards
         return _bucket_for(n, 256, row_multiple)
 
     def _extract(self, batch: Table, b: int, mesh, row_multiple: int):
@@ -289,14 +407,26 @@ class FusedRun:
                                dtype=np.float32)
                 args.append(_pad_rows_to(X, b))
             else:  # csr
-                from flink_ml_tpu.ops.batch import CsrBatch
+                from flink_ml_tpu.ops.batch import CsrBatch, ShardedCsrBatch
 
                 _, col, dim = desc
                 csr = batch.features_csr(col, n_cols=dim)
-                args.append(CsrBatch(
+                padded = CsrBatch(
                     csr.indices, csr.values, csr.row_ids,
                     n_rows=b, n_cols=csr.n_cols,
-                ))
+                )
+                if row_multiple > 1:
+                    # SPMD serving (ISSUE 15): re-lay out shard-major so
+                    # P('data') placement hands each device its rows'
+                    # entries; per-shard nnz pads to one agreed width
+                    # (the agree_max idiom — pad entries are weight-0)
+                    args.append(ShardedCsrBatch.from_csr_batch(
+                        padded, n_shards=row_multiple,
+                        rows_per_shard=b // row_multiple,
+                        pad_multiple=knobs.knob_int("FMT_SERVE_CSR_PAD"),
+                    ))
+                else:
+                    args.append(padded)
         placed = []
         for a in args:
             placed.append(_try_place(a, mesh, row_multiple))
@@ -376,17 +506,22 @@ class FusedRun:
 
     def _device_batch(self, mesh, n: int, args):
         """The single fused dispatch for one batch: (re)place -> one jitted
-        call -> one bundled fetch -> per-stage host finalize."""
+        call -> one bundled fetch -> per-stage host finalize.  On a
+        multi-device mesh the call is the shard_map program — one SPMD
+        dispatch whose per-device outputs come back in the same single
+        bundled fetch (``fused.shard_map_dispatches`` proves the path)."""
         import jax
         import jax.numpy as jnp
 
         from flink_ml_tpu.lib.common import fetch_flat
 
         pressure.maybe_oom(n)
+        width = self._mesh_width(mesh)
+        b = _padded_rows(args)
         t0 = time.perf_counter()
         with obs.trace.span("fused_dispatch", {
             "rows": n, "plan": self.serve_name,
-            "stages": len(self.device_stages),
+            "stages": len(self.device_stages), "mesh_devices": width,
         }):
             placed = [
                 a if isinstance(a, jax.Array)
@@ -399,6 +534,11 @@ class FusedRun:
             # device-execution window of the fused program
             with obs.trace.span("device_sync"):
                 fetched = fetch_flat(*res)
+        if width > 1:
+            obs.counter_add("fused.shard_map_dispatches")
+            _note_device_rows(n, b, width)
+        if b > n:
+            obs.counter_add("fused.padded_rows", b - n)
         out: Dict[str, Sequence] = {}
         i = 0
         for ds in self.device_stages:
@@ -443,7 +583,14 @@ class FusedRun:
 
         def fn(lo, hi):
             if lo == 0 and hi == n:
-                return self._device_batch(mesh, n, args)
+                use = args
+                if _args_deleted(args):
+                    # a previous donated dispatch consumed the buffers
+                    # (an OOM'd attempt whose donation already landed):
+                    # re-extract rather than dispatch deleted arrays
+                    b = self._bucket(n, row_multiple)
+                    use = self._extract(t, b, mesh, row_multiple)
+                return self._device_batch(mesh, n, use)
             sub = t.slice_rows(lo, hi)
             b = self._bucket(hi - lo, row_multiple)
             sub_args = self._extract(sub, b, mesh, row_multiple)
@@ -451,6 +598,7 @@ class FusedRun:
 
         return pressure.run_bisected(
             fn, n, surface=self.serve_name, floor=max(1, row_multiple),
+            n_dev=row_multiple,
         )
 
     def _staged_batch(self, t: Table, offset: int):
@@ -471,14 +619,19 @@ class FusedRun:
 
     def execute(self, table: Table) -> Table:
         from flink_ml_tpu import serve
-        from flink_ml_tpu.parallel.mesh import data_parallel_size, \
-            inference_mesh
+        from flink_ml_tpu.parallel.mesh import inference_mesh, \
+            mesh_spans_processes
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
         from flink_ml_tpu.utils.prefetch import prefetch_iter
 
         obs.counter_add("inference.rows", table.num_rows())
         mesh = inference_mesh(MLEnvironmentFactory.get_default().get_mesh())
-        row_multiple = data_parallel_size(mesh)
+        row_multiple = self._mesh_width(mesh)
+        obs.gauge_set("fused.mesh_devices", row_multiple)
+        # a mesh spanning processes (never the default inference_mesh)
+        # must agree its breaker verdict open-wins across the mesh, or a
+        # collective-bearing program would split device-vs-fallback
+        agreed = mesh_spans_processes(mesh)
         field_order = self.exit_schema.field_names
         out_names = sorted(
             self.device_cols | set(self.batch_cols), key=field_order.index
@@ -519,6 +672,7 @@ class FusedRun:
                         mesh, t, n, args, row_multiple
                     ),
                     fallback=lambda: self._staged_batch(t, offset),
+                    agreed=agreed,
                 )
             for name in self.batch_cols:
                 out[name] = t.col(name)
@@ -539,25 +693,71 @@ class FusedRun:
         return Table.from_columns(self.exit_schema, cols)
 
 
+def _padded_rows(args) -> int:
+    """The padded row count a batch's extracted args carry (0 when the
+    args hold no row-shaped value — never the case for a real plan)."""
+    from flink_ml_tpu.ops.batch import CsrBatch, ShardedCsrBatch
+
+    for a in args:
+        if isinstance(a, ShardedCsrBatch):
+            return a.n_shards * a.rows_per_shard
+        if isinstance(a, CsrBatch):
+            return a.n_rows
+        shape = getattr(a, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
+
+
+def _args_deleted(args) -> bool:
+    """Any leaf buffer already consumed by a donated dispatch?"""
+    import jax
+
+    return any(
+        hasattr(x, "is_deleted") and x.is_deleted()
+        for x in jax.tree_util.tree_leaves(list(args))
+    )
+
+
 def _try_place(a, mesh, row_multiple: int):
     """Best-effort async H2D on the producer thread; a transient placement
-    failure hands the host array through so the consumer's retried dispatch
-    (and, past that, the per-stage fallback) still gets its shot.  An
-    allocator OOM passes the host array through too: the placement retried
-    at dispatch time raises INSIDE the bisection wrapper, where pressure
-    recovery can split the batch (an OOM raised here would surface on the
-    prefetch producer thread, outside any recovery scope)."""
+    failure hands the host array/pytree through so the consumer's retried
+    dispatch (and, past that, the per-stage fallback) still gets its shot.
+    An allocator OOM passes the host value through too: the placement
+    retried at dispatch time raises INSIDE the bisection wrapper, where
+    pressure recovery can split the batch (an OOM raised here would
+    surface on the prefetch producer thread, outside any recovery scope).
+
+    Ragged rows (ISSUE 15 satellite): a ``P('data')`` placement needs dim
+    0 divisible by the data-axis size.  The bucket ladder hands every
+    fused surface a divisible row count already, but a caller arriving
+    with a ragged batch (a bisection sub-range below ``row_multiple``, a
+    hand-built batch) is PADDED here with zero rows — weight-0/masked on
+    every row-aligned fused kernel, sliced off with the bucket's own pad
+    before finalize — instead of erroring out of the sharded path."""
     import jax
 
     from flink_ml_tpu.fault.pressure import is_oom
     from flink_ml_tpu.fault.retry import is_transient
+    from flink_ml_tpu.ops.batch import ShardedCsrBatch
 
-    if not isinstance(a, np.ndarray):
-        return a  # CsrBatch pytrees place at call time, as staged
+    sharded_csr = isinstance(a, ShardedCsrBatch)
+    if not sharded_csr and not isinstance(a, np.ndarray):
+        return a  # unsharded CsrBatch pytrees place at call time, as staged
     try:
         if row_multiple > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            if not sharded_csr and a.shape[0] % row_multiple:
+                from flink_ml_tpu.lib.common import _pad_rows_to
+
+                a = _pad_rows_to(
+                    a, -(-a.shape[0] // row_multiple) * row_multiple
+                )
+            # device_put maps a single sharding over a pytree's leaves:
+            # a ShardedCsrBatch's three flat arrays are (n_shards *
+            # nnz_pad,), so P('data') lands each shard's slice on its
+            # device
             return jax.device_put(a, NamedSharding(mesh, P("data")))
         return jax.device_put(a)
     except Exception as exc:  # noqa: BLE001 - transient-filtered

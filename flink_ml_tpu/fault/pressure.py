@@ -66,6 +66,7 @@ __all__ = [
     "OOM_POINT",
     "PressureState",
     "current_caps",
+    "current_limits",
     "enabled",
     "is_oom",
     "maybe_oom",
@@ -160,26 +161,41 @@ class PressureState:
     :meth:`admit` runs the additive probe — after ``FMT_PRESSURE_PROBE_S``
     of calm the cap steps up by 1/8 of the largest size ever admitted,
     and clears entirely once it reaches that size (full recovery,
-    counted in ``pressure.resizes``)."""
+    counted in ``pressure.resizes``).
+
+    **Per-device denomination** (ISSUE 15): ``cap``/``full`` are stored
+    in PER-DEVICE rows.  A mesh-sharded surface passes its data-axis
+    width as ``n_dev``: the failing global batch divides by the device
+    count before the halving, so an OOM on an 8-device mesh shrinks to
+    what ONE device could not hold — not to a 1-device floor for the
+    whole mesh — and a cap learned at one mesh width admits the right
+    global row count at another.  Single-device callers (``n_dev=1``,
+    the default) see exactly the original semantics; the
+    ``pressure.cap.<surface>`` gauge publishes the per-device number."""
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
-        self.cap: Optional[int] = None
-        self.full = 0            # largest row count ever admitted
+        self.cap: Optional[int] = None   # per-device rows
+        self.full = 0            # largest per-device row count ever admitted
         self.ooms = 0
         self._last_change = 0.0  # monotonic stamp of the last cap move
+        self.n_dev = 1           # row-shard width of the last admit/shrink
 
     def _publish_locked(self) -> None:
         obs.gauge_set(f"pressure.cap.{self.name}",
                       float(self.cap if self.cap is not None else 0))
 
-    def admit(self, n: int) -> int:
-        """Rows allowed per dispatch for a request of ``n`` rows — runs
-        the additive up-probe when the surface has been calm."""
+    def admit(self, n: int, n_dev: int = 1) -> int:
+        """GLOBAL rows allowed per dispatch for a request of ``n`` rows
+        over ``n_dev`` row shards — runs the additive up-probe when the
+        surface has been calm."""
+        n_dev = max(1, int(n_dev))
+        per = -(-int(n) // n_dev)  # ceil: this dispatch's per-device rows
         with self._lock:
-            if n > self.full:
-                self.full = n
+            self.n_dev = n_dev
+            if per > self.full:
+                self.full = per
             if self.cap is None:
                 return n
             now = time.monotonic()
@@ -198,13 +214,19 @@ class PressureState:
                 self._publish_locked()
                 obs.flight.record("pressure.resize", surface=self.name,
                                   cap=self.cap)
-            return min(n, self.cap)
+            return min(n, self.cap * n_dev)
 
-    def shrink(self, failed_rows: int, floor: int = 1) -> int:
-        """Multiplicative decrease after ``failed_rows`` OOM'd; returns
-        the new cap (never below ``floor``)."""
+    def shrink(self, failed_rows: int, floor: int = 1,
+               n_dev: int = 1) -> int:
+        """Multiplicative decrease after a GLOBAL batch of
+        ``failed_rows`` OOM'd across ``n_dev`` shards; returns the new
+        per-device cap (never below ``floor``'s per-device share)."""
+        n_dev = max(1, int(n_dev))
+        per_failed = -(-int(failed_rows) // n_dev)
+        per_floor = max(1, -(-int(floor) // n_dev))
         with self._lock:
-            new_cap = max(int(floor), int(failed_rows) // 2)
+            self.n_dev = n_dev
+            new_cap = max(per_floor, per_failed // 2)
             if self.cap is None or new_cap < self.cap:
                 self.cap = new_cap
             self._last_change = time.monotonic()
@@ -213,15 +235,29 @@ class PressureState:
             return self.cap
 
     def current_cap(self) -> Optional[int]:
+        """The PER-DEVICE cap (None = no pressure)."""
         with self._lock:
             return self.cap
 
-    def capped_below(self, n: int) -> bool:
-        """Would a dispatch of ``n`` rows exceed the current cap?  The
-        cheap pre-check callers use to skip work (pooled full-size
-        placement) that pressure would immediately undo."""
+    def current_limit(self) -> Optional[int]:
+        """The cap in GLOBAL rows at the surface's last dispatch width
+        (None = no pressure) — the readiness-floor denomination."""
+        with self._lock:
+            return None if self.cap is None else self.cap * self.n_dev
+
+    def limit_rows(self, n_dev: int = 1) -> Optional[int]:
+        """The cap in GLOBAL rows for an ``n_dev``-shard dispatch (None
+        = no pressure)."""
         cap = self.current_cap()
-        return cap is not None and cap < n
+        return None if cap is None else cap * max(1, int(n_dev))
+
+    def capped_below(self, n: int, n_dev: int = 1) -> bool:
+        """Would a dispatch of ``n`` global rows over ``n_dev`` shards
+        exceed the current cap?  The cheap pre-check callers use to skip
+        work (pooled full-size placement) that pressure would
+        immediately undo."""
+        limit = self.limit_rows(n_dev)
+        return limit is not None and limit < n
 
 
 _STATES: Dict[str, PressureState] = {}
@@ -239,9 +275,10 @@ def state(name: str) -> PressureState:
 
 def current_caps() -> Dict[str, int]:
     """Every surface currently under pressure: ``{surface: cap}`` for
-    states whose cap is active (a cleared surface drops out).  The
-    telemetry plane's ``/readyz``/``/statusz`` read this — a cap pinned
-    below the readiness floor marks the process unready."""
+    states whose cap is active (a cleared surface drops out).  Caps are
+    PER-DEVICE rows (ISSUE 15) — the ``pressure.cap.<surface>`` gauge's
+    denomination; readiness floors compare against
+    :func:`current_limits` instead."""
     with _STATES_LOCK:
         states = list(_STATES.values())
     out: Dict[str, int] = {}
@@ -249,6 +286,23 @@ def current_caps() -> Dict[str, int]:
         cap = st.current_cap()
         if cap is not None:
             out[st.name] = cap
+    return out
+
+
+def current_limits() -> Dict[str, int]:
+    """Every surface currently under pressure: ``{surface: limit}`` in
+    GLOBAL rows per dispatch — the per-device cap multiplied by the
+    row-shard width the surface last dispatched at.  The telemetry
+    plane's ``/readyz`` floor check reads this: an 8-device surface
+    serving 32-row batches is capped at 4 rows PER DEVICE, which must
+    not read as below an 8-global-row floor."""
+    with _STATES_LOCK:
+        states = list(_STATES.values())
+    out: Dict[str, int] = {}
+    for st in states:
+        limit = st.current_limit()
+        if limit is not None:
+            out[st.name] = limit
     return out
 
 
@@ -313,20 +367,22 @@ def _note_oom(st: PressureState, surface: str, rows: int,
 
 
 def note_oom(surface: str, rows: int, exc: BaseException,
-             floor: int = 1) -> PressureState:
+             floor: int = 1, n_dev: int = 1) -> PressureState:
     """Record one allocator OOM against ``surface`` and shrink its cap
     (counters + flight event + AIMD decrease) — for recovery paths that
     switch execution strategy instead of bisecting in place (the training
     micro-batch fallback, the serving dispatcher's request-boundary
-    split).  Returns the surface's state."""
+    split).  ``n_dev`` denominates the cap per device for mesh-sharded
+    surfaces.  Returns the surface's state."""
     st = state(surface)
     _note_oom(st, surface, rows, exc)
-    st.shrink(rows, floor=floor)
+    st.shrink(rows, floor=floor, n_dev=n_dev)
     return st
 
 
 def run_bisected(fn: Callable, n: int, *, surface: str, floor: int = 1,
-                 concat: Optional[Callable] = None, evict: bool = True):
+                 concat: Optional[Callable] = None, evict: bool = True,
+                 n_dev: int = 1):
     """Run ``fn(lo, hi)`` over the row range ``[0, n)`` with adaptive
     OOM recovery; returns the row-concatenated results.
 
@@ -340,11 +396,15 @@ def run_bisected(fn: Callable, n: int, *, surface: str, floor: int = 1,
     floor-sized batch).  The surface's :class:`PressureState` remembers
     the working size so subsequent batches chunk directly instead of
     re-discovering it, and AIMD probes restore full batches once
-    pressure clears."""
+    pressure clears.  ``n_dev`` is the dispatch's row-shard count: the
+    surface's cap is per-device-denominated (see
+    :class:`PressureState`), so a mesh-wide OOM halves the PER-DEVICE
+    share rather than collapsing the global batch toward a one-device
+    floor."""
     if n <= 0 or not enabled():
         return fn(0, n)
     st = state(surface)
-    limit = st.admit(n)
+    limit = st.admit(n, n_dev=n_dev)
     pieces = []
     lo = 0
     evicted_once = False
@@ -355,7 +415,7 @@ def run_bisected(fn: Callable, n: int, *, surface: str, floor: int = 1,
         try:
             pieces.append(fn(lo, lo + size))
             lo += size
-            cap = st.current_cap()
+            cap = st.limit_rows(n_dev)
             limit = min(n - lo, cap) if cap is not None else n - lo
             continue
         except Exception as exc:  # noqa: BLE001 - OOM-filtered below
@@ -371,7 +431,8 @@ def run_bisected(fn: Callable, n: int, *, surface: str, floor: int = 1,
                     continue  # retry the same size with the slabs freed
             if size <= floor:
                 raise  # cannot shrink further: surface the true error
-            limit = st.shrink(size, floor=floor)
+            st.shrink(size, floor=floor, n_dev=n_dev)
+            limit = st.limit_rows(n_dev) or floor
             obs.counter_add("pressure.bisections")
             obs.counter_add(f"pressure.bisections.{surface}")
             obs.flight.record("pressure.bisect", surface=surface,

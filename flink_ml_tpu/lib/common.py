@@ -2431,7 +2431,10 @@ def _train_glm_pressure(init_params, stack: MinibatchStack,
     epoch = 0
 
     def window_steps() -> int:
-        cap = st.current_cap()
+        # limit_rows converts the per-device cap back to mesh-global rows
+        # (ISSUE 15): an 8-device window shrinks to what one device
+        # couldn't hold, not to a 1-device budget for the whole mesh
+        cap = st.limit_rows(n_dev)
         if cap is None:
             return steps
         return max(1, min(steps, cap // max(group_rows, 1)))
@@ -2439,14 +2442,15 @@ def _train_glm_pressure(init_params, stack: MinibatchStack,
     while epoch < max_iter:
         if tol_ > 0.0 and epoch > 0 and float(delta) <= tol_:
             break
-        st.admit(comb.shape[0] * mb)  # AIMD up-probe between epochs
+        # AIMD up-probe between epochs
+        st.admit(comb.shape[0] * mb, n_dev=n_dev)
         start = params
         ep_losses: list = []
         ep_counts: list = []
         s = 0
         while s < steps:
             w = min(window_steps(), steps - s)
-            cap = st.current_cap()
+            cap = st.limit_rows(n_dev)
             if w == 1 and cap is not None and cap < group_rows:
                 # the cap already says ONE step cannot fit: go straight
                 # to gradient accumulation instead of paying a doomed
@@ -2479,14 +2483,15 @@ def _train_glm_pressure(init_params, stack: MinibatchStack,
                     raise
                 if w > 1:
                     pressure.note_oom(_TRAIN_PRESSURE_SURFACE, rows, exc,
-                                      floor=group_rows)
+                                      floor=group_rows, n_dev=n_dev)
                     obs.counter_add("pressure.bisections")
                     obs.counter_add(
                         f"pressure.bisections.{_TRAIN_PRESSURE_SURFACE}"
                     )
                     continue  # same step range, smaller window
                 # a single step is too big on its own: accumulate
-                pressure.note_oom(_TRAIN_PRESSURE_SURFACE, rows, exc)
+                pressure.note_oom(_TRAIN_PRESSURE_SURFACE, rows, exc,
+                                  n_dev=n_dev)
                 params, loss1, count1 = _pressure_accum_step(
                     params, comb[idx], mesh, grad_fn, learning_rate, reg
                 )
@@ -2575,10 +2580,15 @@ def train_glm(
 
     if not listeners and checkpoint is None:
         from flink_ml_tpu.fault import pressure
+        from flink_ml_tpu.parallel.mesh import data_parallel_size
 
         row_slots = stack.x.shape[0] * stack.mb
+        # per-device-denominated caps (ISSUE 15): an OOM shrinks what ONE
+        # device could not hold, so the mesh width scales the global cap
+        n_dev_mesh = data_parallel_size(mesh)
         st = pressure.state(_TRAIN_PRESSURE_SURFACE)
-        if pressure.enabled() and st.capped_below(row_slots):
+        if pressure.enabled() and st.capped_below(row_slots,
+                                                 n_dev=n_dev_mesh):
             # known pressure from an earlier fit: go straight to the
             # micro-batch path at the remembered window (no failing
             # whole-batch probe); the AIMD up-probe inside restores the
@@ -2616,7 +2626,8 @@ def train_glm(
 
             device_batch = None
             slab_pool.evict_for_pressure()
-            pressure.note_oom(_TRAIN_PRESSURE_SURFACE, row_slots, exc)
+            pressure.note_oom(_TRAIN_PRESSURE_SURFACE, row_slots, exc,
+                              n_dev=n_dev_mesh)
             return _train_glm_pressure(
                 init_params, stack, grad_fn, mesh, learning_rate, reg,
                 max_iter, tol,
@@ -2790,7 +2801,8 @@ def apply_sharded(apply_factory, X: np.ndarray, *args,
 
         if not slab_pool.enabled():
             pool_key = None  # skip tokenization entirely: pooling is off
-        elif pressure.state("apply").capped_below(X.shape[0]):
+        elif pressure.state("apply").capped_below(X.shape[0],
+                                                  n_dev=row_multiple):
             # active memory pressure: the pooled path would place the
             # FULL padded batch the cap says cannot fit — go straight to
             # the bisected unpooled path below
@@ -2904,4 +2916,5 @@ def apply_batched(
         return np.asarray(out)[: hi - lo]
 
     return fault.run_bisected(run, n, surface="apply",
-                              floor=max(1, row_multiple))
+                              floor=max(1, row_multiple),
+                              n_dev=row_multiple)

@@ -538,11 +538,15 @@ def _builtin_reasons() -> List[dict]:
         from flink_ml_tpu.fault import pressure
 
         floor = pressure_floor()
-        for surface, cap in sorted(pressure.current_caps().items()):
-            if cap < floor:
+        # the floor is GLOBAL rows per dispatch: compare against each
+        # surface's mesh-wide limit, not the per-device cap (ISSUE 15 —
+        # an 8-device surface serving 32-row batches holds a per-device
+        # cap of 4, which must not read as below an 8-row floor)
+        for surface, limit in sorted(pressure.current_limits().items()):
+            if limit < floor:
                 reasons.append({
                     "reason": "memory_pressure",
-                    "detail": (f"{surface} capped at {cap} rows "
+                    "detail": (f"{surface} capped at {limit} rows "
                                f"(floor {floor})"),
                 })
     except Exception as exc:  # noqa: BLE001
@@ -606,9 +610,11 @@ def status_snapshot() -> dict:
     try:
         from flink_ml_tpu.fault import pressure
 
-        out["pressure_caps"] = pressure.current_caps()
+        out["pressure_caps"] = pressure.current_caps()  # per-device rows
+        out["pressure_limits"] = pressure.current_limits()  # global rows
     except Exception:  # noqa: BLE001
         out["pressure_caps"] = {}
+        out["pressure_limits"] = {}
     try:
         from flink_ml_tpu.obs import flight
 

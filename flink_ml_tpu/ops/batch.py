@@ -319,3 +319,119 @@ class CsrBatch:
     def __repr__(self) -> str:
         return (f"CsrBatch(n_rows={self.n_rows}, n_cols={self.n_cols}, "
                 f"nnz_padded={self.nnz_padded})")
+
+
+@jax.tree_util.register_pytree_node_class
+class ShardedCsrBatch:
+    """A :class:`CsrBatch` re-laid-out shard-major for SPMD serving.
+
+    The segment-CSR layout shards by ROWS: shard ``d`` of ``n_shards``
+    owns the contiguous row range ``[d*rows_per_shard, (d+1)*rows_per_shard)``
+    and holds its entries in its own ``nnz_pad``-wide slice of the three
+    flat arrays — shape ``(n_shards * nnz_pad,)`` — with row ids rewritten
+    LOCAL to the shard.  Placing the leaves with ``P('data')`` therefore
+    hands every mesh device exactly its rows' entries, and inside a
+    ``shard_map`` the local leaves reassemble into an ordinary local
+    :class:`CsrBatch` (:meth:`local`) whose ``matvec`` needs no
+    collectives.
+
+    ``nnz_pad`` is one agreed width for every shard — the ``agree_max``
+    idiom from the sparse training pack applied across the mesh's row
+    shards: each shard's true nnz differs, all shards take the MAX
+    (padded to ``pad_multiple``), and pad entries carry value 0 with row
+    id ``rows_per_shard`` (the dropped segment), so padding is free and
+    every shard compiles the one identical program.  (The serving mesh is
+    process-local by construction — ``inference_mesh`` — so the agreement
+    is a host-side max, never a cross-process collective.)
+    """
+
+    def __init__(self, indices, values, row_ids, n_shards: int,
+                 rows_per_shard: int, n_cols: int, nnz_pad: int):
+        self.indices = indices
+        self.values = values
+        self.row_ids = row_ids
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = int(rows_per_shard)
+        self.n_cols = int(n_cols)
+        self.nnz_pad = int(nnz_pad)
+
+    @staticmethod
+    def from_csr_batch(csr: "CsrBatch", n_shards: int,
+                       rows_per_shard: int,
+                       pad_multiple: int = 512) -> "ShardedCsrBatch":
+        """Re-shard a (host-convertible) CsrBatch's entries by row range.
+
+        ``n_shards * rows_per_shard`` must cover ``csr.n_rows`` (the
+        caller pads rows to the bucket first); rows past ``csr.n_rows``
+        simply own no entries — the weight-0 pad-row contract.
+        """
+        total_rows = n_shards * rows_per_shard
+        if total_rows < csr.n_rows:
+            raise ValueError(
+                f"{n_shards} shards x {rows_per_shard} rows cannot hold "
+                f"{csr.n_rows} rows"
+            )
+        idx = np.asarray(csr.indices)
+        vals = np.asarray(csr.values)
+        rid = np.asarray(csr.row_ids)
+        real = rid < csr.n_rows  # pad entries carry row id n_rows
+        idx, vals, rid = idx[real], vals[real], rid[real]
+        # entries are row-major from the packers, but from_arrays makes no
+        # ordering promise — a stable sort keeps each row's entries in
+        # their original order (bit-identical per-row summation)
+        if rid.size and np.any(np.diff(rid) < 0):
+            order = np.argsort(rid, kind="stable")
+            idx, vals, rid = idx[order], vals[order], rid[order]
+        bounds = np.searchsorted(
+            rid, np.arange(0, total_rows + 1, rows_per_shard)
+        )
+        per_shard = np.diff(bounds)
+        # the agree_max idiom: every shard adopts the max nnz, padded to a
+        # bucket multiple so varying sparsity reuses one compiled program
+        pad_multiple = max(int(pad_multiple), 1)
+        nnz_pad = _round_up(max(int(per_shard.max(initial=0)), 1),
+                            pad_multiple)
+        out_idx = np.zeros(n_shards * nnz_pad, dtype=np.int32)
+        out_vals = np.zeros(n_shards * nnz_pad, dtype=np.float32)
+        # pad row id = rows_per_shard: the per-shard dropped segment
+        out_rid = np.full(n_shards * nnz_pad, rows_per_shard,
+                          dtype=np.int32)
+        for d in range(n_shards):
+            lo, hi = int(bounds[d]), int(bounds[d + 1])
+            cnt = hi - lo
+            if not cnt:
+                continue
+            at = d * nnz_pad
+            out_idx[at:at + cnt] = idx[lo:hi]
+            out_vals[at:at + cnt] = vals[lo:hi]
+            out_rid[at:at + cnt] = rid[lo:hi] - d * rows_per_shard
+        return ShardedCsrBatch(
+            out_idx, out_vals, out_rid, n_shards=n_shards,
+            rows_per_shard=rows_per_shard, n_cols=csr.n_cols,
+            nnz_pad=nnz_pad,
+        )
+
+    def local(self) -> CsrBatch:
+        """The per-shard CsrBatch view — called INSIDE a shard_map, where
+        each leaf is this shard's ``(nnz_pad,)`` slice and row ids are
+        already local."""
+        return CsrBatch(self.indices, self.values, self.row_ids,
+                        n_rows=self.rows_per_shard, n_cols=self.n_cols)
+
+    # -- pytree protocol ----------------------------------------------------
+
+    def tree_flatten(self):
+        return (
+            (self.indices, self.values, self.row_ids),
+            (self.n_shards, self.rows_per_shard, self.n_cols, self.nnz_pad),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, n_shards=aux[0], rows_per_shard=aux[1],
+                   n_cols=aux[2], nnz_pad=aux[3])
+
+    def __repr__(self) -> str:
+        return (f"ShardedCsrBatch(n_shards={self.n_shards}, "
+                f"rows_per_shard={self.rows_per_shard}, "
+                f"n_cols={self.n_cols}, nnz_pad={self.nnz_pad})")

@@ -312,6 +312,21 @@ def shard_batch_specs(mesh: Mesh, arrays: Sequence, specs: Sequence[P]):
     )
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """Does this mesh hold devices owned by more than one process?
+
+    The serving stack's breaker/pressure agreement trigger: a dispatch
+    surface whose mesh crosses processes must agree degradation decisions
+    (open-wins ``agree_max``) or a collective-bearing program would split
+    between a device path and a fallback path.  Single-process — and the
+    process-local :func:`inference_mesh` — always answer False, keeping
+    the default serving contract collective-free."""
+    if jax.process_count() == 1:
+        return False
+    pi = jax.process_index()
+    return any(d.process_index != pi for d in mesh.devices.flat)
+
+
 def inference_mesh(mesh: Mesh) -> Mesh:
     """The mesh model-apply paths run on: the session mesh single-process;
     multi-process, a LOCAL data-parallel mesh over this process's devices.

@@ -186,6 +186,19 @@ class ModelServer:
             deadline_ms=deadline_ms,
             shed_on_breaker=shed_on_breaker,
         )
+        # mesh-aware coalescing (ISSUE 15): the transform below shards
+        # every fused dispatch over the mesh's data axis, so a full flush
+        # should feed EVERY device — the knob-default coalescing target
+        # scales to mesh_size x FMT_SERVING_MAX_BATCH.  An explicit
+        # max_batch argument is the caller's number and stays verbatim.
+        self._mesh_devices = self._serving_mesh_width()
+        if max_batch is None and self._mesh_devices > 1:
+            import dataclasses
+
+            self.config = dataclasses.replace(
+                self.config,
+                max_batch=self.config.max_batch * self._mesh_devices,
+            )
         # a coalesced dispatch must stay a SINGLE internal transform batch:
         # past the environment batch size the fused path switches to its
         # prefetch-producer thread, which the dispatcher's thread-local
@@ -247,6 +260,7 @@ class ModelServer:
         self._telemetry = None
         self._slo = None
         self._status_key: Optional[str] = None
+        self._mesh_status_key: Optional[str] = None
         from flink_ml_tpu.obs import telemetry as _telemetry_mod
 
         port = (telemetry_port if telemetry_port is not None
@@ -452,6 +466,13 @@ class ModelServer:
             # /statusz gains the per-column drift section
             self._drift_status_key = telemetry_mod.register_status(
                 "drift", self._drift.status)
+        if self._mesh_devices > 1:
+            # /statusz gains the per-device row-share breakdown of the
+            # SPMD fused dispatches this server's transforms run
+            from flink_ml_tpu.common import fused as fused_mod
+
+            self._mesh_status_key = telemetry_mod.register_status(
+                "mesh", fused_mod.mesh_status)
         self._slo = slo_mod.SLOMonitor(drift=self._drift).start()
 
     def _stop_telemetry(self) -> None:
@@ -468,6 +489,9 @@ class ModelServer:
             if self._drift_status_key is not None:
                 telemetry_mod.unregister_status(self._drift_status_key)
                 self._drift_status_key = None
+            if self._mesh_status_key is not None:
+                telemetry_mod.unregister_status(self._mesh_status_key)
+                self._mesh_status_key = None
             self._telemetry.stop()
             self._telemetry = None
         if self._drift is not None:
@@ -806,9 +830,14 @@ class ModelServer:
         schema = None
         # under memory pressure the coalescing target shrinks to the last
         # working batch size (and AIMD-probes back toward max_batch) —
-        # one OOM must not re-split every subsequent coalesced dispatch
+        # one OOM must not re-split every subsequent coalesced dispatch.
+        # The cap is per-device-denominated (ISSUE 15): an OOM on an
+        # 8-device mesh shrinks the per-device share, not the whole
+        # mesh's batch to a 1-device floor.  The width is read LIVE (not
+        # the construction-time cache) so a mid-flight FMT_SERVE_MESH
+        # flip keeps the pressure accounting on the actual dispatch width
         max_rows = pressure.state(_SERVING_SURFACE).admit(
-            self.config.max_batch
+            self.config.max_batch, n_dev=self._serving_mesh_width()
         )
         track_bytes = bool(self.config.queue_cap_bytes)
         while self._queue:
@@ -892,7 +921,8 @@ class ModelServer:
                     and len(requests) > 1):
                 raise
             n_rows = sum(r.n_rows for r in requests)
-            pressure.note_oom(_SERVING_SURFACE, n_rows, exc)
+            pressure.note_oom(_SERVING_SURFACE, n_rows, exc,
+                              n_dev=self._serving_mesh_width())
             obs.counter_add("pressure.bisections")
             obs.counter_add(f"pressure.bisections.{_SERVING_SURFACE}")
             obs.counter_add("serving.pressure_splits")
@@ -1011,6 +1041,23 @@ class ModelServer:
         )
 
     # -- accounting ----------------------------------------------------------
+
+    @staticmethod
+    def _serving_mesh_width() -> int:
+        """The data-axis width the transforms below this server dispatch
+        over — 1 when ``FMT_SERVE_MESH`` pins serving to one device."""
+        from flink_ml_tpu.common.fused import serve_mesh_enabled
+        from flink_ml_tpu.parallel.mesh import (
+            data_parallel_size,
+            inference_mesh,
+        )
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        if not serve_mesh_enabled():
+            return 1
+        return data_parallel_size(
+            inference_mesh(MLEnvironmentFactory.get_default().get_mesh())
+        )
 
     @staticmethod
     def _single_batch_rows() -> int:

@@ -197,6 +197,12 @@ DECLARATIONS: Tuple[Knob, ...] = (
     # -- device data plane ------------------------------------------------
     Knob("FMT_FUSE_TRANSFORM", "1", "bool",
          "Fuse kernel-capable pipeline stages into one dispatch per batch."),
+    Knob("FMT_SERVE_MESH", "1", "bool",
+         "SPMD fused serving over the mesh data axis (0 = one device)."),
+    Knob("FMT_SERVE_CSR_PAD", "512", "int",
+         "Per-shard nnz pad multiple for mesh-sharded segment-CSR serving."),
+    Knob("FMT_FUSE_DONATE", "1", "bool",
+         "Donate placed batch buffers to the fused serving dispatch."),
     Knob("FMT_SLAB_POOL", "1", "bool",
          "Cross-fit device slab pool for placed training batches."),
     Knob("FMT_SLAB_POOL_BUDGET_MB", "4096", "int",

@@ -143,6 +143,28 @@ the ``ContinuousLearningController``'s validation gate:
      the server back to the prior version through the
      integrity-verified swap path (``lifecycle.rollbacks``, black box).
 
+**Multi-chip mode** (``--multichip``, ISSUE 15): the SPMD serving
+counterpart — the fused mesh path on the 8 fake devices this smoke
+already forces:
+
+  1. **sharded path proof** — a dense 2-stage chain AND a categorical
+     segment-CSR chain (indexer -> encoder -> sparse LR) must dispatch
+     EVERY fused batch through ``shard_map``
+     (``fused.shard_map_dispatches == pipeline.fused_dispatches``, zero
+     plan fallbacks) — the CSR single-device bypass is gone;
+  2. **injected OOM under load** — a 2048-row ``ModelServer`` load under
+     a ``fault.oom`` row ceiling must serve ZERO failed requests with
+     every caller's predictions BIT-IDENTICAL to the unpressured run,
+     the learned ``FusedPlan[...]`` cap must be PER-DEVICE-denominated
+     (global limit = cap x 8 within the ceiling — one OOM on the mesh
+     must not collapse the cap to a 1-device floor), and once the
+     ceiling lifts AIMD must probe every cap back up until full batches
+     dispatch unsplit; a pressured segment-CSR transform must
+     re-extract its sharded sub-ranges bit-identically too;
+  3. **breaker trip on the mesh path** — a sticky ``serve.dispatch``
+     fault must open the per-plan breaker ON the sharded path and the
+     staged fallback must serve with exact discrete parity.
+
 **Router mode** (``--router``, ISSUE 13): the horizontal-scale-out
 counterpart — a 3-replica ``ReplicaRouter`` fleet under sustained
 concurrent load:
@@ -1721,6 +1743,187 @@ def online_main() -> int:
     return 0
 
 
+def multichip_main() -> int:
+    """The SPMD multi-chip serving chaos matrix (``--multichip``,
+    ISSUE 15) — the fused mesh path on the forced 8-device mesh."""
+    import time
+    import warnings
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_multichip_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ["FMT_SERVE_BREAKER_THRESHOLD"] = "2"
+    os.environ["FMT_RETRY_ATTEMPTS"] = "2"
+    os.environ["FMT_RETRY_BASE_S"] = "0.001"
+    from flink_ml_tpu import fault, obs, serve
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.common import fused as fused_mod
+    from flink_ml_tpu.fault import pressure
+    from flink_ml_tpu.lib import LogisticRegression, StandardScaler
+    from flink_ml_tpu.lib.encoding import OneHotEncoder, StringIndexer
+    from flink_ml_tpu.serving import ModelServer
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    assert jax.device_count() == 8, jax.device_count()
+    rng = np.random.RandomState(15)
+    n_rows, req_rows = 2048, 64
+    X = rng.randn(n_rows, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    dense = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": X, "label": y},
+    )
+    cats = [f"v{rng.randint(9)}" for _ in range(n_rows)]
+    cat = Table.from_columns(
+        Schema.of(("c1", "string"), ("label", "double")),
+        {"c1": cats,
+         "label": (np.asarray(cats) == "v0").astype(np.float64)},
+    )
+    dense_model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(dense)
+    csr_model = Pipeline([
+        StringIndexer().set_selected_cols(["c1"]).set_output_cols(["i1"]),
+        OneHotEncoder().set_selected_cols(["i1"]).set_output_col("f"),
+        LogisticRegression().set_vector_col("f").set_label_col("label")
+        .set_prediction_col("p").set_learning_rate(0.5).set_max_iter(2),
+    ]).fit(cat)
+
+    # -- leg 1: every fused dispatch rides shard_map (bypass detector) -------
+    obs.reset()
+    fused_mod.reset_mesh_stats()
+    (dense_ref,) = dense_model.transform(dense)
+    (csr_ref,) = csr_model.transform(cat)
+    refp = np.asarray(dense_ref.col("p"))
+    csr_refp = np.asarray(csr_ref.col("p"))
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("pipeline.fused_dispatches", 0) >= 2, c
+    assert (c.get("fused.shard_map_dispatches", 0)
+            == c.get("pipeline.fused_dispatches")), c
+    assert not c.get("pipeline.plan_fallback_batches"), c
+    status = fused_mod.mesh_status()
+    assert status["devices"] == 8, status
+    assert sum(status["device_rows"].values()) == 2 * n_rows, status
+    print(f"  sharded path: dense + segment-CSR plans, "
+          f"{c.get('fused.shard_map_dispatches'):g}/"
+          f"{c.get('pipeline.fused_dispatches'):g} dispatches through "
+          "shard_map (CSR bypass gone), 8-device row shares accounted")
+
+    # -- leg 2: OOM ceiling under serving load -> per-device AIMD recovery ---
+    ceiling = 256
+    pressure.reset_states()
+    obs.reset()
+    os.environ["FMT_PRESSURE_PROBE_S"] = "0"  # probe on every admit
+    fault.configure(f"fault.oom>{ceiling}")
+    failures = []
+    try:
+        with ModelServer(dense_model, max_batch=512,
+                         max_wait_ms=1) as server:
+            futs = [
+                server.submit(
+                    dense.slice_rows(i * req_rows, (i + 1) * req_rows))
+                for i in range(n_rows // req_rows)
+            ]
+            for i, fut in enumerate(futs):
+                try:
+                    got = np.asarray(fut.result(120).table.col("p"))
+                    np.testing.assert_array_equal(
+                        got, refp[i * req_rows:(i + 1) * req_rows],
+                        err_msg=f"request {i} diverged under pressure",
+                    )
+                except BaseException as exc:  # noqa: BLE001 - the assertion
+                    failures.append(exc)
+            assert not failures, (
+                f"{len(failures)} of {len(futs)} requests failed under "
+                f"the injected ceiling: {failures[0]!r}"
+            )
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("pressure.ooms", 0) >= 1, c
+            assert c.get("pressure.bisections", 0) >= 1, c
+            # the learned caps are PER-DEVICE: the plan's global limit
+            # (cap x 8) sits within the ceiling instead of the whole
+            # mesh collapsing toward a 1-device floor
+            plan_caps = {k: st.cap for k, st in pressure._STATES.items()
+                         if k.startswith("FusedPlan[")
+                         and st.cap is not None}
+            assert plan_caps, sorted(pressure._STATES)
+            assert all(cap * 8 <= ceiling and cap >= 1
+                       for cap in plan_caps.values()), plan_caps
+            print(f"  ceiling: {len(futs)} x {req_rows}-row requests "
+                  "served, zero failures, bit-identical; per-device caps "
+                  f"{sorted(plan_caps.values())} (x8 <= {ceiling})")
+
+            # the CSR sharded layout re-extracts its bisection sub-ranges
+            (csr_pressured,) = csr_model.transform(cat)
+            np.testing.assert_array_equal(
+                np.asarray(csr_pressured.col("p")), csr_refp,
+                err_msg="pressured segment-CSR predictions diverged",
+            )
+            print("  ceiling: sharded segment-CSR transform bisected "
+                  "bit-identically")
+
+            # -- ceiling lifts -> AIMD probes every cap back up ---------
+            fault.configure(None)
+            deadline = time.monotonic() + 60
+            surfaces = [name for name in pressure._STATES
+                        if name.startswith("FusedPlan[")]
+
+            def caps():
+                return [pressure.state(s).cap for s in surfaces]
+
+            while any(cap is not None for cap in caps()):
+                assert time.monotonic() < deadline, (
+                    f"AIMD never recovered: caps={caps()}"
+                )
+                server.predict(dense.slice_rows(0, 512), timeout=120)
+                csr_model.transform(cat)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("pressure.resizes", 0) >= 1, c
+        before = c.get("pressure.bisections", 0)
+        (out,) = dense_model.transform(dense)
+        np.testing.assert_array_equal(np.asarray(out.col("p")), refp)
+        after = obs.registry().snapshot()["counters"].get(
+            "pressure.bisections", 0)
+        assert after == before, (before, after)
+        print(f"  AIMD: caps cleared "
+              f"(resizes={c.get('pressure.resizes'):g}), full-batch "
+              "mesh dispatch restored unsplit")
+    finally:
+        fault.configure(None)
+        os.environ.pop("FMT_PRESSURE_PROBE_S", None)
+
+    # -- leg 3: breaker trips on the mesh path -> staged fallback parity -----
+    serve.reset_breakers()
+    obs.reset()
+    fault.configure("serve.dispatch@1+", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            dense_model.transform(dense)        # breaker absorbs failures
+            (fb_out,) = dense_model.transform(dense)  # now fully open
+    finally:
+        fault.configure(None)
+    np.testing.assert_array_equal(
+        np.asarray(fb_out.col("p")), refp,
+        err_msg="mesh-path staged fallback predictions diverge",
+    )
+    c = obs.registry().snapshot()["counters"]
+    plan_keys = [k for k in c if k.startswith("serve.fallbacks.FusedPlan[")]
+    assert plan_keys, c
+    plan_name = plan_keys[0][len("serve.fallbacks."):]
+    assert serve.breaker(plan_name).state == 1.0, f"{plan_name}: not open"
+    assert c.get("pipeline.plan_fallback_batches", 0) >= 1, c
+    serve.reset_breakers()
+    print(f"  breaker: sharded plan tripped open ({plan_name}), staged "
+          "fallback parity exact "
+          f"(fallback_batches={c.get('pipeline.plan_fallback_batches'):g})")
+    print("multichip chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -1741,6 +1944,8 @@ def main() -> int:
         return drift_main()
     if "--online" in sys.argv:
         return online_main()
+    if "--multichip" in sys.argv:
+        return multichip_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -1,0 +1,188 @@
+"""Subprocess worker for tests/test_multichip_serve.py (ISSUE 15).
+
+Launched once per device count (``XLA_FLAGS=--xla_force_host_platform_
+device_count=N`` set by the parent BEFORE jax initializes), loads the
+pipeline models the parent fitted and saved, transforms the SAME
+deterministic tables, and prints one ``RESULT {json}`` line holding:
+
+* per family (dense LR, sparse segment-CSR LR, scalers, KMeans assign,
+  Knn chunked scan): the fused transform's discrete outputs verbatim and
+  float outputs rounded to comparison precision — with fused-vs-staged
+  parity asserted IN-WORKER (discrete bit-identical, floats ~1e-5);
+* quarantine offsets of a fused transform with planted bad rows;
+* a pressure-bisection run (``fault.oom``-injected HBM ceiling) whose
+  output must equal the clean fused run bit-identically;
+* the fused/shard_map dispatch counters, so the parent can assert the
+  sharded path actually ran on the multi-device mesh (and did NOT on
+  the 1-device mesh).
+
+The parent compares RESULTs across device counts: multi-chip serving
+must be a deployment detail, never a numerics change.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N, D = 384, 6  # 384 is deliberately not a ladder rung (pads to 512)
+SPARSE_DIM, NNZ = 64, 4
+
+
+def make_tables():
+    """Deterministic serving tables — identical in parent and workers."""
+    from flink_ml_tpu.ops.vector import SparseVector
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(29)
+    X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+    w = rng.randn(D).astype(np.float32)
+    y = ((X - 1.0) @ w > 0).astype(np.float64)
+    dense = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR),
+                  ("label", "double")),
+        {"features": X, "label": y},
+    )
+    vecs = []
+    true_w = np.zeros(SPARSE_DIM)
+    true_w[:8] = rng.randn(8) * 2
+    ys = []
+    for _ in range(N):
+        idx = np.sort(rng.choice(SPARSE_DIM, NNZ, replace=False))
+        val = rng.randn(NNZ)
+        vecs.append(SparseVector(SPARSE_DIM, idx.astype(np.int64), val))
+        ys.append(float(val @ true_w[idx] > 0))
+    aux = rng.randn(N, 3).astype(np.float32)
+    sparse = Table.from_columns(
+        Schema.of(("aux", DataTypes.DENSE_VECTOR),
+                  ("features", DataTypes.SPARSE_VECTOR),
+                  ("label", "double")),
+        {"aux": aux, "features": vecs, "label": np.asarray(ys)},
+    )
+    return dense, sparse
+
+
+#: family -> (model subdir, table key, discrete output cols, float cols)
+FAMILIES = {
+    "dense_lr": ("dense_lr", "dense", ["pred"], ["proba"]),
+    "sparse_lr": ("sparse_lr", "sparse", ["pred"], ["proba"]),
+    "scalers": ("scalers", "dense", [], ["features"]),
+    "kmeans": ("kmeans", "dense", ["cluster"], []),
+    "knn": ("knn", "dense", ["pred"], []),
+}
+
+
+def _col(table, name):
+    from flink_ml_tpu.table.schema import DataTypes
+
+    if DataTypes.is_vector(table.schema.type_of(name)):
+        return np.asarray(table.features_dense(name), dtype=np.float64)
+    return np.asarray(table.col(name), dtype=np.float64)
+
+
+def _transform(model, table, fuse: bool):
+    os.environ["FMT_FUSE_TRANSFORM"] = "1" if fuse else "0"
+    try:
+        (out,) = model.transform(table)
+    finally:
+        os.environ.pop("FMT_FUSE_TRANSFORM", None)
+    return out
+
+
+def main(model_dir: str) -> None:
+    import jax
+
+    from flink_ml_tpu import fault, obs
+    from flink_ml_tpu.api.pipeline import PipelineModel
+    from flink_ml_tpu.serve import quarantine
+    from flink_ml_tpu.table.table import Table
+
+    dense, sparse = make_tables()
+    tables = {"dense": dense, "sparse": sparse}
+    obs.enable()
+    obs.reset()
+    result = {"devices": jax.device_count(), "families": {}}
+
+    for fam, (sub, tkey, discrete_cols, float_cols) in FAMILIES.items():
+        model = PipelineModel.load(os.path.join(model_dir, sub))
+        table = tables[tkey]
+        fused_out = _transform(model, table, True)
+        staged_out = _transform(model, table, False)
+        rec = {}
+        for c in discrete_cols:
+            f, s = _col(fused_out, c), _col(staged_out, c)
+            assert np.array_equal(f, s), (
+                f"{fam}.{c}: fused discrete diverges from staged")
+            rec[c] = f.tolist()
+        for c in float_cols:
+            f, s = _col(fused_out, c), _col(staged_out, c)
+            np.testing.assert_allclose(
+                f, s, rtol=1e-5, atol=1e-5,
+                err_msg=f"{fam}.{c}: fused floats diverge from staged")
+            rec[c] = np.round(f, 5).tolist()
+        result["families"][fam] = rec
+
+    # -- quarantine offsets through the fused sharded path -------------------
+    X = np.asarray(dense.features_dense("features")).copy()
+    bad_rows = [5, 130, N - 1]
+    for i, r in enumerate(bad_rows):
+        X[r, i % D] = np.nan if i % 2 == 0 else np.inf
+    bad = Table.from_columns(dense.schema, {
+        "features": X.astype(np.float32), "label": dense.col("label")})
+    model = PipelineModel.load(os.path.join(model_dir, "dense_lr"))
+    quarantine.reset()
+    q_out = _transform(model, bad, True)
+    assert q_out.num_rows() == N - len(bad_rows), q_out.num_rows()
+    qt = quarantine.quarantine_table("StandardScalerModel")
+    assert qt is not None, "no quarantine side-table emitted"
+    result["quarantine_rows"] = sorted(
+        int(r) for r in qt.col(quarantine.QUARANTINE_ROW_COL))
+    result["quarantine_survivor_pred"] = _col(q_out, "pred").tolist()
+    quarantine.reset()
+
+    # -- pressure bisection on this mesh: bit-identical recovery -------------
+    from flink_ml_tpu.fault import pressure
+
+    pressure.reset_states()
+    clean = _transform(model, dense, True)
+    c0 = obs.registry().snapshot()["counters"]
+    fault.configure("fault.oom>96", seed=0)
+    try:
+        pressured = _transform(model, dense, True)
+    finally:
+        fault.configure(None)
+    c1 = obs.registry().snapshot()["counters"]
+    assert np.array_equal(_col(pressured, "pred"), _col(clean, "pred")), (
+        "pressure-bisected predictions diverge from the clean run")
+    np.testing.assert_allclose(
+        _col(pressured, "proba"), _col(clean, "proba"), rtol=1e-5,
+        atol=1e-5, err_msg="pressure-bisected probas diverge")
+    result["bisections"] = int(
+        c1.get("pressure.bisections", 0) - c0.get("pressure.bisections", 0))
+    caps = pressure.current_caps()
+    result["per_device_cap"] = next(
+        (v for k, v in caps.items() if k.startswith("FusedPlan[")), None)
+    pressure.reset_states()
+
+    counters = obs.registry().snapshot()["counters"]
+    result["fused_dispatches"] = counters.get("pipeline.fused_dispatches", 0)
+    result["shard_map_dispatches"] = counters.get(
+        "fused.shard_map_dispatches", 0)
+    result["plan_fallbacks"] = counters.get(
+        "pipeline.plan_fallback_batches", 0)
+    print("RESULT " + json.dumps(result))
+
+
+if __name__ == "__main__":
+    # worker-only jax config: the parent suite imports make_tables from
+    # this module, and a module-level config update would leak
+    # cpu/x64 into the importing process's backend (e.g. a TPU tier run)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    main(sys.argv[1])

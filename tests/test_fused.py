@@ -533,3 +533,121 @@ class TestReapHoisting:
         mapper = model.loaded_mapper(dense_table.schema)
         mapper.apply(empty)
         assert len(calls) == 0
+
+
+class TestMeshSharding:
+    """SPMD fused serving over the virtual 8-device mesh (ISSUE 15)."""
+
+    def _mesh(self):
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        return MLEnvironmentFactory.get_default().get_mesh()
+
+    def test_try_place_pads_ragged_rows_to_row_multiple(self):
+        """Red test (ISSUE 15 satellite): a ``P('data')`` placement of a
+        batch whose row count does not divide the mesh's data axis used
+        to raise out of ``_try_place`` — now it pads with zero (masked)
+        rows instead, so every fused surface survives ragged batches."""
+        import jax
+
+        mesh = self._mesh()
+        n_dev = jax.device_count()
+        assert n_dev == 8
+        a = np.arange(10 * 4, dtype=np.float32).reshape(10, 4)
+        placed = fused._try_place(a, mesh, n_dev)
+        assert placed.shape[0] == 16  # padded up to the axis multiple
+        np.testing.assert_array_equal(np.asarray(placed)[:10], a)
+        np.testing.assert_array_equal(
+            np.asarray(placed)[10:], np.zeros((6, 4), np.float32))
+
+    def test_sparse_csr_plan_shards_over_the_mesh(self, obs_on,
+                                                  monkeypatch):
+        """The segment-CSR fused path no longer takes the single-device
+        bypass: an indexer -> encoder -> sparse-LR chain dispatches ONE
+        shard_map program per batch with staged-parity outputs."""
+        rng = np.random.RandomState(3)
+        n = 1000  # pads to the 1024 rung: 24 weight-0 pad rows
+        cats = list(rng.choice(["a", "b", "c", "d"], size=n))
+        y = (np.asarray(cats) == "a").astype(np.float64)
+        t = Table.from_columns(
+            Schema.of(("c1", "string"), ("label", "double")),
+            {"c1": cats, "label": y},
+        )
+        model = Pipeline([
+            StringIndexer().set_selected_cols(["c1"])
+            .set_output_cols(["i1"]),
+            OneHotEncoder().set_selected_cols(["i1"])
+            .set_output_col("feat"),
+            LogisticRegression().set_vector_col("feat")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_learning_rate(0.5).set_max_iter(3),
+        ]).fit(t)
+        fused.reset_mesh_stats()
+        fused_t = _transform(model, t, True, monkeypatch)
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("fused.shard_map_dispatches", 0) >= 1
+        assert counters.get("pipeline.fused_dispatches", 0) >= 1
+        assert counters.get("fused.padded_rows", 0) == 24
+        status = fused.mesh_status()
+        assert status["devices"] == 8
+        assert sum(int(r) for r in status["device_rows"].values()) == n
+        staged = _transform(model, t, False, monkeypatch)
+        _assert_parity(staged, fused_t, discrete_cols=["pred"])
+
+    def test_serve_mesh_off_restores_single_device_dispatch(
+            self, dense_table, obs_on, monkeypatch):
+        """FMT_SERVE_MESH=0 is the escape hatch: same answers, zero
+        shard_map dispatches."""
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+        ]).fit(dense_table)
+        monkeypatch.setenv("FMT_SERVE_MESH", "0")
+        off_out = _transform(model, dense_table, True, monkeypatch)
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("fused.shard_map_dispatches", 0) == 0
+        monkeypatch.delenv("FMT_SERVE_MESH")
+        on_out = _transform(model, dense_table, True, monkeypatch)
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("fused.shard_map_dispatches", 0) >= 1
+        _assert_parity(off_out, on_out, float_cols=["features"])
+
+    def test_bisection_subranges_below_row_multiple_pad_and_mask(
+            self, obs_on, monkeypatch):
+        """``_bisected_batch`` halving can leave a trailing sub-range
+        smaller than (or not divisible by) the mesh width — those
+        ranges pad-and-mask through the ladder and the result is
+        bit-identical to the unpressured fused run."""
+        rng = np.random.RandomState(11)
+        n = 180  # ceilings below force sub-ranges of 40/20 rows on 8 devs
+        X = (2.0 * rng.randn(n, D) + 1.0).astype(np.float32)
+        w = rng.randn(D).astype(np.float32)
+        y = ((X - 1.0) @ w > 0).astype(np.float64)
+        t = Table.from_columns(SCHEMA, {"features": X, "label": y})
+        model = Pipeline([
+            StandardScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_prediction_detail_col("proba")
+            .set_learning_rate(0.5).set_max_iter(3),
+        ]).fit(t)
+        from flink_ml_tpu.fault import pressure
+
+        pressure.reset_states()
+        clean = _transform(model, t, True, monkeypatch)
+        fault.configure("fault.oom>64", seed=0)
+        try:
+            pressured = _transform(model, t, True, monkeypatch)
+        finally:
+            fault.configure(None)
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("pressure.bisections", 0) >= 1
+        _assert_parity(clean, pressured, discrete_cols=["pred"],
+                       float_cols=["proba"])
+        # the surface's cap is per-device-denominated: its GLOBAL limit
+        # over the 8 shards recovered to at most the injected ceiling
+        caps = {k: v for k, v in pressure.current_caps().items()
+                if k.startswith("FusedPlan[")}
+        assert caps, pressure.current_caps()
+        assert all(v * 8 <= 64 for v in caps.values()), caps
+        pressure.reset_states()
