@@ -128,6 +128,9 @@ def record(kind: str, **fields) -> None:
         "ts": time.time(),
         "mono_s": time.monotonic(),
         "thread": threading.current_thread().name,
+        # pid, like the span records': a fleet's merged black boxes must
+        # say WHICH process saw each event
+        "pid": os.getpid(),
         **_redact(fields),
     }
     if trace_id is not None and "trace_id" not in event:

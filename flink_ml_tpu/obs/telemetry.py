@@ -621,6 +621,12 @@ def status_snapshot() -> dict:
         out["flight_tail"] = flight.events()[-10:]
     except Exception:  # noqa: BLE001
         out["flight_tail"] = []
+    try:
+        from flink_ml_tpu.obs import trace
+
+        out["trace"] = trace.sink_status()
+    except Exception:  # noqa: BLE001
+        out["trace"] = {}
     snap = registry().snapshot()
     out["registry"] = {k: len(v) for k, v in snap.items()}
     with _SOURCES_LOCK:

@@ -89,12 +89,17 @@ class ServeResult:
                    bit-identical to a solo ``transform`` of the request;
     ``quarantine`` per-mapper side-tables for THIS request's bad rows,
                    ``_quarantine_row`` rewritten to request-local indices;
-    ``version``    the model version that served the batch.
+    ``version``    the model version that served the batch;
+    ``trace_id``   the request's trace id (None when untraced) — returned
+                   on SUCCESS as well as on sheds, so a caller can
+                   correlate any response with its fleet waterfall
+                   without tailing span files.
     """
 
     table: Table
     quarantine: Dict[str, Table]
     version: str
+    trace_id: Optional[str] = None
 
     @property
     def num_rows(self) -> int:
@@ -201,5 +206,5 @@ def demux(
                 part = Table.concat([quarantine[name], part])
             quarantine[name] = part
         results.append(ServeResult(table=table, quarantine=quarantine,
-                                   version=version))
+                                   version=version, trace_id=trace_id))
     return results

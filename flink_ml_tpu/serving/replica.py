@@ -57,6 +57,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from flink_ml_tpu.obs import trace
 from flink_ml_tpu.serving.batcher import ServeResult
 from flink_ml_tpu.serving.errors import (
     SHED_SHUTDOWN,
@@ -157,7 +158,10 @@ class _DataHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - http.server contract
         if self.path.split("?", 1)[0] == "/healthz":
-            body = json.dumps({"ok": True, "pid": os.getpid()}).encode()
+            # ``ts`` feeds the router's NTP-style clock probe: the fleet
+            # stitcher corrects each replica's spans onto one timeline
+            body = json.dumps({"ok": True, "pid": os.getpid(),
+                               "ts": time.time()}).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -187,12 +191,18 @@ class _DataHandler(BaseHTTPRequestHandler):
     def _submit(self, payload: dict) -> None:
         server = self.server.model_server
         table = decode_table(payload["table"])
+        remote = payload.get("trace") or {}
         try:
-            result = server.predict(
-                table,
-                deadline_ms=payload.get("deadline_ms"),
-                timeout=payload.get("timeout_s", 120.0),
-            )
+            # adopt the router's trace context for this handler thread:
+            # the server's request root then JOINS the routed trace,
+            # parented under the router's dispatch span
+            with trace.adopt(remote.get("trace_id"),
+                             remote.get("parent_span_id", "")):
+                result = server.predict(
+                    table,
+                    deadline_ms=payload.get("deadline_ms"),
+                    timeout=payload.get("timeout_s", 120.0),
+                )
         except ServerOverloadedError as exc:
             # the shed travels as DATA, reason code intact: the router's
             # retry classification consumes the code, not the prose
@@ -208,6 +218,9 @@ class _DataHandler(BaseHTTPRequestHandler):
             "quarantine": {name: encode_table(t)
                            for name, t in result.quarantine.items()},
             "version": result.version,
+            # SUCCESSES carry the trace id too (sheds always did): a
+            # caller can correlate any response with its waterfall
+            "trace_id": result.trace_id,
         })
 
     def _deploy(self, payload: dict) -> None:
@@ -361,20 +374,30 @@ class ReplicaClient:
         ) from last_exc
 
     def submit(self, table, deadline_ms: Optional[float] = None,
-               timeout_s: float = 120.0) -> ServeResult:
+               timeout_s: float = 120.0,
+               trace_ctx: Optional[tuple] = None) -> ServeResult:
         """Forward one request; returns the replica's
         :class:`ServeResult` (tables bit-identical to an in-process
         serve) or raises the replica's reason-coded shed /
-        :class:`ReplicaRemoteError` / :class:`ReplicaUnreachableError`."""
-        answer = self._post("/submit", {
+        :class:`ReplicaRemoteError` / :class:`ReplicaUnreachableError`.
+
+        ``trace_ctx`` is an optional ``(trace_id, parent_span_id)`` pair
+        shipped in the payload so the replica records its spans inside
+        the ROUTER's trace (``trace.adopt`` on the far side)."""
+        payload = {
             "table": encode_table(table), "deadline_ms": deadline_ms,
             "timeout_s": timeout_s,
-        }, timeout_s=timeout_s + 10.0)
+        }
+        if trace_ctx:
+            payload["trace"] = {"trace_id": trace_ctx[0],
+                                "parent_span_id": trace_ctx[1]}
+        answer = self._post("/submit", payload, timeout_s=timeout_s + 10.0)
         return ServeResult(
             table=decode_table(answer["table"]),
             quarantine={name: decode_table(wire)
                         for name, wire in answer["quarantine"].items()},
             version=answer["version"],
+            trace_id=answer.get("trace_id"),
         )
 
     def deploy(self, path: str, version: str,
@@ -400,6 +423,22 @@ class ReplicaClient:
             raise ReplicaUnreachableError(
                 f"replica {self.serve_address} healthz failed: {exc}"
             ) from exc
+
+    def clock_probe(self, timeout_s: float = 2.0) -> dict:
+        """NTP-style clock-offset estimate for this replica's process:
+        ``{"pid", "offset_s", "rtt_s"}``, where ``offset_s`` is the
+        replica wall clock minus ours, measured against the probe RTT's
+        midpoint (the error bound is the RTT asymmetry — loopback
+        microseconds, far below span widths).  The router feeds this to
+        :func:`flink_ml_tpu.obs.trace.note_clock_offset` so the fleet
+        stitcher lands every process's spans on ONE timeline."""
+        t0 = time.time()
+        body = self.healthz(timeout_s=timeout_s)
+        rtt = time.time() - t0
+        server_ts = float(body.get("ts") or 0.0)
+        offset = server_ts - (t0 + rtt / 2.0) if server_ts else 0.0
+        return {"pid": int(body.get("pid") or 0), "offset_s": offset,
+                "rtt_s": rtt}
 
     def probe(self, timeout_s: float = 2.0, depth: bool = True) -> dict:
         """One health-poll sample off the replica's telemetry plane:
@@ -529,6 +568,14 @@ class ReplicaProcess:
         # a parent-side chaos schedule is the PARENT's experiment: the
         # child starts fault-free unless the caller injects explicitly
         env.pop("FMT_FAULT_INJECT", None)
+        if trace.enabled():
+            # a traced fleet traces its replicas too, into the SAME
+            # directory (per-pid filenames keep the writers apart) —
+            # the runtime enable() may postdate the parent's env
+            env["FMT_TRACE"] = "1"
+            env["FMT_TRACE_DIR"] = trace.trace_dir()
+            env.setdefault("FMT_TRACE_SAMPLE", str(trace.sample_rate()))
+            env.setdefault("FMT_TRACE_TAIL", ",".join(trace.tail_modes()))
         env["PYTHONPATH"] = _package_root() + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )

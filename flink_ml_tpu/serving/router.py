@@ -185,6 +185,10 @@ class _RouterRequest:
     deadline_at: Optional[float]
     n_rows: int
     attempts: int = 0
+    #: the routed request's ROOT trace (obs.trace.RequestTrace, None when
+    #: tracing is off/sampled out) — every dispatch attempt parents under
+    #: it, and its context ships to the replica over the wire
+    trace: Optional[object] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_at is not None and now > self.deadline_at
@@ -445,6 +449,13 @@ class ReplicaRouter:
             replica.mark_probe(client.probe())
         except ReplicaUnreachableError:
             replica.note_probe_failure()
+        if obs.trace.enabled() and process is not None:
+            # one NTP-style clock sample per (re)spawn: enough for the
+            # fleet stitcher to land this child's spans on our timeline
+            try:
+                obs.trace.note_clock_offset(**client.clock_probe())
+            except (ReplicaUnreachableError, AttributeError, TypeError):
+                pass
         return replica
 
     def start(self) -> "ReplicaRouter":
@@ -540,12 +551,17 @@ class ReplicaRouter:
         now = now_s()
         deadline_at = (now + float(deadline_ms) / 1e3
                        if deadline_ms and deadline_ms > 0 else None)
+        req_trace = obs.trace.start_request("router.request", {"rows": n})
+        t_submit = time.perf_counter()
         request = _RouterRequest(table=table, future=Future(),
                                  enqueued_at=now, deadline_at=deadline_at,
-                                 n_rows=n)
+                                 n_rows=n, trace=req_trace)
         rejected = None
         with self._cond:
             if self._closed or self._stopping:
+                if req_trace is not None:
+                    req_trace.end(status="shed",
+                                  attrs={"shed_reason": SHED_SHUTDOWN})
                 raise ServerClosedError("router is shut down")
             if self._queued_rows + n > self.config.queue_cap:
                 rejected = (
@@ -558,7 +574,16 @@ class ReplicaRouter:
                 obs.gauge_set("router.queue_depth", self._queued_rows)
                 self._cond.notify()
         if rejected is not None:
-            raise self._shed_error(SHED_QUEUE_FULL, rejected)
+            if req_trace is not None:
+                req_trace.end(status="shed",
+                              attrs={"shed_reason": SHED_QUEUE_FULL})
+            raise self._shed_error(
+                SHED_QUEUE_FULL, rejected,
+                trace_id=req_trace.trace_id if req_trace else None)
+        if req_trace is not None:
+            obs.trace.record_span((req_trace.ctx,), "submit",
+                                  time.perf_counter() - t_submit,
+                                  {"rows": n})
         self._tally("router.requests")
         self._tally("router.request_rows", n)
         obs.counter_add("router.requests")
@@ -616,94 +641,127 @@ class ReplicaRouter:
         replica can take it."""
         from flink_ml_tpu.fault.injection import InjectedFault, maybe_fail
 
-        excluded: set = set()
-        last_exc: Optional[BaseException] = None
-        while True:
-            now = now_s()
-            if request.expired(now):
-                self._fail(request, self._shed_error(
-                    SHED_DEADLINE, "deadline passed while routing"))
-                return
-            replica = self._pick(excluded)
-            if replica is None and excluded:
-                # every routable replica already failed this request once;
-                # budget permitting, give the fleet a second pass (their
-                # transient load — a full queue — may have drained)
-                excluded.clear()
+        req_trace = request.trace
+        if req_trace is not None:
+            obs.trace.record_span(
+                (req_trace.ctx,), "queue_wait",
+                max(now_s() - request.enqueued_at, 0.0))
+        # install the request's context on THIS dispatch lane: each
+        # attempt below records a router.dispatch span under the root —
+        # retries render as SIBLINGS, and the winning attempt's span is
+        # the parent the replica's adopted subtree nests under
+        with obs.trace.use((req_trace.ctx,) if req_trace is not None
+                           else ()):
+            excluded: set = set()
+            last_exc: Optional[BaseException] = None
+            while True:
+                now = now_s()
+                if request.expired(now):
+                    self._fail(request, self._shed_error(
+                        SHED_DEADLINE, "deadline passed while routing"))
+                    return
                 replica = self._pick(excluded)
-            if replica is None:
-                replica = self._wait_routable(request)
+                if replica is None and excluded:
+                    # every routable replica already failed this request
+                    # once; budget permitting, give the fleet a second
+                    # pass (their transient load — a full queue — may
+                    # have drained)
+                    excluded.clear()
+                    replica = self._pick(excluded)
                 if replica is None:
-                    self._fail(request, last_exc or self._shed_error(
-                        SHED_NO_REPLICA,
-                        "no ready replica (all dead, draining, or "
-                        "reason-coded unready)"))
-                    return
-            try:
-                maybe_fail("router.dispatch")
-                replica.begin_dispatch()
+                    replica = self._wait_routable(request)
+                    if replica is None:
+                        self._fail(request, last_exc or self._shed_error(
+                            SHED_NO_REPLICA,
+                            "no ready replica (all dead, draining, or "
+                            "reason-coded unready)"))
+                        return
                 try:
-                    result = replica.client.submit(
-                        request.table,
-                        # remaining time re-read NOW: _wait_routable may
-                        # have blocked for seconds since the iteration's
-                        # deadline check, and a stale clock would hand
-                        # the replica budget the caller no longer has
-                        deadline_ms=request.remaining_ms(now_s()),
-                        timeout_s=_DISPATCH_TIMEOUT_S,
-                    )
-                finally:
-                    replica.end_dispatch()
-            except ServerOverloadedError as exc:
-                policy = shed_policy(exc.reason)
-                if policy == POLICY_ROUTE_AWAY:
-                    # the replica said "I am degraded", not "I am busy":
-                    # out of rotation until a probe clears it
-                    replica.mark_unready(exc.reason)
-                if policy == POLICY_FAIL or not self._budget(request):
-                    self._tally(f"router.shed.{exc.reason}")
-                    self._tally("router.shed")
-                    obs.counter_add("router.shed")
-                    obs.counter_add(f"router.shed.{exc.reason}")
+                    with obs.trace.span("router.dispatch", {
+                        "replica": replica.name,
+                        "attempt": request.attempts + 1,
+                        "rows": request.n_rows,
+                    }):
+                        maybe_fail("router.dispatch")
+                        replica.begin_dispatch()
+                        try:
+                            ctx = obs.trace.current()
+                            result = replica.client.submit(
+                                request.table,
+                                # remaining time re-read NOW:
+                                # _wait_routable may have blocked for
+                                # seconds since the iteration's deadline
+                                # check, and a stale clock would hand the
+                                # replica budget the caller no longer has
+                                deadline_ms=request.remaining_ms(now_s()),
+                                timeout_s=_DISPATCH_TIMEOUT_S,
+                                **({"trace_ctx": (ctx[0].trace_id,
+                                                  ctx[0].span_id)}
+                                   if ctx else {}),
+                            )
+                        finally:
+                            replica.end_dispatch()
+                except ServerOverloadedError as exc:
+                    policy = shed_policy(exc.reason)
+                    if policy == POLICY_ROUTE_AWAY:
+                        # the replica said "I am degraded", not "I am
+                        # busy": out of rotation until a probe clears it
+                        replica.mark_unready(exc.reason)
+                    if policy == POLICY_FAIL or not self._budget(request):
+                        self._tally(f"router.shed.{exc.reason}")
+                        self._tally("router.shed")
+                        obs.counter_add("router.shed")
+                        obs.counter_add(f"router.shed.{exc.reason}")
+                        self._fail(request, exc)
+                        return
+                    excluded.add(replica.name)
+                    last_exc = exc
+                    self._note_retry(replica.name, exc.reason)
+                    continue
+                except (ReplicaUnreachableError, InjectedFault) as exc:
+                    if isinstance(exc, ReplicaUnreachableError):
+                        self._note_unreachable(replica)
+                    if not self._budget(request):
+                        self._fail(request, exc)
+                        return
+                    excluded.add(replica.name)
+                    last_exc = exc
+                    self._note_retry(replica.name, type(exc).__name__)
+                    continue
+                except ReplicaRemoteError as exc:
+                    # a real failure inside the replica's transform is
+                    # deterministic for this request — no cross-replica
+                    # retry
+                    self._tally("router.failed_requests")
+                    obs.counter_add("router.failed_requests")
                     self._fail(request, exc)
                     return
-                excluded.add(replica.name)
-                last_exc = exc
-                self._note_retry(replica.name, exc.reason)
-                continue
-            except (ReplicaUnreachableError, InjectedFault) as exc:
-                if isinstance(exc, ReplicaUnreachableError):
-                    self._note_unreachable(replica)
-                if not self._budget(request):
+                except BaseException as exc:  # noqa: BLE001 - futures carry it
                     self._fail(request, exc)
                     return
-                excluded.add(replica.name)
-                last_exc = exc
-                self._note_retry(replica.name, type(exc).__name__)
-                continue
-            except ReplicaRemoteError as exc:
-                # a real failure inside the replica's transform is
-                # deterministic for this request — no cross-replica retry
-                self._tally("router.failed_requests")
-                obs.counter_add("router.failed_requests")
-                self._fail(request, exc)
+                latency_ms = (now_s() - request.enqueued_at) * 1e3
+                with self._counts_lock:
+                    # under the tally lock: stats() sorts this deque from
+                    # other threads, and a concurrent append would raise
+                    # "deque mutated during iteration"
+                    self._latencies.append(latency_ms)
+                obs.observe("router.request_latency_ms", latency_ms)
+                self._tally("router.served_requests")
+                self._tally("router.served_rows", result.num_rows)
+                obs.counter_add("router.served_requests")
+                if req_trace is not None:
+                    # end the root BEFORE resolving the future (the
+                    # server-side discipline) and backfill the trace id
+                    # onto the result so callers can correlate without
+                    # tailing span files
+                    req_trace.end(status="ok", attrs={
+                        "replica": replica.name, "version": result.version,
+                    })
+                    if getattr(result, "trace_id", None) is None:
+                        result.trace_id = req_trace.trace_id
+                if not request.future.cancelled():
+                    request.future.set_result(result)
                 return
-            except BaseException as exc:  # noqa: BLE001 - futures carry it
-                self._fail(request, exc)
-                return
-            latency_ms = (now_s() - request.enqueued_at) * 1e3
-            with self._counts_lock:
-                # under the tally lock: stats() sorts this deque from
-                # other threads, and a concurrent append would raise
-                # "deque mutated during iteration"
-                self._latencies.append(latency_ms)
-            obs.observe("router.request_latency_ms", latency_ms)
-            self._tally("router.served_requests")
-            self._tally("router.served_rows", result.num_rows)
-            obs.counter_add("router.served_requests")
-            if not request.future.cancelled():
-                request.future.set_result(result)
-            return
 
     def _budget(self, request: _RouterRequest) -> bool:
         """Consume one retry; False when the request is out of budget
@@ -716,8 +774,16 @@ class ReplicaRouter:
         obs.counter_add("router.retries")
         obs.flight.record("router.retry", replica=replica_name, why=why)
 
-    @staticmethod
-    def _fail(request: _RouterRequest, exc: BaseException) -> None:
+    def _fail(self, request: _RouterRequest,
+              exc: BaseException) -> None:
+        req_trace = getattr(request, "trace", None)
+        if req_trace is not None:
+            if isinstance(exc, ServerOverloadedError):
+                req_trace.end(status="shed", attrs={
+                    "shed_reason": getattr(exc, "reason", "")})
+            else:
+                req_trace.end(status="error",
+                              attrs={"error": type(exc).__name__})
         if not request.future.done():
             request.future.set_exception(exc)
 
@@ -774,13 +840,14 @@ class ReplicaRouter:
             time.sleep(0.01)
         return None
 
-    def _shed_error(self, reason: str, detail: str) -> ServerOverloadedError:
+    def _shed_error(self, reason: str, detail: str,
+                    trace_id: Optional[str] = None) -> ServerOverloadedError:
         self._tally("router.shed")
         self._tally(f"router.shed.{reason}")
         obs.counter_add("router.shed")
         obs.counter_add(f"router.shed.{reason}")
         obs.flight.record("router.shed", reason=reason, detail=detail)
-        return ServerOverloadedError(reason, detail)
+        return ServerOverloadedError(reason, detail, trace_id=trace_id)
 
     # -- supervision (poll loop) ---------------------------------------------
 

@@ -83,6 +83,13 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Head-sampling probability for request traces (0..1)."),
     Knob("FMT_TRACE_DIR", "", "str",
          "Span sink directory (default: traces/ under the reports dir)."),
+    Knob("FMT_TRACE_TAIL", "", "str",
+         "Tail-sampling modes (slow|shed|error, comma-combinable): keep "
+         "only traces whose boundary span is anomalous."),
+    Knob("FMT_TRACE_SLOW_MS", "250", "float",
+         "Boundary-span duration that counts as slow for FMT_TRACE_TAIL."),
+    Knob("FMT_TRACE_MAX_MB", "64", "float",
+         "Rotate a process's trace sink past this size (0 disables)."),
     Knob("FMT_FLIGHT_EVENTS", "512", "int",
          "Flight-recorder ring capacity (events kept for black-box dumps)."),
     Knob("FMT_FLIGHT_MIN_S", "30", "float",

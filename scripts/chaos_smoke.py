@@ -202,6 +202,20 @@ end-to-end request tracing plus the black-box flight recorder:
      ``breaker_open`` shed IN CAUSAL ORDER (ring sequence numbers), with
      the shed event carrying the shed request's ``trace_id`` (the same
      id stamped on its ``ServerOverloadedError``).
+
+**Fleet-trace mode** (``--fleet-trace``, ISSUE 16): the distributed
+counterpart — trace-context propagation across a real router + 2
+replica subprocesses:
+
+  1. **tail sampling under load** — 50 routed requests with
+     ``FMT_TRACE_TAIL=slow`` keep only the anomalous traces, and at
+     least one survivor stitches spans from >= 2 processes with
+     router-probed clock offsets on disk;
+  2. **retries as siblings** — an injected ``router.dispatch`` fault
+     renders the retry as a sibling span under one root (error -> ok);
+  3. **the fleet CLI** — ``python -m flink_ml_tpu.obs fleet`` lists and
+     renders the stitched multi-process waterfall with its per-phase
+     cost rollup.
 """
 
 import json
@@ -1023,6 +1037,122 @@ def trace_main() -> int:
     print(f"    breaker open seq={opens[0]['seq']} -> shed "
           f"seq={sheds[-1]['seq']} trace_id={sheds[-1]['trace_id']}")
     print("trace chaos smoke OK")
+    return 0
+
+
+def fleet_trace_main() -> int:
+    """The fleet-tracing chaos matrix (``--fleet-trace``, ISSUE 16):
+    distributed traces across a real router + 2 replica subprocesses.
+
+      1. **tail sampling under load** — 50 routed requests with
+         ``FMT_TRACE_TAIL=slow`` must persist only the anomalous traces
+         (the first-compile request is slow in BOTH processes; the
+         steady state is not), and at least one survivor must stitch
+         spans from >= 2 pids with router-measured clock offsets on
+         disk;
+      2. **retries as siblings** — an injected ``router.dispatch`` fault
+         must render the retry as a SIBLING ``router.dispatch`` span
+         under the same root, first attempt status ``error``, last
+         ``ok``;
+      3. **the fleet CLI** — ``python -m flink_ml_tpu.obs fleet`` over
+         the shared trace dir must list and render the stitched
+         multi-process waterfall with its per-phase cost rollup.
+    """
+    tdir = tempfile.mkdtemp(prefix="chaos_fleet_traces_")
+    # env BEFORE the router spawns: the replica children inherit the
+    # sink dir and the tail policy from it
+    os.environ["FMT_TRACE"] = "1"
+    os.environ["FMT_TRACE_DIR"] = tdir
+    os.environ["FMT_TRACE_TAIL"] = "slow"
+    # the first routed request pays the replica's fused compile (~200 ms
+    # on the CPU mesh); the steady state is ~10 ms — 100 ms splits them
+    os.environ["FMT_TRACE_SLOW_MS"] = "100"
+    os.environ["FMT_OBS_REPORTS"] = tempfile.mkdtemp(
+        prefix="chaos_fleet_reports_"
+    )
+    from flink_ml_tpu import fault
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import trace
+    from flink_ml_tpu.serving import ReplicaRouter
+
+    trace.enable(True, sample=1.0)
+    trace.set_tail("slow")
+    table = dense_table()
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+    v1_dir = os.path.join(tempfile.mkdtemp(prefix="chaos_fleet_models_"),
+                          "v1")
+    model.save(v1_dir)
+
+    router = ReplicaRouter(v1_dir, version="v1", replicas=2, poll_ms=50)
+    try:
+        # -- leg 1: 50 requests under FMT_TRACE_TAIL=slow --------------------
+        n_req = 50
+        for i in range(n_req):
+            lo = (i * 4) % (N - 4)
+            res = router.predict(table.slice_rows(lo, lo + 4), timeout=120)
+            assert res.trace_id, "routed success response carries no trace_id"
+        trace.flush()
+        spans = trace.load_spans(tdir)
+        kept = [s for s in spans if s["name"] == "router.request"]
+        assert kept, ("tail sampling dropped every trace — the "
+                      "first-compile request must judge slow")
+        assert len(kept) < n_req, (
+            f"tail sampling kept all {len(kept)}/{n_req} traces — the "
+            "steady state should be under FMT_TRACE_SLOW_MS"
+        )
+        pids_by_trace = {}
+        for s in spans:
+            pids_by_trace.setdefault(s["trace_id"], set()).add(s["pid"])
+        stitched = [t for t, pids in pids_by_trace.items() if len(pids) >= 2]
+        assert stitched, "no kept trace spans >= 2 processes"
+        offsets = trace.load_clock_offsets(tdir)
+        replica_pids = {r["pid"] for r in router.replicas}
+        assert replica_pids & set(offsets), (
+            f"no clock offset probed for the replicas: {offsets}"
+        )
+        print(f"  tail: kept {len(kept)}/{n_req} traces, "
+              f"{len(stitched)} stitched across >= 2 pids, clock offsets "
+              f"for {sorted(set(offsets) & replica_pids)}")
+
+        # -- leg 2: injected dispatch fault -> sibling retry spans -----------
+        trace.set_tail("")  # keep the (fast) retried trace in the parent
+        fault.configure("router.dispatch@1", seed=0)
+        try:
+            res = router.predict(table.slice_rows(0, 4), timeout=120)
+        finally:
+            fault.configure(None)
+        trace.flush()
+        spans = trace.load_spans(tdir)
+        disp = sorted(
+            (s for s in spans if s["trace_id"] == res.trace_id
+             and s["name"] == "router.dispatch"),
+            key=lambda s: s["attrs"].get("attempt", 0),
+        )
+        assert len(disp) >= 2, f"retry recorded {len(disp)} dispatch span(s)"
+        assert len({s["parent_id"] for s in disp}) == 1, (
+            "retry attempts are not siblings under one root"
+        )
+        assert disp[0]["status"] == "error", disp[0]
+        assert disp[-1]["status"] == "ok", disp[-1]
+        stats = router.stats()
+        assert stats.get("router.retries", 0) >= 1, stats
+        print(f"  retry: {len(disp)} sibling router.dispatch spans under "
+              f"one root (error -> ok), retries="
+              f"{stats.get('router.retries'):g}")
+    finally:
+        router.shutdown()
+
+    # -- leg 3: the fleet CLI over the shared trace dir ----------------------
+    assert trace.fleet_main(["--traces", tdir, "--list"]) == 0
+    assert trace.fleet_main(["--traces", tdir, stitched[0]]) == 0
+    print("fleet-trace chaos smoke OK")
     return 0
 
 
@@ -1936,6 +2066,8 @@ def main() -> int:
         return router_main()
     if "--trace" in sys.argv:
         return trace_main()
+    if "--fleet-trace" in sys.argv:
+        return fleet_trace_main()
     if "--pressure" in sys.argv:
         return pressure_main()
     if "--telemetry" in sys.argv:

@@ -1,0 +1,519 @@
+"""Fleet-wide distributed tracing (ISSUE 16).
+
+The contracts under test:
+
+* **context propagation** — the router ships (trace_id, parent_span_id)
+  over the replica wire, the replica ``adopt``s it, and one routed
+  request renders as ONE trace whose spans come from >= 2 processes with
+  correct parent/child nesting (the acceptance criterion, tested against
+  a REAL router + replica subprocess);
+* **cost attribution** — every record carries a phase class and a pid;
+  compile-bearing dispatches land in the persistent per-rung ledger;
+* **fleet stitching** — per-pid ``traces-<pid>.jsonl`` sinks merge by
+  trace id, clock-offset corrected and causally clamped, and a kill -9'd
+  replica's torn final line never breaks the merge;
+* **tail sampling** — ``FMT_TRACE_TAIL`` persists only anomalous traces
+  (the disabled path stays one module-bool check);
+* **rotation** — the sink rotates at ``FMT_TRACE_MAX_MB`` with the
+  reports-style commit sidecar, and ``load_spans`` reads both
+  generations.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.api.pipeline import Pipeline
+from flink_ml_tpu.common import fused
+from flink_ml_tpu.lib import LogisticRegression
+from flink_ml_tpu.lib.feature import StandardScaler
+from flink_ml_tpu.obs import flight, telemetry, trace
+from flink_ml_tpu.serve import integrity
+from flink_ml_tpu.serving import (
+    ReplicaRouter,
+    ServerOverloadedError,
+)
+from flink_ml_tpu.serving.batcher import ServeResult
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+N, D = 192, 5
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+WAIT = 120  # generous future timeout: a hang fails loudly, not flakily
+
+
+@pytest.fixture(scope="module")
+def dense_table():
+    rng = np.random.RandomState(23)
+    X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+    w = rng.randn(D).astype(np.float32)
+    y = ((X - 1.0) @ w > 0).astype(np.float64)
+    return Table.from_columns(SCHEMA, {"features": X, "label": y})
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory, dense_table):
+    """One fitted+saved pipeline the real-subprocess fleet serves."""
+    root = tmp_path_factory.mktemp("fleet_trace_models")
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(dense_table)
+    path = str(root / "v1")
+    model.save(path)
+    return {"path": path, "model": model}
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Tracing on at sample=1.0, spans to a per-test sink; clean exit."""
+    monkeypatch.setenv("FMT_TRACE_DIR", str(tmp_path))
+    trace.reset()
+    trace.enable(True, sample=1.0)
+    yield tmp_path
+    trace.enable(False, sample=1.0)
+    trace.set_tail("")
+    trace.reset()
+
+
+def _spans(trace_id=None):
+    spans = trace.recent_spans()
+    if trace_id is None:
+        return spans
+    return [s for s in spans if s["trace_id"] == trace_id]
+
+
+# -- adopt: the cross-process handoff -----------------------------------------
+
+
+class TestAdopt:
+    def test_disabled_or_empty_is_shared_nullcontext(self):
+        assert not trace.enabled()
+        assert trace.adopt("cafe", "beef") is trace.adopt("", "")
+        assert trace.adopt(None) is trace.span("x")
+
+    def test_span_under_adopt_lands_in_remote_trace(self, traced):
+        with trace.adopt("cafe01", "beef02"):
+            with trace.span("work", {"k": 1}):
+                pass
+        (rec,) = _spans("cafe01")
+        assert rec["parent_id"] == "beef02"
+        assert rec["name"] == "work"
+
+    def test_start_request_joins_adopted_context(self, traced):
+        with trace.adopt("cafe01", "beef02"):
+            rt = trace.start_request("serving.request", {"rows": 3})
+            assert rt is not None
+            assert rt.trace_id == "cafe01"
+            trace.record_span((rt.ctx,), "queue_wait", 0.01)
+            rt.end(status="ok")
+        recs = {s["name"]: s for s in _spans("cafe01")}
+        # the joined root parents under the REMOTE span, not ""
+        assert recs["serving.request"]["parent_id"] == "beef02"
+        assert recs["queue_wait"]["parent_id"] == rt.ctx.span_id
+
+    def test_joined_root_skips_the_sampling_coin_flip(self, traced):
+        trace.enable(True, sample=0.0)
+        assert trace.start_request("r") is None  # true mint: sampled out
+        with trace.adopt("cafe01", "beef02"):
+            # adopted context IS the remote sampled-in verdict
+            assert trace.start_request("r") is not None
+
+    def test_joined_root_end_flushes_the_sink(self, traced):
+        """An adopted request never records a parentless line, so the
+        BOUNDARY flag (not parent-lessness) must trigger the flush."""
+        with trace.adopt("cafe01", "beef02"):
+            rt = trace.start_request("serving.request")
+            rt.end()
+        spans = trace.load_spans(str(traced))
+        assert [s["name"] for s in spans] == ["serving.request"]
+
+
+# -- phase + pid attribution --------------------------------------------------
+
+
+class TestPhases:
+    def test_known_span_names_classify(self):
+        assert trace.phase_of("queue_wait") == "queue"
+        assert trace.phase_of("coalesce") == "coalesce"
+        assert trace.phase_of("place_h2d") == "h2d"
+        assert trace.phase_of("fused_dispatch") == "compute"
+        assert trace.phase_of("device_sync") == "compute"
+        assert trace.phase_of("demux") == "demux"
+        assert trace.phase_of("compile") == "compile"
+        assert trace.phase_of("router.dispatch") == "net"
+        assert trace.phase_of("router.request") == "queue"
+        assert trace.phase_of("something_else") == "compute"
+
+    def test_records_carry_phase_and_pid(self, traced):
+        with trace.root_span("fit"):
+            with trace.span("place_h2d"):
+                pass
+        by_name = {s["name"]: s for s in _spans()}
+        assert by_name["place_h2d"]["phase"] == "h2d"
+        assert by_name["fit"]["pid"] == os.getpid()
+
+    def test_phase_totals_use_self_time(self):
+        spans = [
+            {"trace_id": "t", "span_id": "a", "parent_id": "", "name": "r",
+             "ts": 0.0, "dur_s": 1.0, "phase": "queue"},
+            {"trace_id": "t", "span_id": "b", "parent_id": "a",
+             "name": "transform", "ts": 0.1, "dur_s": 0.8,
+             "phase": "compute"},
+        ]
+        totals = trace.phase_totals(spans, "t")
+        assert totals["queue"] == pytest.approx(0.2)
+        assert totals["compute"] == pytest.approx(0.8)
+
+
+# -- tail sampling ------------------------------------------------------------
+
+
+class TestTailSampling:
+    def test_fast_ok_trace_is_dropped_slow_kept(self, traced, monkeypatch):
+        monkeypatch.setenv("FMT_TRACE_SLOW_MS", "40")
+        trace.set_tail("slow")
+        fast = trace.start_request("serving.request")
+        with trace.use((fast.ctx,)):
+            with trace.span("coalesce"):
+                pass
+        fast.end()
+        slow = trace.start_request("serving.request")
+        time.sleep(0.06)
+        slow.end()
+        trace.flush()
+        kept = trace.trace_ids(trace.load_spans(str(traced)))
+        assert kept == [slow.trace_id]
+        # the dropped trace still reached the in-memory ring (debugging)
+        assert fast.trace_id in {s["trace_id"] for s in _spans()}
+        assert trace.sink_status()["tail_dropped"] >= 1
+
+    def test_error_and_shed_modes(self, traced):
+        trace.set_tail("error,shed")
+        ok = trace.start_request("r")
+        ok.end(status="ok")
+        err = trace.start_request("r")
+        err.end(status="error")
+        shed = trace.start_request("r")
+        shed.end(status="shed")
+        kept = set(trace.trace_ids(trace.load_spans(str(traced))))
+        assert kept == {err.trace_id, shed.trace_id}
+
+    def test_kept_trace_keeps_its_children_too(self, traced):
+        trace.set_tail("error")
+        rt = trace.start_request("r")
+        with trace.use((rt.ctx,)):
+            with trace.span("transform"):
+                pass
+        rt.end(status="error")
+        names = {s["name"] for s in trace.load_spans(str(traced))}
+        assert names == {"r", "transform"}
+
+    def test_disabled_hot_path_unchanged(self):
+        """Tail sampling must not touch the FMT_TRACE=0 contract."""
+        assert not trace.enabled()
+        assert trace.span("x") is trace.span("y")
+        assert trace.start_request("r") is None
+
+
+# -- rotation + commit sidecar ------------------------------------------------
+
+
+class TestRotation:
+    def test_sink_rotates_with_commit_sidecar(self, traced, monkeypatch):
+        monkeypatch.setenv("FMT_TRACE_MAX_MB", "0.001")  # ~1 KiB
+        written = 0
+        while trace.sink_status()["rotations"] == 0 and written < 64:
+            with trace.root_span("fit", {"pad": "x" * 64}):
+                pass
+            written += 1
+        assert trace.sink_status()["rotations"] == 1
+        with trace.root_span("fit", {"pad": "x" * 64}):
+            pass  # one span in the fresh post-rotation sink
+        trace.flush()
+        rotated = trace.traces_path() + ".1"
+        assert os.path.exists(rotated)
+        assert integrity.verify_commit_record(rotated, required=True)
+        # one rotation deep: both generations merge on read
+        assert len(trace.load_spans(str(traced))) == written + 1
+
+    def test_default_cap_does_not_rotate_tiny_sinks(self, traced):
+        with trace.root_span("fit"):
+            pass
+        trace.flush()
+        assert not os.path.exists(trace.traces_path() + ".1")
+
+
+# -- fleet stitching ----------------------------------------------------------
+
+
+def _write_sink(directory, pid, records, torn_tail=False):
+    path = os.path.join(str(directory), f"traces-{pid}.jsonl")
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        if torn_tail:
+            f.write('{"trace_id": "t1", "span_id": "to')  # kill -9 mid-write
+    return path
+
+
+class TestStitching:
+    def _fleet(self, directory):
+        root = {"trace_id": "t1", "span_id": "r", "parent_id": "",
+                "name": "router.request", "ts": 10.0, "dur_s": 0.5,
+                "status": "ok", "phase": "queue", "pid": 100, "attrs": {}}
+        disp = {"trace_id": "t1", "span_id": "d", "parent_id": "r",
+                "name": "router.dispatch", "ts": 10.1, "dur_s": 0.3,
+                "status": "ok", "phase": "net", "pid": 100, "attrs": {}}
+        # the replica's clock runs 2 s ahead: uncorrected, its spans
+        # would render far outside the router's window
+        serve = {"trace_id": "t1", "span_id": "s", "parent_id": "d",
+                 "name": "serving.request", "ts": 12.15, "dur_s": 0.2,
+                 "status": "ok", "phase": "queue", "pid": 200, "attrs": {}}
+        _write_sink(directory, 100, [root, disp])
+        _write_sink(directory, 200, [serve], torn_tail=True)
+        return root, disp, serve
+
+    def test_torn_partial_file_still_stitches(self, tmp_path):
+        self._fleet(tmp_path)
+        spans = trace.load_spans(str(tmp_path))
+        assert len(spans) == 3  # the torn line is skipped, not fatal
+        out = trace.render_waterfall(spans, "t1")
+        assert "serving.request" in out and "2 process(es)" in out
+        assert "@100" in out and "@200" in out
+
+    def test_clock_offset_correction_and_causal_clamp(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("FMT_TRACE_DIR", str(tmp_path))
+        self._fleet(tmp_path)
+        trace.note_clock_offset(200, 2.0, 0.004)
+        trace.note_clock_offset(200, 5.0, 0.5)  # worse RTT: ignored
+        offsets = trace.load_clock_offsets(str(tmp_path))
+        assert offsets == {200: 2.0}
+        stitched = trace.stitch(trace.load_spans(str(tmp_path)), offsets)
+        by_id = {s["span_id"]: s for s in stitched}
+        assert by_id["s"]["ts"] == pytest.approx(10.15)
+        # children never render before their cause, even if the offset
+        # estimate overshoots
+        assert by_id["s"]["ts"] >= by_id["d"]["ts"]
+
+    def test_fleet_cli_renders_and_rolls_up(self, tmp_path, capsys):
+        self._fleet(tmp_path)
+        assert trace.fleet_main(["--traces", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 process(es)" in out
+        assert "phase self-time:" in out
+        assert trace.fleet_main(["--traces", str(tmp_path), "--list"]) == 0
+        assert "processes=2" in capsys.readouterr().out
+
+    def test_fleet_cli_empty_dir(self, tmp_path, capsys):
+        assert trace.fleet_main(["--traces", str(tmp_path)]) == 1
+
+
+# -- the compile ledger -------------------------------------------------------
+
+
+class TestCompileLedger:
+    def test_note_compile_writes_ledger_and_span(self, traced, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path / "reports"))
+        with trace.root_span("fit"):
+            trace.note_compile("lr_serve", 32, 8, "float32", 1.25)
+            trace.note_compile("lr_serve", 32, 8, "float32", 9.0)  # dup
+        by_name = {s["name"]: s for s in _spans()}
+        assert by_name["compile"]["phase"] == "compile"
+        assert by_name["compile"]["attrs"]["bucket"] == 32
+        with open(trace.compile_ledger_path()) as f:
+            entries = [json.loads(line) for line in f]
+        assert len(entries) == 1  # keyed: one line per rung, not per call
+        assert entries[0]["kernel"] == "lr_serve"
+        assert entries[0]["mesh"] == 8
+        assert entries[0]["dur_s"] == pytest.approx(1.25)
+
+    def test_fused_serve_ledgers_its_first_dispatch(self, traced, tmp_path,
+                                                    monkeypatch, saved,
+                                                    dense_table):
+        monkeypatch.setenv("FMT_OBS_REPORTS", str(tmp_path / "reports"))
+        fused.reset_compile_keys()
+        with trace.root_span("transform"):
+            saved["model"].transform(dense_table.slice_rows(0, 16))
+        compiles = [s for s in _spans() if s["name"] == "compile"]
+        assert compiles, "first fused dispatch must record a compile span"
+        assert os.path.exists(trace.compile_ledger_path())
+
+
+# -- router spans against scripted fakes --------------------------------------
+
+
+class _FakeClient:
+    """Scripted ReplicaClient speaking the traced wire: ``script``
+    entries are consumed per submit — an exception instance raises,
+    anything else echoes the request back as a served result."""
+
+    def __init__(self, name, script=()):
+        self.name = name
+        self.script = list(script)
+        self.submits = 0
+        self.trace_ctxs = []
+
+    def submit(self, table, deadline_ms=None, timeout_s=120.0,
+               trace_ctx=None):
+        self.submits += 1
+        self.trace_ctxs.append(trace_ctx)
+        if self.script:
+            step = self.script.pop(0)
+            if isinstance(step, BaseException):
+                raise step
+        return ServeResult(table=table, quarantine={}, version="v1")
+
+    def deploy(self, path, version, timeout_s=600.0):
+        return version
+
+    def probe(self, timeout_s=2.0, depth=True):
+        out = {"ready": True, "reasons": []}
+        if depth:
+            out["queue_depth"] = 0.0
+        return out
+
+
+def _fake_router(clients, **kw):
+    table = {f"replica-{i}-g{i + 1}": c for i, c in enumerate(clients)}
+
+    def factory(name, path, version):
+        return table[name], None
+
+    kw.setdefault("poll_ms", 600_000.0)
+    return ReplicaRouter("/nonexistent", replicas=len(clients),
+                         replica_factory=factory, **kw)
+
+
+class TestRouterSpans:
+    def test_served_request_has_root_dispatch_and_wire_ctx(self, traced,
+                                                           dense_table):
+        a = _FakeClient("a")
+        router = _fake_router([a])
+        try:
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+        finally:
+            router.shutdown()
+        # satellite 1: the SUCCESS response surfaces the trace id
+        assert res.trace_id is not None
+        recs = {s["name"]: s for s in _spans(res.trace_id)}
+        assert recs["router.request"]["parent_id"] == ""
+        assert recs["router.request"]["status"] == "ok"
+        root_id = recs["router.request"]["span_id"]
+        assert recs["queue_wait"]["parent_id"] == root_id
+        assert recs["submit"]["parent_id"] == root_id
+        assert recs["router.dispatch"]["parent_id"] == root_id
+        # the wire context the replica would adopt IS the dispatch span
+        (ctx,) = a.trace_ctxs
+        assert ctx == (res.trace_id, recs["router.dispatch"]["span_id"])
+
+    def test_retries_are_sibling_spans_under_one_root(self, traced,
+                                                      dense_table):
+        a = _FakeClient("a", script=[ServerOverloadedError("queue_full")])
+        b = _FakeClient("b", script=[ServerOverloadedError("queue_full")])
+        router = _fake_router([a, b])
+        try:
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+        finally:
+            router.shutdown()
+        dispatches = [s for s in _spans(res.trace_id)
+                      if s["name"] == "router.dispatch"]
+        assert len(dispatches) >= 2
+        assert len({s["parent_id"] for s in dispatches}) == 1  # siblings
+        statuses = [s["status"] for s in dispatches]
+        assert statuses.count("shed") >= 1 and statuses[-1] == "ok"
+        attempts = [s["attrs"]["attempt"] for s in dispatches]
+        assert attempts == sorted(attempts)
+
+    def test_failed_request_ends_root_with_status(self, traced,
+                                                  dense_table):
+        a = _FakeClient("a", script=[ServerOverloadedError("breaker_open"),
+                                     ServerOverloadedError("breaker_open")])
+        router = _fake_router([a], retries=0)
+        try:
+            with pytest.raises(ServerOverloadedError):
+                router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+        finally:
+            router.shutdown()
+        roots = [s for s in _spans() if s["name"] == "router.request"]
+        assert roots and roots[-1]["status"] == "shed"
+
+    def test_untraced_requests_pass_no_wire_ctx(self, dense_table):
+        assert not trace.enabled()
+        a = _FakeClient("a")
+        router = _fake_router([a])
+        try:
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+        finally:
+            router.shutdown()
+        assert res.trace_id is None
+        assert a.trace_ctxs == [None]
+
+
+# -- status + flight ----------------------------------------------------------
+
+
+class TestStatusSurfaces:
+    def test_statusz_has_trace_section(self, traced):
+        snap = telemetry.status_snapshot()
+        assert snap["trace"]["enabled"] is True
+        assert snap["trace"]["sample"] == 1.0
+
+    def test_flight_events_carry_pid(self):
+        flight.reset()
+        flight.record("router.retry", replica="r0", why="test")
+        (event,) = [e for e in flight.events()
+                    if e["kind"] == "router.retry"]
+        assert event["pid"] == os.getpid()
+        flight.reset()
+
+
+# -- the acceptance criterion: a REAL router -> replica waterfall -------------
+
+
+class TestFleetEndToEnd:
+    def test_routed_request_stitches_across_processes(self, traced, saved,
+                                                      dense_table, capsys):
+        router = ReplicaRouter(saved["path"], version="v1", replicas=1,
+                               poll_ms=50, spawn_timeout_s=120)
+        try:
+            res = router.predict(dense_table.slice_rows(0, 8), timeout=WAIT)
+        finally:
+            router.shutdown()
+        assert res.num_rows == 8
+        assert res.trace_id is not None
+        trace.flush()
+        spans = trace.load_spans(str(traced))
+        mine = [s for s in spans if s["trace_id"] == res.trace_id]
+        pids = {s["pid"] for s in mine}
+        assert len(pids) >= 2, f"spans from one process only: {pids}"
+        by_name = {}
+        for s in mine:
+            by_name.setdefault(s["name"], s)
+        # nesting across the process boundary: router.request ->
+        # router.dispatch -> serving.request -> ... -> fused_dispatch
+        root = by_name["router.request"]
+        assert root["parent_id"] == "" and root["pid"] == os.getpid()
+        disp = by_name["router.dispatch"]
+        assert disp["parent_id"] == root["span_id"]
+        serve = by_name["serving.request"]
+        assert serve["parent_id"] == disp["span_id"]
+        assert serve["pid"] != os.getpid()
+        assert "fused_dispatch" in by_name
+        # the router probed the replica's clock on spawn
+        offsets = trace.load_clock_offsets(str(traced))
+        assert serve["pid"] in offsets
+        # and the fleet CLI renders it as ONE stitched waterfall
+        assert trace.fleet_main(
+            ["--traces", str(traced), res.trace_id]) == 0
+        out = capsys.readouterr().out
+        assert "2 process(es)" in out
+        assert "serving.request" in out
+        assert "phase self-time:" in out
