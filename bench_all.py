@@ -221,6 +221,37 @@ def _bench_glm(kind, n_rows, n_features, epochs, batch, lr, seed):
                             row_bytes=(n_features + 2) * 4,
                             t_short=steady_wall)
 
+    # dispatch-diet sub-sweep (ISSUE 17): the same short fit with batch
+    # donation off — params must be BITWISE-equal (donation and the
+    # bundled fetch may only change where buffers live and how results
+    # travel, never values), and the per-fit call_latency_ms shows the
+    # device-call window the single-buffer fetch + donated batch shrink.
+    # On CPU donation is inert (both arms build the identical program),
+    # so there the two latencies read the same.
+    n_short = max(2, epochs // 10)
+    _, model_d = fit_at_epochs(n_short, sweeps=1)
+    old_donate = os.environ.get("FMT_FUSE_DONATE")
+    os.environ["FMT_FUSE_DONATE"] = "0"
+    try:
+        _, model_nd = fit_at_epochs(n_short, sweeps=1)
+    finally:
+        if old_donate is None:
+            os.environ.pop("FMT_FUSE_DONATE", None)
+        else:
+            os.environ["FMT_FUSE_DONATE"] = old_donate
+    donate_params_equal = bool(
+        np.array_equal(model_d.coefficients(), model_nd.coefficients())
+        and model_d.intercept() == model_nd.intercept()
+    )
+    assert donate_params_equal, \
+        "donated-batch fit diverged from the non-donated run"
+
+    def _call_ms(m):
+        steps = getattr(m.train_metrics_, "steps", [])
+        return round(float(np.median(
+            [s.get("call_latency_ms", 0.0) for s in steps])), 1) \
+            if steps else None
+
     per_record_sps = _np_per_record_glm(
         X[:n_train], y[:n_train], lr, batch, kind
     )
@@ -241,6 +272,10 @@ def _bench_glm(kind, n_rows, n_features, epochs, batch, lr, seed):
         "steady_wall_s": round(steady_wall, 3),
         "sweep_walls_s": [round(w, 3) for w in walls],
         "first_fit_s": round(first_fit_s, 1),
+        "call_latency_ms": _call_ms(model),
+        "donate_call_latency_ms": _call_ms(model_d),
+        "nodonate_call_latency_ms": _call_ms(model_nd),
+        "donate_params_bitwise_equal": donate_params_equal,
         "shape": f"{n_train}x{n_features} f32 batch={batch} epochs={epochs}",
     }
     if kind == "logistic":
@@ -1391,6 +1426,202 @@ def bench_serve_fused(n_rows=200_000, n_features=16, batch=4096, sweeps=3):
                  f"(scaler->scaler->LR score), batch={batch}, "
                  f"{n_batches} batches, median of {sweeps}",
     })
+
+
+def bench_serve_pallas(n_rows=200_000, n_features=16, batch=4096, sweeps=3):
+    """Pallas serving kernel + low-precision inference legs (ISSUE 17).
+
+    Two gated ratios against the same XLA fused baseline:
+
+    - ``fused_pallas_over_xla``: the 3-stage chain served through ONE
+      ``serve_chain`` Pallas launch per batch (``FMT_SERVE_PALLAS=1``) vs
+      the XLA fused program.  One-kernel-per-dispatch is asserted via
+      ``fused.pallas_dispatches == pipeline.fused_dispatches``; discrete
+      predictions must be bit-identical.  On CPU the kernel runs in
+      interpret mode (an emulation, not the TPU lowering), so the CPU gate
+      bounds overhead; on TPU the single HBM pass is the win.
+    - ``quantized_over_f32``: the same chain at ``FMT_SERVE_PRECISION=
+      bf16`` (half the batch-placement bytes) vs f32.  Discrete parity is
+      asserted on margin rows — rows whose f32 probability clears 0.5 by
+      more than the documented bf16 tolerance band; a quantization bug
+      flips predictions far from the boundary and fails the assert.
+
+    A side (untimed) probe injects NaN/Inf rows and asserts the deferred
+    in-kernel quarantine scan yields the SAME side-table rows/reasons and
+    surviving predictions as the XLA path's host scan.
+    """
+    import warnings
+
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+    from flink_ml_tpu.serve import quarantine
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+    from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+    rng = np.random.RandomState(17)
+    X = (2.0 * rng.randn(n_rows, n_features) + 3.0).astype(np.float32)
+    true_w = (rng.randn(n_features) / np.sqrt(n_features)).astype(np.float32)
+    y = ((X - 3.0) @ true_w > 0).astype(np.float64)
+    schema = Schema.of(("features", DataTypes.DENSE_VECTOR),
+                       ("label", "double"))
+    t = Table.from_columns(schema, {"features": X, "label": y})
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        MinMaxScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_prediction_detail_col("proba")
+        .set_learning_rate(2.0).set_max_iter(30),
+    ]).fit(t)
+
+    env = MLEnvironmentFactory.get_default()
+    old_bs, env.default_batch_size = env.default_batch_size, batch
+    old_env = {k: os.environ.get(k) for k in
+               ("FMT_FUSE_TRANSFORM", "FMT_SERVE_PALLAS",
+                "FMT_SERVE_PRECISION")}
+
+    def arm(pallas, precision="f32"):
+        os.environ["FMT_FUSE_TRANSFORM"] = "1"
+        os.environ["FMT_SERVE_PALLAS"] = "1" if pallas else "0"
+        os.environ["FMT_SERVE_PRECISION"] = precision
+        return pallas, precision
+
+    def timed(table, pallas, precision="f32"):
+        arm(pallas, precision)
+        model.transform(table)  # warmup: compile every per-batch bucket
+        walls = []
+        for _ in range(sweeps):
+            t0 = time.perf_counter()
+            (out,) = model.transform(table)
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls)), out
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            # margin eval set: rows whose f32 probability clears the
+            # boundary by > the bf16 tolerance band — discrete parity is
+            # contractual there (boundary rows may legitimately flip)
+            arm(False)
+            (full,) = model.transform(t)
+            proba = np.asarray(full.col("proba"), dtype=np.float64)
+            eval_t = t.filter_rows(np.abs(proba - 0.5) > 0.02)
+            n_eval = eval_t.num_rows()
+            assert n_eval > n_rows * 0.8, n_eval  # fit separates classes
+
+            xla_s, xla_out = timed(eval_t, False)
+            obs.reset()
+            pallas_s, pallas_out = timed(eval_t, True)
+            counters = obs.registry().snapshot()["counters"]
+            obs.reset()
+            bf16_s, bf16_out = timed(eval_t, False, "bf16")
+            gauges = obs.registry().snapshot()["gauges"]
+
+            # one Pallas launch per fused dispatch, zero fallbacks
+            assert counters.get("fused.pallas_dispatches", 0) == \
+                counters.get("pipeline.fused_dispatches", -1), counters
+            assert "fused.pallas_fallbacks" not in counters, counters
+            n_batches = -(-n_eval // batch)
+            assert counters["fused.pallas_dispatches"] == \
+                (sweeps + 1) * n_batches, counters
+            assert gauges.get("serve.precision") == 16, gauges
+
+            pallas_pred_parity = bool(np.array_equal(
+                np.asarray(xla_out.col("pred")),
+                np.asarray(pallas_out.col("pred"))))
+            assert pallas_pred_parity, \
+                "pallas discrete predictions diverge from XLA"
+            quant_pred_parity = bool(np.array_equal(
+                np.asarray(xla_out.col("pred")),
+                np.asarray(bf16_out.col("pred"))))
+            assert quant_pred_parity, \
+                "bf16 discrete predictions diverge from f32 on margin rows"
+            pallas_proba_err = float(np.max(np.abs(
+                np.asarray(xla_out.col("proba"))
+                - np.asarray(pallas_out.col("proba")))))
+            quant_proba_err = float(np.max(np.abs(
+                np.asarray(xla_out.col("proba"))
+                - np.asarray(bf16_out.col("proba")))))
+
+            # quarantine parity probe (untimed): the deferred in-kernel
+            # scan must match the host scan's side-table exactly
+            Xq = np.asarray(
+                t.slice_rows(0, 4096).features_dense("features")).copy()
+            Xq[7, 0] = np.nan
+            Xq[513, 3] = np.inf
+            Xq[4000, 9] = -np.inf
+            bad_t = Table.from_columns(schema, {
+                "features": Xq, "label": y[:4096]})
+
+            def q_probe(pallas):
+                arm(pallas)
+                quarantine.reset()
+                (out,) = model.transform(bad_t)
+                qt = quarantine.quarantine_table("StandardScalerModel")
+                rows = sorted(int(r) for r in
+                              qt.col(quarantine.QUARANTINE_ROW_COL))
+                reasons = sorted(set(
+                    qt.col(quarantine.QUARANTINE_REASON_COL)))
+                quarantine.reset()
+                return rows, reasons, np.asarray(out.col("pred"))
+
+            x_rows, x_reasons, x_preds = q_probe(False)
+            p_rows, p_reasons, p_preds = q_probe(True)
+            quarantine_parity = bool(
+                x_rows == p_rows == [7, 513, 4000]
+                and x_reasons == p_reasons
+                and np.array_equal(x_preds, p_preds))
+            assert quarantine_parity, (x_rows, p_rows)
+    finally:
+        env.default_batch_size = old_bs
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    import jax
+
+    interpret = jax.default_backend() != "tpu"
+    shape = (f"{n_eval}x{n_features} f32 margin rows, 3 stages "
+             f"(scaler->scaler->LR score), batch={batch}, "
+             f"{-(-n_eval // batch)} batches, median of {sweeps}")
+    pallas_rec = _emit({
+        "metric": "PipelineModel.transform fused_pallas_over_xla",
+        "value": round(pallas_s / xla_s, 4),
+        "unit": "ratio (lower is better)",
+        "xla_ms": round(xla_s * 1e3, 1),
+        "pallas_ms": round(pallas_s * 1e3, 1),
+        "interpret_mode": interpret,
+        "pred_parity": pallas_pred_parity,
+        "proba_max_abs_err": pallas_proba_err,
+        "quarantine_parity": quarantine_parity,
+        "kernel_launches_per_dispatch": 1,
+        "shape": shape,
+    })
+    quant_rec = _emit({
+        "metric": "PipelineModel.transform quantized_over_f32",
+        "value": round(bf16_s / xla_s, 4),
+        "unit": "ratio (lower is better)",
+        "f32_ms": round(xla_s * 1e3, 1),
+        "bf16_ms": round(bf16_s * 1e3, 1),
+        "precision_bits": 16,
+        "pred_parity": quant_pred_parity,
+        "proba_max_abs_err": quant_proba_err,
+        "shape": shape,
+    })
+    return [pallas_rec, quant_rec]
+
+
+def bench_serve(n_rows=200_000, n_features=16, batch=4096, sweeps=3):
+    """The full serve suite: the staged-vs-fused gate plus the Pallas and
+    low-precision legs (all three ratios land in BASELINE.json)."""
+    fused_rec = bench_serve_fused(n_rows, n_features, batch, sweeps)
+    return [fused_rec] + bench_serve_pallas(n_rows, n_features, batch,
+                                            sweeps)
 
 
 def bench_serving(n_rows=20_000, n_features=16, n_requests=160, sweeps=3,
@@ -2784,7 +3015,7 @@ WORKLOADS = {
     "sparse_ooc": bench_sparse_ooc,
     "pipeline": bench_pipeline,
     "warmfit": bench_warm_fit,
-    "serve": bench_serve_fused,
+    "serve": bench_serve,
     "serving": bench_serving,
     "trace_overhead": bench_trace_overhead,
     "pressure": bench_pressure,

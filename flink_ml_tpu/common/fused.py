@@ -68,19 +68,38 @@ run), ``pipeline.fused_rows``, ``pipeline.plan_fallback_batches``, the
 ``fused.padded_rows`` per-batch pad accounting, and the per-device
 row-share breakdown ``/statusz`` renders (:func:`mesh_status`).
 
+Pallas hot path + low precision (ISSUE 17): a run whose device chain is
+one dense feature flow through declared ``pallas_op`` stages (scaler ->
+GLM today) can lower to ONE ``serve_chain`` Pallas launch — the
+quarantine NaN/Inf scan, the scaling, and the score in a single HBM pass
+(``FMT_SERVE_PALLAS``, default off; ``interpret=True`` off-TPU).  When
+the plan's sole validator reduces to the pure finite scan
+(:func:`~flink_ml_tpu.serve.quarantine.finite_scan_only`), validation
+DEFERS into that same launch: the kernel emits a per-row ok mask, bad
+rows are zeroed in-kernel, and the executor emits the identical
+quarantine side-table (offsets and all) after the dispatch.
+``FMT_SERVE_PRECISION=bf16|int8`` ships the batch placement (and model
+args) low-precision — compute upcasts to f32 on device, so discrete
+predictions stay bit-identical to f32 on margin-separated data while
+float scores carry a documented quantization tolerance; int8 keeps host
+validation (NaN is unrepresentable post-quantization) and falls back to
+the XLA fused program when Pallas is also requested.
+
 Knobs: ``FMT_FUSE_TRANSFORM`` (default on; off restores the stage-at-a-
 time transform verbatim), ``FMT_SERVE_MESH`` (default on; off pins fused
 serving to a single logical device — plain jit, no row sharding),
 ``FMT_SERVE_CSR_PAD`` (per-shard nnz pad multiple for sharded CSR),
 ``FMT_FUSE_DONATE`` (donate placed batch buffers to the dispatch;
-ignored on the CPU backend).
+ignored on the CPU backend), ``FMT_SERVE_PALLAS`` /
+``FMT_SERVE_PALLAS_TILE`` (the Pallas serving kernel and its row-tile
+size), ``FMT_SERVE_PRECISION`` (f32 | bf16 | int8 serving precision).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, namedtuple
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -101,6 +120,8 @@ __all__ = [
     "reset_compile_keys",
     "reset_mesh_stats",
     "serve_mesh_enabled",
+    "serve_pallas_enabled",
+    "serve_precision",
     "transform_fused",
 ]
 
@@ -108,6 +129,40 @@ __all__ = [
 def fusion_enabled() -> bool:
     """Is fused pipeline inference on?  ``FMT_FUSE_TRANSFORM`` (default 1)."""
     return knobs.knob_bool("FMT_FUSE_TRANSFORM")
+
+
+def serve_pallas_enabled() -> bool:
+    """Is the Pallas-fused serving kernel on?  ``FMT_SERVE_PALLAS``
+    (default 0 — opt-in while the measured delta accrues per backend)."""
+    return knobs.knob_bool("FMT_SERVE_PALLAS")
+
+
+def serve_precision() -> str:
+    """The serving numeric precision: ``f32`` (default), ``bf16`` or
+    ``int8`` (``FMT_SERVE_PRECISION``).  Unrecognized values degrade to
+    f32 — precision is an optimization knob, never a failure mode."""
+    p = knobs.knob_str("FMT_SERVE_PRECISION").strip().lower()
+    if p in ("bf16", "bfloat16"):
+        return "bf16"
+    if p in ("int8", "i8"):
+        return "int8"
+    return "f32"
+
+
+#: gauge value (``serve.precision``) and compile-ledger dtype per precision
+_PRECISION_BITS = {"f32": 32, "bf16": 16, "int8": 8}
+_PRECISION_DTYPE = {"f32": "float32", "bf16": "bfloat16", "int8": "int8"}
+
+
+#: per-execute dispatch mode — computed once per :meth:`FusedRun.execute`
+#: from the knobs so a knob flipped mid-feed never splits one run's
+#: batches across modes
+_ServeMode = namedtuple("_ServeMode", ["precision", "pallas", "defer"])
+
+
+#: the executor-internal output key the deferred in-kernel validation
+#: mask rides under (popped before any column reaches the sink)
+_ROW_OK_KEY = "__row_ok__"
 
 
 #: (plan, bucket rung, mesh width, dtype) keys whose first dispatch this
@@ -124,18 +179,21 @@ def reset_compile_keys() -> None:
         _COMPILE_SEEN.clear()
 
 
-def _note_first_dispatch(plan: str, b: int, width: int,
-                         dur_s: float) -> None:
+def _note_first_dispatch(plan: str, b: int, width: int, dur_s: float,
+                         dtype: str = "float32",
+                         pallas: bool = False) -> None:
     """First dispatch of a (plan, bucket, mesh, dtype) shape: record the
     compile-attributed span + ledger line (obs.trace.note_compile).
-    Every data desc this plan places is float32 (``_extract``), so the
-    dtype key is fixed until mixed-precision serving lands."""
-    key = (plan, b, width, "float32")
+    The dtype key is the placement precision (``FMT_SERVE_PRECISION``);
+    a Pallas-lowered plan ledgers under a ``pallas:`` key prefix so
+    ``obs fleet`` rollups tell Mosaic compiles from XLA compiles."""
+    name = ("pallas:" + plan) if pallas else plan
+    key = (name, b, width, dtype)
     with _COMPILE_LOCK:
         if key in _COMPILE_SEEN:
             return
         _COMPILE_SEEN.add(key)
-    obs.trace.note_compile(plan, b, width, "float32", dur_s)
+    obs.trace.note_compile(name, b, width, dtype, dur_s)
 
 
 def serve_mesh_enabled() -> bool:
@@ -228,6 +286,11 @@ class FusedKernel:
     model_args: tuple = ()
     finalize: Optional[Callable] = None
     env_outputs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    #: this stage's op in the Pallas serve chain (an ``ops.pallas_kernels.
+    #: SERVE_CHAIN_OPS`` name, exactly two model args) — None keeps the
+    #: stage XLA-only; a whole-run chain of declared ops lowers to one
+    #: ``serve_chain`` launch under ``FMT_SERVE_PALLAS``
+    pallas_op: Optional[str] = None
 
 
 # -- plan assembly ------------------------------------------------------------
@@ -308,6 +371,53 @@ class FusedRun:
             name for name in exit_schema.field_names
             if isinstance(exit_src[name], int)
         }
+        self.pallas_chain = self._pallas_chain()
+
+    def _pallas_chain(self) -> Optional[Tuple[Tuple[str, ...], int]]:
+        """``(per-stage op kinds, feature width)`` when this run's device
+        chain lowers to ONE ``serve_chain`` Pallas launch, else None: a
+        single dense/matrix data desc feeding stage 0, every stage a
+        declared ``pallas_op`` with exactly ``(pa, pb)`` model args and
+        one output key, each later stage consuming the previous stage's
+        width-d env column, and a GLM score only in final position (it
+        narrows the row to one lane)."""
+        from flink_ml_tpu.ops.pallas_kernels import SERVE_CHAIN_OPS
+
+        if self.has_csr or len(self.data_descs) != 1:
+            return None
+        if self.data_descs[0][0] not in ("dense", "matrix"):
+            return None
+        d = int(self.data_descs[0][2])
+        kinds = []
+        for i, ds in enumerate(self.device_stages):
+            op = ds.kernel.pallas_op
+            if (op not in SERVE_CHAIN_OPS or len(ds.out_keys) != 1
+                    or ds.marg_hi - ds.marg_lo != 2):
+                return None
+            if op == "glm_score" and i != len(self.device_stages) - 1:
+                return None
+            # the chain kernel assumes (d,)-sized stage params and a
+            # scalar intercept for the score — a multi-class weight
+            # matrix (or any other layout) stays on the XLA program
+            pa, pb = self.model_args[ds.marg_lo:ds.marg_hi]
+            want_b = 1 if op == "glm_score" else d
+            if np.asarray(pa).size != d or np.asarray(pb).size != want_b:
+                return None
+            if i == 0:
+                if ds.input_refs != [("arg", 0)]:
+                    return None
+            else:
+                prev = self.device_stages[i - 1].kernel
+                env_cols = {
+                    col.lower() for col, w in prev.env_outputs.values()
+                    if int(w) == d
+                }
+                if (len(ds.input_refs) != 1
+                        or ds.input_refs[0][0] != "env"
+                        or ds.input_refs[0][1] not in env_cols):
+                    return None
+            kinds.append(op)
+        return tuple(kinds), d
 
     # -- the one jitted program ----------------------------------------------
 
@@ -320,12 +430,15 @@ class FusedRun:
         def fused(*args):
             # inside a shard_map a ShardedCsrBatch's leaves are this
             # shard's slice with local row ids: reassemble the ordinary
-            # local CsrBatch the kernels consume
+            # local CsrBatch the kernels consume; low-precision args
+            # (bf16 arrays, int8 (q, scale) pairs) upcast to the f32
+            # compute type here, so only the H2D bytes shrink
             data = tuple(
-                a.local() if isinstance(a, ShardedCsrBatch) else a
+                a.local() if isinstance(a, ShardedCsrBatch)
+                else _dev_f32(a)
                 for a in args[:n_data]
             )
-            margs = args[n_data:]
+            margs = tuple(_dev_f32(m) for m in args[n_data:])
             env: Dict[str, object] = {}
             outs = []
             for ds in device_stages:
@@ -339,6 +452,30 @@ class FusedRun:
                 if ds.fetch:
                     outs.extend(res[k] for k in ds.out_keys)
             return tuple(outs)
+
+        return fused
+
+    def _pallas_fused_fn(self, masked: bool):
+        """The whole-chain Pallas program: ONE ``serve_chain`` launch for
+        scan (+mask, when validation is deferred) + every stage's math.
+        Same call signature as :meth:`_fused_fn`'s program — the single
+        data arg arrives column-padded to the kernel's 128-lane width
+        (:meth:`_extract`), outputs carry that padding back out and are
+        trimmed host-side in :meth:`_device_batch`."""
+        from flink_ml_tpu.ops.pallas_kernels import serve_chain
+
+        kinds, d = self.pallas_chain
+        fetch = tuple(ds.fetch for ds in self.device_stages)
+        chain = serve_chain(
+            kinds, fetch, d, masked=masked,
+            tile_rows=knobs.knob_int("FMT_SERVE_PALLAS_TILE"),
+        )
+        slices = [(ds.marg_lo, ds.marg_hi) for ds in self.device_stages]
+
+        def fused(x, *margs):
+            margs = tuple(_dev_f32(m) for m in margs)
+            pairs = [tuple(margs[lo:hi]) for lo, hi in slices]
+            return tuple(chain(_dev_f32(x), *pairs))
 
         return fused
 
@@ -369,16 +506,25 @@ class FusedRun:
             return ()
         return tuple(range(len(self.data_descs)))
 
-    def _apply_fn(self, mesh):
+    def _apply_fn(self, mesh, pallas: Optional[str] = None):
+        """The compiled program for (mesh, donation, pallas variant):
+        ``pallas`` is None for the XLA chain, ``"raw"`` for the Pallas
+        chain, ``"masked"`` for the Pallas chain with deferred in-kernel
+        validation (one extra leading per-row ok output)."""
         width = self._mesh_width(mesh)
         donate = self._donate_argnums()
-        key = (mesh, width > 1, donate)
+        key = (mesh, width > 1, donate, pallas)
         fn = self._apply_fns.get(key)
         if fn is not None:
             return fn
         import jax
 
-        fused = self._fused_fn()
+        if pallas is None:
+            fused = self._fused_fn()
+            n_out = len(self.fetch_layout)
+        else:
+            fused = self._pallas_fused_fn(masked=pallas == "masked")
+            n_out = len(self.fetch_layout) + (pallas == "masked")
         if width == 1:
             # a 1-wide data axis (or FMT_SERVE_MESH=0) degenerates to the
             # plain single-logical-device program
@@ -389,13 +535,14 @@ class FusedRun:
             from flink_ml_tpu.parallel.collectives import shard_map
 
             # P('data') is a pytree-prefix spec: a dense batch shards its
-            # rows, a ShardedCsrBatch shards each flat (n_shards*nnz_pad,)
-            # leaf — handing every device exactly its rows' entries
+            # rows (an int8 (q, scale) pair both its leaves), a
+            # ShardedCsrBatch each flat (n_shards*nnz_pad,) leaf —
+            # handing every device exactly its rows' entries
             in_specs = tuple(
                 [P("data")] * len(self.data_descs)
                 + [P()] * len(self.model_args)
             )
-            out_specs = tuple([P("data")] * len(self.fetch_layout))
+            out_specs = tuple([P("data")] * n_out)
             fn = jax.jit(shard_map(
                 fused, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
@@ -415,11 +562,28 @@ class FusedRun:
         # identically, and every shard_map sees equal row shards
         return _bucket_for(n, 256, row_multiple)
 
-    def _extract(self, batch: Table, b: int, mesh, row_multiple: int):
+    def _extract(self, batch: Table, b: int, mesh, row_multiple: int,
+                 mode: Optional[_ServeMode] = None):
         """Host half of one batch's device inputs: feature extraction +
         pad-to-bucket + best-effort async placement (runs on the prefetch
-        producer thread, overlapping the previous batch's compute)."""
+        producer thread, overlapping the previous batch's compute).  A
+        Pallas-bound batch additionally zero-pads its columns to the
+        kernel's 128-lane width here (host-side, once) so the launch
+        never re-lays out the batch; a low-precision mode quantizes the
+        dense placement (CSR values stay f32)."""
         from flink_ml_tpu.lib.common import _pad_rows_to
+
+        pallas = mode is not None and mode.pallas
+        precision = mode.precision if mode is not None else "f32"
+
+        def _dense(X):
+            if pallas:
+                d_pad = -(-max(X.shape[1], 1) // 128) * 128
+                if d_pad != X.shape[1]:
+                    Xp = np.zeros((X.shape[0], d_pad), dtype=X.dtype)
+                    Xp[:, : X.shape[1]] = X
+                    X = Xp
+            return _quantize(_pad_rows_to(X, b), precision)
 
         args = []
         for desc in self.data_descs:
@@ -429,12 +593,12 @@ class FusedRun:
                 X = np.asarray(
                     batch.features_dense(col, dim=dim), dtype=np.float32
                 )
-                args.append(_pad_rows_to(X, b))
+                args.append(_dense(X))
             elif kind == "matrix":
                 _, cols, _dim = desc
                 X = np.asarray(batch.numeric_matrix(list(cols)),
                                dtype=np.float32)
-                args.append(_pad_rows_to(X, b))
+                args.append(_dense(X))
             else:  # csr
                 from flink_ml_tpu.ops.batch import CsrBatch, ShardedCsrBatch
 
@@ -506,7 +670,48 @@ class FusedRun:
         good_all[orig] = True
         return b, good_all
 
-    def _prep_batches(self, table: Table, mesh, row_multiple: int):
+    def _margs_for(self, mode: Optional[_ServeMode]) -> tuple:
+        """The model args at the mode's placement precision (memoized —
+        params are static per run, so the low-precision copies are built
+        once): bf16 casts, int8 symmetric-quantizes to ``(q, scale)``
+        pairs the device program dequantizes.  Only stages with DECLARED
+        ``pallas_op`` semantics (affine params, GLM weights) quantize —
+        an opaque kernel's args may be categorical (kNN labels) or feed
+        tie-breaking argmins (centroids), where lossy params would break
+        the discrete-parity contract; those stay f32, the batch
+        placement low-precision either way."""
+        precision = mode.precision if mode is not None else "f32"
+        if precision == "f32":
+            return self.model_args
+        memo = self.__dict__.setdefault("_marg_memo", {})
+        margs = memo.get(precision)
+        if margs is None:
+            out = list(self.model_args)
+            for ds in self.device_stages:
+                if ds.kernel.pallas_op is None:
+                    continue
+                for i in range(ds.marg_lo, ds.marg_hi):
+                    out[i] = _quantize(
+                        np.asarray(out[i], dtype=np.float32), precision
+                    )
+            margs = memo[precision] = tuple(out)
+        return margs
+
+    def _defer_ok(self, t: Table) -> bool:
+        """May THIS batch's validation defer into the masked Pallas
+        launch?  Only when the plan's single validator would reduce to
+        the pure NaN/Inf row scan over the one data desc the kernel
+        already reads (:func:`quarantine.finite_scan_only`)."""
+        from flink_ml_tpu.serve import quarantine
+
+        kind, col, dim = self.data_descs[0]
+        if kind == "dense":
+            return quarantine.finite_scan_only(t, dim, vector_col=col)
+        return quarantine.finite_scan_only(t, dim,
+                                           feature_cols=list(col))
+
+    def _prep_batches(self, table: Table, mesh, row_multiple: int,
+                      mode: _ServeMode):
         batch_size = self.batch_size
         if batch_size is None or table.num_rows() <= batch_size:
             batches = [table]
@@ -519,7 +724,16 @@ class FusedRun:
             for _stage, mapper, _k in self.host_stages:
                 out = mapper._map_checked(t, validated=False)
                 t = mapper._helper.get_result_table(t, out)
-            t, good = self._validate_entry(t, offset)
+            deferred = (
+                mode.defer and t.num_rows() > 0 and self._defer_ok(t)
+            )
+            if deferred:
+                # in-kernel validation: the masked Pallas launch scans,
+                # flags, and zeroes bad rows; the executor emits the
+                # identical side-table after the dispatch
+                good = None
+            else:
+                t, good = self._validate_entry(t, offset)
             n = t.num_rows()
             args = None
             if n:
@@ -529,16 +743,22 @@ class FusedRun:
                 # (prefetch_iter hands it off explicitly)
                 with obs.trace.span("place_h2d",
                                     {"rows": n, "bucket": b}):
-                    args = self._extract(t, b, mesh, row_multiple)
-            yield offset, n_in, n, good, t, args
+                    args = self._extract(t, b, mesh, row_multiple, mode)
+            yield offset, n_in, n, good, t, args, deferred
             offset += n_in
 
-    def _device_batch(self, mesh, n: int, args):
+    def _device_batch(self, mesh, n: int, args,
+                      mode: Optional[_ServeMode] = None,
+                      deferred: bool = False):
         """The single fused dispatch for one batch: (re)place -> one jitted
         call -> one bundled fetch -> per-stage host finalize.  On a
         multi-device mesh the call is the shard_map program — one SPMD
         dispatch whose per-device outputs come back in the same single
-        bundled fetch (``fused.shard_map_dispatches`` proves the path)."""
+        bundled fetch (``fused.shard_map_dispatches`` proves the path).
+        On the Pallas path that one call is exactly ONE kernel launch
+        (``fused.pallas_dispatches`` counts them); its column-padded
+        outputs trim back to the plan's widths here, and a deferred
+        validation's per-row ok mask rides out under ``_ROW_OK_KEY``."""
         import jax
         import jax.numpy as jnp
 
@@ -547,6 +767,11 @@ class FusedRun:
         pressure.maybe_oom(n)
         width = self._mesh_width(mesh)
         b = _padded_rows(args)
+        pallas = mode is not None and mode.pallas
+        variant = ("masked" if deferred else "raw") if pallas else None
+        kinds = self.pallas_chain[0] if pallas else None
+        d = self.pallas_chain[1] if pallas else 0
+        margs = self._margs_for(mode)
         t0 = time.perf_counter()
         with obs.trace.span("fused_dispatch", {
             "rows": n, "plan": self.serve_name,
@@ -559,11 +784,17 @@ class FusedRun:
                 for a in args
             ]
             t_disp = time.perf_counter()
-            res = self._apply_fn(mesh)(*placed, *self.model_args)
+            res = self._apply_fn(mesh, variant)(*placed, *margs)
             # a first-seen (plan, bucket, mesh, dtype) shape pays its XLA
-            # compile inside THAT call — ledger it (phase: compile)
-            _note_first_dispatch(self.serve_name, b, width,
-                                 time.perf_counter() - t_disp)
+            # (or Mosaic, on the pallas: key) compile inside THAT call —
+            # ledger it (phase: compile)
+            _note_first_dispatch(
+                self.serve_name, b, width,
+                time.perf_counter() - t_disp,
+                dtype=_PRECISION_DTYPE[mode.precision] if mode else
+                "float32",
+                pallas=pallas,
+            )
             # the bundled fetch is the one sync point: its span IS the
             # device-execution window of the fused program
             with obs.trace.span("device_sync"):
@@ -573,14 +804,27 @@ class FusedRun:
             _note_device_rows(n, b, width)
         if b > n:
             obs.counter_add("fused.padded_rows", b - n)
+        if pallas:
+            obs.counter_add("fused.pallas_dispatches")
         out: Dict[str, Sequence] = {}
         i = 0
-        for ds in self.device_stages:
+        if variant == "masked":
+            out[_ROW_OK_KEY] = (
+                np.asarray(fetched[0][:n]).reshape(-1) > 0
+            )
+            i = 1
+        for si, ds in enumerate(self.device_stages):
             if not ds.fetch:
                 continue
             vals = {}
             for key in ds.out_keys:
-                vals[key] = fetched[i][:n]
+                v = fetched[i][:n]
+                if pallas:
+                    # trim the kernel's 128-lane column pad back to the
+                    # plan's widths: affine stages to d, the score to 1-D
+                    v = (np.asarray(v)[:, 0] if kinds[si] == "glm_score"
+                         else np.asarray(v)[:, :d])
+                vals[key] = v
                 i += 1
             cols = ds.kernel.finalize(vals, n)
             for c, v in cols.items():
@@ -599,7 +843,9 @@ class FusedRun:
         return out
 
     def _bisected_batch(self, mesh, t: Table, n: int, args,
-                        row_multiple: int):
+                        row_multiple: int,
+                        mode: Optional[_ServeMode] = None,
+                        deferred: bool = False):
         """Pressure-aware fused dispatch for one batch (ISSUE 9).
 
         The unsplit fast path IS :meth:`_device_batch` on the
@@ -623,25 +869,41 @@ class FusedRun:
                     # (an OOM'd attempt whose donation already landed):
                     # re-extract rather than dispatch deleted arrays
                     b = self._bucket(n, row_multiple)
-                    use = self._extract(t, b, mesh, row_multiple)
-                return self._device_batch(mesh, n, use)
+                    use = self._extract(t, b, mesh, row_multiple, mode)
+                return self._device_batch(mesh, n, use, mode, deferred)
             sub = t.slice_rows(lo, hi)
             b = self._bucket(hi - lo, row_multiple)
-            sub_args = self._extract(sub, b, mesh, row_multiple)
-            return self._device_batch(mesh, hi - lo, sub_args)
+            sub_args = self._extract(sub, b, mesh, row_multiple, mode)
+            return self._device_batch(mesh, hi - lo, sub_args, mode,
+                                      deferred)
 
         return pressure.run_bisected(
             fn, n, surface=self.serve_name, floor=max(1, row_multiple),
             n_dev=row_multiple,
         )
 
-    def _staged_batch(self, t: Table, offset: int):
+    def _staged_batch(self, t: Table, offset: int,
+                      mode: Optional[_ServeMode] = None,
+                      deferred: bool = False):
         """The per-stage fallback for one batch (breaker open / device
         failure): each device stage's own ``_apply_batch`` — which routes
         through its own ``serve.dispatch`` and CPU fallback — serves the
         batch exactly as the unfused pipeline would.  Entry validation
         already ran, so per-stage re-validation is skipped (same rows in,
-        same rows out: the sink's row accounting stays aligned)."""
+        same rows out: the sink's row accounting stays aligned).  When
+        validation was DEFERRED into the (now failed) Pallas launch, the
+        host verdict runs here first and rides out under ``_ROW_OK_KEY``
+        — same survivors, same side-table, exactly as the kernel would
+        have flagged them."""
+        if mode is not None and mode.pallas:
+            obs.counter_add("fused.pallas_fallbacks")
+        row_ok = None
+        if deferred:
+            verdict = self.validators[0].validate_batch(t)
+            row_ok = (np.ones(t.num_rows(), dtype=bool)
+                      if verdict is None
+                      else np.asarray(verdict[0], dtype=bool))
+            t = t.filter_rows(row_ok)
         obs.flight.record("plan.fallback", plan=self.serve_name,
                           rows=t.num_rows())
         with obs.trace.span("plan_fallback", {"plan": self.serve_name}):
@@ -649,12 +911,16 @@ class FusedRun:
                 t = ds.mapper._apply_batch(t, row_offset=offset,
                                            validate=False)
         obs.counter_add("pipeline.plan_fallback_batches")
-        return {name: t.col(name) for name in self.device_cols}
+        out = {name: t.col(name) for name in self.device_cols}
+        if row_ok is not None:
+            out[_ROW_OK_KEY] = row_ok
+        return out
 
     def execute(self, table: Table) -> Table:
         from flink_ml_tpu import serve
         from flink_ml_tpu.parallel.mesh import inference_mesh, \
             mesh_spans_processes
+        from flink_ml_tpu.serve import quarantine
         from flink_ml_tpu.utils.environment import MLEnvironmentFactory
         from flink_ml_tpu.utils.prefetch import prefetch_iter
 
@@ -666,6 +932,28 @@ class FusedRun:
         # must agree its breaker verdict open-wins across the mesh, or a
         # collective-bearing program would split device-vs-fallback
         agreed = mesh_spans_processes(mesh)
+        # dispatch mode, pinned for the whole run: placement precision
+        # (int8 keeps host validation — NaN is unrepresentable after
+        # quantization — and keeps the XLA program), the Pallas chain
+        # when this plan lowers, and scan deferral when the single
+        # validator reduces to the kernel's own finite scan (a
+        # process-spanning mesh agrees verdicts on the HOST mask, so it
+        # never defers)
+        precision = serve_precision()
+        pallas = (self.pallas_chain is not None and serve_pallas_enabled()
+                  and precision != "int8")
+        mode = _ServeMode(
+            precision,
+            pallas,
+            pallas and len(self.validators) == 1 and not agreed
+            and quarantine.enabled(),
+        )
+        obs.gauge_set("serve.precision", _PRECISION_BITS[precision])
+        if serve_pallas_enabled() and not pallas:
+            # the operator asked for Pallas and this plan can't lower
+            # (CSR/multi-input chain, undeclared stage, int8): one XLA
+            # fallback per run keeps the PALLAS-DEGRADED check honest
+            obs.counter_add("fused.pallas_fallbacks")
         field_order = self.exit_schema.field_names
         out_names = sorted(
             self.device_cols | set(self.batch_cols), key=field_order.index
@@ -675,7 +963,7 @@ class FusedRun:
         kept_parts: List[Tuple[int, int, Optional[np.ndarray]]] = []
         filtered = False
 
-        gen = self._prep_batches(table, mesh, row_multiple)
+        gen = self._prep_batches(table, mesh, row_multiple, mode)
         many = (
             self.batch_size is not None
             and table.num_rows() > self.batch_size
@@ -685,7 +973,7 @@ class FusedRun:
             # the producer thread under batch i's compute (the shared
             # prefetch idiom, utils/prefetch.py)
             gen = prefetch_iter(gen, depth=2, name="fused-prefetch")
-        for offset, n_in, n, good, t, args in gen:
+        for offset, n_in, n, good, t, args, deferred in gen:
             if n == 0:
                 out = {
                     name: np.zeros(0, dtype=DataTypes.numpy_dtype(typ))
@@ -693,7 +981,7 @@ class FusedRun:
                     if name in self.device_cols
                 }
             else:
-                if self.validators:
+                if self.validators and not deferred:
                     # fused-plan-entry drift tap (ISSUE 11): the entry-
                     # validated survivors, observed on the CONSUMER
                     # thread (the prefetch producer has no tap scope);
@@ -703,11 +991,36 @@ class FusedRun:
                 out = serve.dispatch(
                     self.serve_name,
                     device=lambda: self._bisected_batch(
-                        mesh, t, n, args, row_multiple
+                        mesh, t, n, args, row_multiple, mode, deferred
                     ),
-                    fallback=lambda: self._staged_batch(t, offset),
+                    fallback=lambda: self._staged_batch(
+                        t, offset, mode, deferred
+                    ),
                     agreed=agreed,
                 )
+            row_ok = out.pop(_ROW_OK_KEY, None)
+            if row_ok is not None:
+                # deferred validation's verdict (in-kernel mask, or the
+                # fallback's host scan): emit the SAME side-table the
+                # entry path would have — original-feed offsets, nan_inf
+                # reasons (finite_scan_only guarantees no other code) —
+                # then keep the survivors
+                row_ok = np.asarray(row_ok, dtype=bool)
+                reasons = np.full(n, None, dtype=object)
+                reasons[~row_ok] = quarantine.REASON_NAN_INF
+                quarantine.emit(self.validators[0].serve_name(), t,
+                                row_ok, reasons, row_offset=offset)
+                k = int(row_ok.sum())
+                if k != n:
+                    for name, v in list(out.items()):
+                        # device-path cols are still full-batch; the
+                        # staged fallback already served survivors only
+                        if len(v) == n:
+                            out[name] = np.asarray(v)[row_ok]
+                    t = t.filter_rows(row_ok)
+                    n = k
+                good = row_ok
+                obs.drift.observe_input(self.validators[0], t)
             for name in self.batch_cols:
                 out[name] = t.col(name)
             sink.append(out, n)
@@ -727,6 +1040,47 @@ class FusedRun:
         return Table.from_columns(self.exit_schema, cols)
 
 
+def _quantize(X: np.ndarray, precision: str):
+    """One dense placement at the serving precision.  ``bf16`` casts in
+    place (H2D ships half the bytes; compute upcasts on device).
+    ``int8`` symmetric-quantizes per buffer — ``scale = absmax/127``
+    over the FINITE values, ``q = clip(rint(X/scale))`` — and returns
+    ``(q, scale_column)``: the f32 scale broadcasts as a per-row column
+    so both leaves row-shard under ``P('data')``.  Non-finite values
+    quantize to 0 (int8 has no NaN; host validation is mandatory on
+    this path, so they never reach a real dispatch)."""
+    if precision == "bf16":
+        import ml_dtypes
+
+        return X.astype(ml_dtypes.bfloat16)
+    if precision == "int8":
+        flat = X.ravel()
+        finite = flat[np.isfinite(flat)]
+        amax = float(np.abs(finite).max()) if finite.size else 0.0
+        scale = (amax / 127.0) or 1.0
+        with np.errstate(invalid="ignore"):
+            q = np.clip(np.rint(X / scale), -127, 127)
+        q = np.where(np.isfinite(q), q, 0.0).astype(np.int8)
+        rows = X.shape[0] if X.ndim > 1 else 1
+        return q, np.full((rows, 1) if X.ndim > 1 else (),
+                          scale, dtype=np.float32)
+    return X
+
+
+def _dev_f32(a):
+    """Upcast one placed arg to the f32 compute type inside the traced
+    program: int8 ``(q, scale)`` pairs dequantize, bf16 upcasts, f32
+    (and CSR pytrees) pass through untouched."""
+    import jax.numpy as jnp
+
+    if isinstance(a, tuple):
+        q, s = a
+        return q.astype(jnp.float32) * s
+    if getattr(a, "dtype", None) == jnp.bfloat16:
+        return a.astype(jnp.float32)
+    return a
+
+
 def _padded_rows(args) -> int:
     """The padded row count a batch's extracted args carry (0 when the
     args hold no row-shaped value — never the case for a real plan)."""
@@ -737,6 +1091,8 @@ def _padded_rows(args) -> int:
             return a.n_shards * a.rows_per_shard
         if isinstance(a, CsrBatch):
             return a.n_rows
+        if isinstance(a, tuple):  # int8 (q, scale): q carries the rows
+            a = a[0]
         shape = getattr(a, "shape", None)
         if shape:
             return int(shape[0])
@@ -776,13 +1132,13 @@ def _try_place(a, mesh, row_multiple: int):
     from flink_ml_tpu.ops.batch import ShardedCsrBatch
 
     sharded_csr = isinstance(a, ShardedCsrBatch)
-    if not sharded_csr and not isinstance(a, np.ndarray):
+    if not sharded_csr and not isinstance(a, (np.ndarray, tuple)):
         return a  # unsharded CsrBatch pytrees place at call time, as staged
     try:
         if row_multiple > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if not sharded_csr and a.shape[0] % row_multiple:
+            if isinstance(a, np.ndarray) and a.shape[0] % row_multiple:
                 from flink_ml_tpu.lib.common import _pad_rows_to
 
                 a = _pad_rows_to(

@@ -535,7 +535,8 @@ def _combined_view_memo(stack: MinibatchStack) -> np.ndarray:
 
 def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
                           max_iter, tol, in_specs=None, out_specs=None,
-                          delta_fn=None, epoch_fn=None, check_vma=True):
+                          delta_fn=None, epoch_fn=None, check_vma=True,
+                          bundle=False, donate_batch=False):
     """The WHOLE training run as one compiled device program.
 
     Epochs are a ``lax.while_loop`` around the minibatch ``lax.scan``; the
@@ -555,7 +556,28 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
     are sharded.  Non-SGD algorithms (KMeans' Lloyd step) pass ``epoch_fn
     (params, batch) -> (params, loss, delta)`` instead of ``mb_grad_step`` to
     reuse the identical while_loop/termination/history scaffolding.
+
+    ``bundle`` folds the result packing INTO the training program: the four
+    outputs (params pytree, loss history, epochs, delta) ravel and
+    concatenate in-program into ONE flat device buffer, so the driver's
+    readback is a single ``np.asarray`` — :func:`fetch_flat`'s separate
+    concat program (an extra dispatch on the per-fit critical path)
+    disappears.  Bundled fns return that flat buffer instead of the 4-tuple
+    and carry ``bundle_fetch=True`` / ``loss_hist_len`` / ``donates_batch``
+    attrs for :func:`_run_fused_train`; direct callers (diagnose_perf, the
+    graft entry) keep the default unbundled 4-tuple contract.  Bundling
+    requires the default replicated out_specs — custom placements (feature
+    sharding) would concatenate MIXED shardings, the exact miscompile
+    :func:`fetch_flat` guards against — so custom ``out_specs`` forces it
+    off.  ``donate_batch`` additionally donates the batch argument to XLA
+    (the placed minibatch slab is dead after the run's first read, so its
+    HBM recycles into program temporaries instead of staying live for the
+    whole while_loop); only honored with ``bundle`` because the driver must
+    see ``donates_batch`` to place a FRESH never-pooled batch — donating a
+    slab-pooled buffer would delete it under the pool's feet.
     """
+    bundle = bundle and out_specs is None
+    key = key + (bool(bundle), bool(bundle and donate_batch))
     cached = _cache_get(key)
     if cached is not None:
         return cached
@@ -631,8 +653,41 @@ def _build_fused_train_fn(key, mb_grad_step, mesh, learning_rate, reg,
         # see make_pallas_grad_fn) — every other path stays strict
         check_vma=check_vma,
     )
-    return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)),
-                      fused=True)
+    if not bundle:
+        return _cache_put(key, jax.jit(sharded, donate_argnums=(0,)),
+                          fused=True)
+
+    # the dispatch-diet program (ISSUE 17): all four outputs are replicated
+    # under the default out_specs, so raveling them into one buffer is
+    # sharding-safe.  The fetch dtype mirrors fetch_flat (f64 only on the
+    # x64 CPU test mesh) so bundled and unbundled fits return bit-identical
+    # host values.
+    fetch_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    def bundled(params, batch):
+        params, loss_hist, epochs, delta = sharded(params, batch)
+        pieces = [
+            jnp.ravel(a).astype(fetch_dtype)
+            for a in jax.tree_util.tree_leaves(params)
+        ]
+        pieces.append(loss_hist.astype(fetch_dtype))
+        pieces.append(jnp.reshape(epochs, (1,)).astype(fetch_dtype))
+        pieces.append(jnp.reshape(delta, (1,)).astype(fetch_dtype))
+        return jnp.concatenate(pieces)
+
+    jitted = jax.jit(
+        bundled,
+        donate_argnums=(0, 1) if donate_batch else (0,),
+    )
+
+    def train_fn(placed, device_batch):
+        return jitted(placed, device_batch)
+
+    # attrs ride a plain closure: jit wrappers don't reliably accept them
+    train_fn.bundle_fetch = True
+    train_fn.loss_hist_len = int(max_iter)
+    train_fn.donates_batch = bool(donate_batch)
+    return _cache_put(key, train_fn, fused=True)
 
 
 def _run_fused_train(train_fn, init_params, batch, mesh,
@@ -645,7 +700,17 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     already sharded the batch (chunked checkpoint loops place it once).
     ``n_rows`` (true rows per epoch) feeds the recorded throughput metrics —
     a fused run is ONE device program, so it records one StepMetrics step
-    covering all epochs (the fetch is the sync point)."""
+    covering all epochs (the fetch is the sync point).
+
+    A ``train_fn`` built with ``bundle=True`` returns one flat device
+    buffer instead of the 4-tuple; the driver reads its ``bundle_fetch`` /
+    ``loss_hist_len`` / ``donates_batch`` attrs, splits the single
+    ``np.asarray`` readback by the placed leaves' (donation-surviving)
+    shape metadata, and — when the program donates its batch — places a
+    FRESH batch outside the slab pool and skips the pool pin (there is no
+    pooled entry to protect, and the buffers are gone after the call
+    anyway)."""
+    import contextlib
     from flink_ml_tpu.parallel.mesh import replicate
     from flink_ml_tpu.table import slab_pool
 
@@ -670,10 +735,22 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
     )
     global _RUN_BUILDS_SEEN
 
+    donate_batch = (
+        getattr(train_fn, "donates_batch", False) and not batch_preplaced
+    )
     t_place = _time.perf_counter()
     if batch_preplaced:
         device_batch = batch
         place_s = 0.0
+    elif donate_batch:
+        # the program donates its batch arg: the buffers must never enter
+        # the slab pool (donation deletes them; the pool would hand the
+        # dead entry to the next warm fit).  Same double-buffered chunked
+        # H2D as the pooled path, minus the pool bookkeeping.
+        from flink_ml_tpu.parallel.mesh import shard_batch_prefetched
+
+        device_batch = shard_batch_prefetched(mesh, batch)
+        place_s = _time.perf_counter() - t_place
     else:
         # pooled + double-buffered: a warm re-fit of the same host arrays
         # skips the transfer entirely (slab_pool hit); a cold placement
@@ -682,19 +759,45 @@ def _run_fused_train(train_fn, init_params, batch, mesh,
         place_s = _time.perf_counter() - t_place
     # pin the (possibly pooled) batch for the whole dispatch+fetch window:
     # budget eviction must never drop the pool's reference while a donating
-    # program is in flight over these buffers
-    with slab_pool.pool().pinned(device_batch):
+    # program is in flight over these buffers.  A donated fresh batch was
+    # never pooled — nothing to pin.
+    pin = (contextlib.nullcontext() if donate_batch
+           else slab_pool.pool().pinned(device_batch))
+    with pin:
         t_run = _time.perf_counter()
-        params, loss_hist, epochs, delta = train_fn(placed, device_batch)
-        dispatch_s = _time.perf_counter() - t_run
-        t_fetch = _time.perf_counter()
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        fetched = fetch_flat(
-            *leaves, loss_hist, jnp.asarray(epochs), jnp.asarray(delta)
-        )
-        # fetch_flat is the single sync point: it absorbs transfer + program +
-        # readback (no extra block_until_ready round-trips on tunneled devices)
-        sync_s = _time.perf_counter() - t_fetch
+        if getattr(train_fn, "bundle_fetch", False):
+            flat = train_fn(placed, device_batch)
+            dispatch_s = _time.perf_counter() - t_run
+            t_fetch = _time.perf_counter()
+            # ONE readback for the whole result: param leaves + loss
+            # history + epochs + delta ride a single flat buffer packed
+            # in-program.  Split by the placed leaves' shapes — shape
+            # metadata survives donation even though the buffers don't.
+            leaves, treedef = jax.tree_util.tree_flatten(placed)
+            hist_len = int(train_fn.loss_hist_len)
+            buf = np.asarray(flat)
+            fetched = []
+            off = 0
+            for a in leaves:
+                size = int(np.prod(a.shape))
+                fetched.append(buf[off : off + size].reshape(a.shape))
+                off += size
+            fetched.append(buf[off : off + hist_len])
+            fetched.append(buf[off + hist_len])
+            fetched.append(buf[off + hist_len + 1])
+            sync_s = _time.perf_counter() - t_fetch
+        else:
+            params, loss_hist, epochs, delta = train_fn(placed, device_batch)
+            dispatch_s = _time.perf_counter() - t_run
+            t_fetch = _time.perf_counter()
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            fetched = fetch_flat(
+                *leaves, loss_hist, jnp.asarray(epochs), jnp.asarray(delta)
+            )
+            # fetch_flat is the single sync point: it absorbs transfer +
+            # program + readback (no extra block_until_ready round-trips
+            # on tunneled devices)
+            sync_s = _time.perf_counter() - t_fetch
     n_epochs = int(fetched[-2])
     losses = [float(x) for x in fetched[-3][:n_epochs]]
     # call_latency_ms: the DRIVER's device-call window — param placement,
@@ -767,9 +870,14 @@ def make_glm_train_fn(
     reg: float,
     max_iter: int,
     tol: float,
+    bundle: bool = False,
+    donate_batch: bool = False,
 ):
     """Fused training over the dense combined layout
-    (see :func:`_build_fused_train_fn` for the program structure)."""
+    (see :func:`_build_fused_train_fn` for the program structure;
+    ``bundle``/``donate_batch`` select the single-buffer-fetch /
+    batch-donating program variant driven by :func:`_run_fused_train` —
+    direct callers that unpack the 4-tuple keep the defaults)."""
     check_vma = getattr(grad_fn, "shard_map_check_vma", True)
     key = ("train", grad_fn, mesh, float(learning_rate), float(reg),
            int(max_iter), float(tol), check_vma)
@@ -779,7 +887,7 @@ def make_glm_train_fn(
 
     return _build_fused_train_fn(
         key, mb_grad_step, mesh, learning_rate, reg, max_iter, tol,
-        check_vma=check_vma,
+        check_vma=check_vma, bundle=bundle, donate_batch=donate_batch,
     )
 
 
@@ -2597,8 +2705,22 @@ def train_glm(
                 init_params, stack, grad_fn, mesh, learning_rate, reg,
                 max_iter, tol,
             )
+        from flink_ml_tpu.utils import knobs
+
+        # dispatch diet (ISSUE 17): the fast path always bundles the
+        # result fetch into the training program; the batch is donated
+        # too when THIS driver places it (an estimator-supplied
+        # device_batch is slab-pooled — donation would delete the pool's
+        # entry) and donation isn't inert (CPU ignores it, warning per
+        # call — same contract as FusedRun._donate_argnums).
+        donate_batch = (
+            device_batch is None
+            and knobs.knob_bool("FMT_FUSE_DONATE")
+            and jax.default_backend() != "cpu"
+        )
         train_fn = make_glm_train_fn(
-            grad_fn, mesh, learning_rate, reg, max_iter, tol
+            grad_fn, mesh, learning_rate, reg, max_iter, tol,
+            bundle=True, donate_batch=donate_batch,
         )
         try:
             fault.maybe_oom(row_slots)

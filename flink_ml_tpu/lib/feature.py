@@ -197,6 +197,7 @@ class StandardScalerModelMapper(ModelMapper):
                 _c: np.asarray(fetched["out"], dtype=np.float32)
             },
             env_outputs={"out": (out_col, self._dim)},
+            pallas_op="affine_sub_mul",  # (x - shift) * inv_scale
         )
 
 
@@ -339,6 +340,7 @@ class MinMaxScalerModelMapper(ModelMapper):
                 _c: np.asarray(fetched["out"], dtype=np.float32)
             },
             env_outputs={"out": (out_col, self._dim)},
+            pallas_op="affine_mul_add",  # x * a + b
         )
 
 

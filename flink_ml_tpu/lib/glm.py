@@ -207,6 +207,7 @@ class LinearScoreMapper(ModelMapper):
             out_keys=("scores",),
             model_args=(self._w, self._b),
             finalize=self._fused_finalize,
+            pallas_op="glm_score",  # x @ w + b
         )
 
     def _scores(self, batch: Table) -> np.ndarray:

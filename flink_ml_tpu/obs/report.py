@@ -388,6 +388,34 @@ def serve_degraded_runs(reports: List[dict]) -> List[dict]:
     return flagged
 
 
+def pallas_degraded_runs(reports: List[dict]) -> List[dict]:
+    """Transform reports where a requested Pallas plan only served via the
+    XLA path.
+
+    ``FMT_SERVE_PALLAS`` was on but the serve delta shows Pallas
+    fallbacks with ZERO Pallas launches — the plan could not lower
+    (CSR chain, undeclared stage, int8 conflict) or every launch failed
+    into the staged program.  Same visibility rule as SERVE-DEGRADED:
+    latest report per transform name, informational (the XLA path is
+    exact, just slower than what the operator asked for)."""
+    latest: Dict[str, dict] = {}
+    for r in reports:
+        if r.get("kind") == "transform":
+            latest[str(r.get("name", ""))] = r
+    flagged = []
+    for _, r in sorted(latest.items()):
+        serve = (r.get("extra") or {}).get("serve") or {}
+        fallbacks = serve.get("fused.pallas_fallbacks", 0)
+        dispatches = serve.get("fused.pallas_dispatches", 0)
+        if fallbacks and not dispatches:
+            flagged.append(
+                {"name": r.get("name"), "ts": r.get("ts"),
+                 "git_sha": r.get("git_sha"), "serve": serve,
+                 "rows": (r.get("extra") or {}).get("rows")}
+            )
+    return flagged
+
+
 def drift_runs(reports: List[dict]) -> List[dict]:
     """Transform/serving reports carrying a drift section (ISSUE 11) —
     latest per (kind, name), the fault_assisted_runs visibility rule.
@@ -716,6 +744,7 @@ def main(argv=None) -> int:
         reports = reports[-args.last:]
     fault_assisted = fault_assisted_runs(reports)
     serve_degraded = serve_degraded_runs(reports)
+    pallas_degraded = pallas_degraded_runs(reports)
     drift_rows = drift_runs(reports)
     analysis = analysis_summary(args.reports)
     timing_summary = timing_quantile_summary(reports)
@@ -741,6 +770,7 @@ def main(argv=None) -> int:
             "metrics": rows,
             "fault_assisted": fault_assisted,
             "serve_degraded": serve_degraded,
+            "pallas_degraded": pallas_degraded,
             "drift": drift_rows,
             "analysis": analysis,
             "timings": timing_summary,
@@ -778,6 +808,15 @@ def main(argv=None) -> int:
         )
         print(f"SERVE-DEGRADED transform {sr['name']} "
               f"[{sr.get('git_sha', '')}]: {counters}")
+    # a requested Pallas plan that only served via XLA: exact results,
+    # but not the kernel the operator turned on — same visibility rule
+    for pr in pallas_degraded:
+        counters = ", ".join(
+            f"{k}={v:g}" for k, v in sorted(pr["serve"].items())
+            if k.startswith("fused.pallas")
+        )
+        print(f"PALLAS-DEGRADED transform {pr['name']} "
+              f"[{pr.get('git_sha', '')}]: {counters}")
     # data-plane drift per surface: the worst column against the deploy
     # reference — same visibility rule as the flags above
     for dr in drift_rows:

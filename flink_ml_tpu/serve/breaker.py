@@ -341,7 +341,8 @@ def dispatch(name: str, device: Callable, fallback: Optional[Callable] = None,
 
 # -- per-transform accounting -------------------------------------------------
 
-_SERVE_PREFIXES = ("serve.", "fault.retries.serve", "fault.giveups.serve")
+_SERVE_PREFIXES = ("serve.", "fault.retries.serve", "fault.giveups.serve",
+                   "fused.pallas")
 
 
 def serve_counter_snapshot() -> Dict[str, float]:

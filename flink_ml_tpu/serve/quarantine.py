@@ -53,6 +53,7 @@ __all__ = [
     "drain",
     "emit",
     "enabled",
+    "finite_scan_only",
     "quarantine_table",
     "quarantined_counts",
     "reset",
@@ -128,6 +129,43 @@ def _rows_finite(X: np.ndarray) -> np.ndarray:
 
 
 # -- validation ---------------------------------------------------------------
+
+
+def finite_scan_only(
+    batch: Table,
+    dim: int,
+    vector_col: Optional[str] = None,
+    feature_cols: Optional[List[str]] = None,
+    agreed: bool = False,
+) -> bool:
+    """Would :func:`validate_feature_batch` reduce to the pure NaN/Inf row
+    scan (``_rows_finite``) for this batch?
+
+    True only for the branches whose sole possible verdict is
+    ``nan_inf`` over the extracted numeric matrix: a matrix-backed 2D
+    vector column no wider than the model (wider is a structural
+    ``bad_dim``) and the ``feature_cols``/``numeric_matrix`` path.  This
+    is the precondition for deferring validation into a fused device
+    kernel — the kernel can flag non-finite rows but cannot diagnose
+    nulls, type errors, ragged dimensions, or CSR index bounds, and a
+    cross-process agreed mask needs the host verdict before dispatch."""
+    import jax
+
+    if agreed and jax.process_count() > 1:
+        return False
+    if batch.num_rows() == 0:
+        return False
+    if feature_cols is not None and vector_col is None:
+        return True
+    if vector_col is None:
+        return False
+    col = batch.col(vector_col)
+    return (
+        DataTypes.is_vector(batch.schema.type_of(vector_col))
+        and isinstance(col, np.ndarray)
+        and col.ndim == 2
+        and col.shape[1] <= int(dim)
+    )
 
 
 def validate_feature_batch(

@@ -523,6 +523,10 @@ class ModelServer:
 
     def _telemetry_status(self) -> dict:
         """This server's /statusz contribution."""
+        from flink_ml_tpu.common.fused import (
+            serve_pallas_enabled, serve_precision,
+        )
+
         with self._cond:
             queued_rows = self._queued_rows
         return {
@@ -533,6 +537,12 @@ class ModelServer:
             "queued_rows": queued_rows,
             "queue_cap": self.config.queue_cap,
             "max_batch": self.config.max_batch,
+            # the data plane's numeric contract (ISSUE 17): the router
+            # surfaces each replica's serving precision and whether the
+            # Pallas hot path is requested — an operator diffing replica
+            # scores needs to see a precision split before anything else
+            "precision": serve_precision(),
+            "pallas": serve_pallas_enabled(),
             "stats": self.stats(),
         }
 

@@ -218,6 +218,12 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Chunk size for double-buffered cold placement (0 = one shot)."),
     Knob("FMT_HOT_SLAB_BUDGET_MB", "4096", "int",
          "HBM budget for the resident hot slab in hot/cold training."),
+    Knob("FMT_SERVE_PALLAS", "0", "bool",
+         "Pallas-fused serving kernel: scan+scale+score in one HBM pass."),
+    Knob("FMT_SERVE_PALLAS_TILE", "512", "int",
+         "Row-tile size for the Pallas serving kernel grid."),
+    Knob("FMT_SERVE_PRECISION", "f32", "str",
+         "Serving numeric precision: f32 (default), bf16, or int8."),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in DECLARATIONS}

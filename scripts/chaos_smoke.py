@@ -575,6 +575,57 @@ def serve_main() -> int:
           f"({plan_name}, "
           f"fallback_batches={counters.get('pipeline.plan_fallback_batches'):g})")
 
+    # -- leg 5: Pallas serving chain under chaos (ISSUE 17) ------------------
+    # the same 3-stage pipeline lowered to ONE Pallas kernel per batch:
+    # clean pass must be bit-identical to the XLA path with exactly one
+    # kernel launch per fused dispatch; a sticky dispatch fault must open
+    # the plan breaker and bottom out in the per-stage path — still exact
+    # — while the degraded run is flagged PALLAS-DEGRADED in the reports
+    os.environ["FMT_SERVE_PALLAS"] = "1"
+    try:
+        serve.reset_breakers()
+        obs.reset()
+        (pl_t,) = pipe.transform(table)
+        np.testing.assert_array_equal(
+            _col_matrix(pl_t, "p"), _col_matrix(ref_t, "p"),
+            err_msg="pallas chain: predictions diverge from XLA path",
+        )
+        counters = obs.registry().snapshot()["counters"]
+        n_disp = counters.get("fused.pallas_dispatches", 0)
+        assert n_disp >= 1, counters
+        assert n_disp == counters.get("pipeline.fused_dispatches"), counters
+        assert counters.get("fused.pallas_fallbacks", 0) == 0, counters
+
+        serve.reset_breakers()
+        obs.reset()
+        fault.configure("serve.dispatch@1+", seed=0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                pipe.transform(table)            # plan breaker absorbs
+                (pfb_t,) = pipe.transform(table)  # now fully open
+        finally:
+            fault.configure(None)
+        np.testing.assert_array_equal(
+            _col_matrix(pfb_t, "p"), _col_matrix(ref_t, "p"),
+            err_msg="pallas chain: faulted fallback predictions diverge",
+        )
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get("fused.pallas_fallbacks", 0) >= 1, counters
+        assert counters.get("fused.pallas_dispatches", 0) == 0, counters
+        from flink_ml_tpu.obs.report import (
+            load_reports,
+            pallas_degraded_runs,
+        )
+
+        pdeg = pallas_degraded_runs(load_reports(reports_dir))
+        assert pdeg, "no transform RunReport was flagged PALLAS-DEGRADED"
+        print(f"  pallas chain: clean parity ({n_disp:g} kernel launches) "
+              f"+ breaker fallback parity OK "
+              f"({len(pdeg)} PALLAS-DEGRADED run(s))")
+    finally:
+        os.environ.pop("FMT_SERVE_PALLAS", None)
+
     # -- RunReport accounting: fallback-only transforms are SERVE-DEGRADED ---
     from flink_ml_tpu.obs.report import load_reports, serve_degraded_runs
 
