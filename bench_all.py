@@ -2984,6 +2984,151 @@ def bench_serve_multichip(n_rows=65_536, n_features=16, batch=4096,
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _coldstart_worker(model_dir: str, out_path: str, n_rows: int,
+                      n_features: int) -> None:
+    """One arm of ``bench_coldstart`` — a FRESH process that deploys the
+    saved pipeline from disk (which activates the model-adjacent
+    warm-artifact store) and answers one small request.  Times
+    deploy-to-first-response, then reports its own compile-ledger line
+    count: the warm arm's must be ZERO — every executable replayed off
+    disk, none rebuilt."""
+    import warnings
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.obs import trace as obs_trace
+    from flink_ml_tpu.serving.versioning import VersionManager
+
+    obs.enable()
+    dense, _ = _multichip_tables(n_rows, n_features)
+    warmup = dense.slice_rows(0, 8)
+    request = dense.slice_rows(8, 24)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        t0 = time.perf_counter()
+        vm = VersionManager()
+        vm.deploy(os.path.join(model_dir, "model"), "v1", warmup=warmup)
+        out = vm.active().transform(request)
+        ttfr_s = time.perf_counter() - t0
+    ledger_lines = 0
+    try:
+        with open(obs_trace.compile_ledger_path()) as f:
+            ledger_lines = sum(1 for line in f if line.strip())
+    except OSError:
+        pass
+    counters = obs.registry().snapshot()["counters"]
+    with open(out_path, "w") as f:
+        json.dump({
+            "ttfr_s": ttfr_s,
+            "ledger_lines": ledger_lines,
+            "pred": np.asarray(out.col("pred")).tolist(),
+            "proba": np.asarray(out.col("proba")).tolist(),
+            "warm_hits": counters.get("warmstart.hits", 0),
+            "warm_saves": counters.get("warmstart.saves", 0),
+            "compile_skips": counters.get("warmstart.compile_skips", 0),
+            "ladder_rungs": counters.get("serving.warm_ladder_rungs", 0),
+            "degraded": counters.get("warmstart.degraded", 0),
+        }, f)
+
+
+def bench_coldstart(n_rows=2048, n_features=8):
+    """Cold-start resilience gate (ISSUE 18).
+
+    The parent fits the 3-stage dense chain ONCE (scaler -> scaler -> LR
+    score, the serve_multichip shape) and saves it, then launches two
+    FRESH subprocesses that each deploy it from disk and answer one small
+    request.  The cold arm pays every XLA compile across the warmup
+    ladder and seals the warm-artifact store beside the model; the warm
+    arm — a respawned replica in miniature — must replay every executable
+    off that store: its compile-ledger delta is asserted EMPTY and its
+    predictions bit-identical to the cold arm's (a deserialized
+    executable is the same program, not a re-derivation).
+
+    Emits ``cold_start_over_warm`` (warm time-to-first-response / cold,
+    lower is better) as the BASELINE.json contract gate.  Both arms share
+    the persistent XLA compile cache directory too, so the ratio is the
+    marginal win of AOT executable replay over bytecode-level caching —
+    the honest number a respawn actually sees.
+    """
+    import shutil
+    import subprocess
+
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+
+    dense, _ = _multichip_tables(n_rows, n_features)
+    work = tempfile.mkdtemp(prefix="bench_coldstart_")
+    try:
+        Pipeline([
+            StandardScaler().set_selected_col("features"),
+            MinMaxScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("pred")
+            .set_prediction_detail_col("proba")
+            .set_learning_rate(0.5).set_max_iter(4),
+        ]).fit(dense).save(os.path.join(work, "model"))
+
+        results = {}
+        for arm in ("cold", "warm"):
+            out_path = os.path.join(work, f"result_{arm}.json")
+            env = dict(os.environ)
+            env.pop("FMT_FAULT_INJECT", None)
+            env.pop("FMT_SERVE_MESH", None)
+            env.pop("FMT_WARM_DIR", None)  # store lands beside the model
+            env.pop("FLINK_ML_TPU_COMPILE_CACHE", None)
+            env["FMT_OBS"] = "1"
+            env["FMT_OBS_REPORTS"] = os.path.join(work, f"reports_{arm}")
+            env["FMT_WARMSTART"] = "1"
+            env["FMT_COMPILE_CACHE"] = os.path.join(work, "xla_cache")
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "_coldstart_worker", work, out_path, str(n_rows),
+                 str(n_features)],
+                capture_output=True, text=True, timeout=1200, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            assert proc.returncode == 0, (
+                proc.stdout[-2000:], proc.stderr[-4000:])
+            with open(out_path) as f:
+                results[arm] = json.load(f)
+
+        cold, warm = results["cold"], results["warm"]
+        assert cold["warm_saves"] > 0, cold       # the cold arm sealed it
+        assert warm["warm_hits"] > 0, warm        # ...and the warm arm hit
+        assert warm["degraded"] == 0, warm
+        # the contract's teeth: the warm process rebuilt NOTHING — zero
+        # fresh compiles across the whole ladder — and served the same
+        # bits the cold process did
+        assert warm["ledger_lines"] == 0, (
+            f"warm arm wrote {warm['ledger_lines']} compile-ledger lines "
+            "(expected an empty delta)", warm)
+        assert warm["pred"] == cold["pred"], (
+            "cold/warm discrete predictions diverge")
+        assert warm["proba"] == cold["proba"], (
+            "cold/warm float scores are not bit-identical")
+        return _emit({
+            "metric": "VersionManager.deploy cold_start_over_warm",
+            "value": round(warm["ttfr_s"] / cold["ttfr_s"], 4),
+            "unit": "ratio (lower is better)",
+            "cold_ttfr_ms": round(cold["ttfr_s"] * 1e3, 1),
+            "warm_ttfr_ms": round(warm["ttfr_s"] * 1e3, 1),
+            "cold_compiles": cold["ledger_lines"],
+            "warm_compiles": warm["ledger_lines"],
+            "warm_hits": warm["warm_hits"],
+            "ladder_rungs": cold["ladder_rungs"],
+            "pred_parity": True,  # asserted bit-identical above
+            "shape": f"{n_rows}x{n_features} dense 3-stage pipeline, "
+                     "fresh cold/warm subprocesses sharing one "
+                     "warm-artifact store + XLA disk cache",
+        })
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_sparse_file(n_rows, dim, nnz):
     """Create (once) the synthetic Criteo-shaped LibSVM file."""
     rng = np.random.RandomState(5)
@@ -3024,6 +3169,7 @@ WORKLOADS = {
     "online_loop": bench_online_loop,
     "router": bench_router,
     "serve_multichip": bench_serve_multichip,
+    "coldstart": bench_coldstart,
 }
 
 
@@ -3050,5 +3196,11 @@ if __name__ == "__main__":
             int(_a[0]), _a[1], _a[2], int(_a[3]), int(_a[4]), int(_a[5]),
             int(_a[6]),
         )
+    elif sys.argv[1:2] == ["_coldstart_worker"]:
+        # one cold/warm arm of bench_coldstart, re-exec'd in a fresh
+        # process so deploy-to-first-response includes real compile (or
+        # warm-replay) cost — never a workload name
+        _a = sys.argv[2:]
+        _coldstart_worker(_a[0], _a[1], int(_a[2]), int(_a[3]))
     else:
         main(sys.argv[1:])

@@ -58,6 +58,12 @@ Planted points (grep ``maybe_fail`` for the live set):
 ``router.spawn``    :meth:`~flink_ml_tpu.serving.replica.ReplicaProcess.
                     spawn` — replica subprocess boot (the respawn path's
                     bounded-retry lever)
+``warmstart.load``  :meth:`~flink_ml_tpu.serving.warmstart.WarmstartStore.
+                    load` — warm-artifact read (degrades to a plain
+                    recompile, never an error to the caller)
+``warmstart.save``  :meth:`~flink_ml_tpu.serving.warmstart.WarmstartStore.
+                    save` — warm-artifact persist (the replica keeps
+                    serving; the next process compiles again)
 ==================  =========================================================
 """
 

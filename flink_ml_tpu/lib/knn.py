@@ -378,6 +378,9 @@ class KnnModelMapper(ModelMapper):
             )],
             fn=fn,
             out_keys=("knn",),
+            # fn closes over program-shaping constants invisible in the
+            # arg shapes — they must key the warm-artifact entry
+            cache_token=(k, chunk, n_classes, bf16),
             model_args=(self._xt, self._yt),
             finalize=lambda fetched, n: self._vote_cols(fetched["knn"]),
         )

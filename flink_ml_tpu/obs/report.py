@@ -416,6 +416,33 @@ def pallas_degraded_runs(reports: List[dict]) -> List[dict]:
     return flagged
 
 
+def warmstart_degraded_runs(reports: List[dict]) -> List[dict]:
+    """Transform/serving reports whose warm-artifact reads degraded to
+    recompiles (ISSUE 18).
+
+    The serve delta shows ``warmstart.degraded.*`` — a torn write,
+    corrupt entry, or fingerprint mismatch was DETECTED and the plan
+    compiled fresh instead of replaying it.  Results are exact (the
+    whole point of the sidecar CRC check); what the operator loses is
+    the millisecond warm boot, so the flag carries the per-reason
+    counters.  Same visibility rule as SERVE-/PALLAS-DEGRADED: latest
+    report per name, informational."""
+    latest: Dict[str, dict] = {}
+    for r in reports:
+        if r.get("kind") in ("transform", "serving"):
+            latest[str(r.get("name", ""))] = r
+    flagged = []
+    for _, r in sorted(latest.items()):
+        serve = (r.get("extra") or {}).get("serve") or {}
+        if serve.get("warmstart.degraded", 0):
+            flagged.append(
+                {"name": r.get("name"), "ts": r.get("ts"),
+                 "git_sha": r.get("git_sha"), "serve": serve,
+                 "rows": (r.get("extra") or {}).get("rows")}
+            )
+    return flagged
+
+
 def drift_runs(reports: List[dict]) -> List[dict]:
     """Transform/serving reports carrying a drift section (ISSUE 11) —
     latest per (kind, name), the fault_assisted_runs visibility rule.
@@ -745,6 +772,7 @@ def main(argv=None) -> int:
     fault_assisted = fault_assisted_runs(reports)
     serve_degraded = serve_degraded_runs(reports)
     pallas_degraded = pallas_degraded_runs(reports)
+    warmstart_degraded = warmstart_degraded_runs(reports)
     drift_rows = drift_runs(reports)
     analysis = analysis_summary(args.reports)
     timing_summary = timing_quantile_summary(reports)
@@ -771,6 +799,7 @@ def main(argv=None) -> int:
             "fault_assisted": fault_assisted,
             "serve_degraded": serve_degraded,
             "pallas_degraded": pallas_degraded,
+            "warmstart_degraded": warmstart_degraded,
             "drift": drift_rows,
             "analysis": analysis,
             "timings": timing_summary,
@@ -817,6 +846,16 @@ def main(argv=None) -> int:
         )
         print(f"PALLAS-DEGRADED transform {pr['name']} "
               f"[{pr.get('git_sha', '')}]: {counters}")
+    # a warm-artifact read that degraded to a recompile: exact results,
+    # slow boot — the reason-coded counters say whether it was a torn
+    # write, rot, or a fingerprint (jax/backend) mismatch
+    for wr in warmstart_degraded:
+        counters = ", ".join(
+            f"{k}={v:g}" for k, v in sorted(wr["serve"].items())
+            if k.startswith("warmstart.")
+        )
+        print(f"WARMSTART-DEGRADED transform {wr['name']} "
+              f"[{wr.get('git_sha', '')}]: {counters}")
     # data-plane drift per surface: the worst column against the deploy
     # reference — same visibility rule as the flags above
     for dr in drift_rows:

@@ -342,7 +342,7 @@ def dispatch(name: str, device: Callable, fallback: Optional[Callable] = None,
 # -- per-transform accounting -------------------------------------------------
 
 _SERVE_PREFIXES = ("serve.", "fault.retries.serve", "fault.giveups.serve",
-                   "fused.pallas")
+                   "fused.pallas", "warmstart.")
 
 
 def serve_counter_snapshot() -> Dict[str, float]:

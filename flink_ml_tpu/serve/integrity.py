@@ -49,11 +49,19 @@ class AtomicFile:
     LAST — a crash at any earlier point leaves the previous committed file
     (or nothing) at the final path, never a truncated artifact.  On error
     the tmp file is removed.
+
+    ``unique_tmp`` makes the tmp name per-writer (pid-suffixed) so
+    CONCURRENT writers of the same path — N replicas warming the same
+    bucket ladder into a shared warm-artifact store — never stomp each
+    other's half-written tmp; each rename is atomic and the last writer
+    wins both the entry and its sidecar.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, unique_tmp: bool = False):
         self.path = path
-        self._tmp = path + ".tmp"
+        self._unique = unique_tmp
+        self._tmp = (f"{path}.{os.getpid()}.tmp" if unique_tmp
+                     else path + ".tmp")
         self._f = None
         self.crc = 0
         self.size = 0
@@ -84,16 +92,20 @@ class AtomicFile:
                 pass
             return False  # propagate the original error
         os.replace(self._tmp, self.path)
-        write_commit_record(self.path, size=self.size, crc32=self.crc)
+        write_commit_record(self.path, size=self.size, crc32=self.crc,
+                            unique_tmp=self._unique)
         return False
 
 
 def write_commit_record(path: str, size: Optional[int] = None,
-                        crc32: Optional[int] = None) -> str:
+                        crc32: Optional[int] = None,
+                        unique_tmp: bool = False) -> str:
     """Write ``<path>.commit.json`` (tmp+rename) for an already-final file.
 
     ``size``/``crc32`` default to a fresh streamed read of ``path`` — the
-    AtomicFile writer passes both so the commit costs no second read."""
+    AtomicFile writer passes both so the commit costs no second read.
+    ``unique_tmp`` pid-suffixes the sidecar's tmp for concurrent writers
+    (see :class:`AtomicFile`)."""
     if size is None or crc32 is None:
         size, crc32 = 0, 0
         with open(path, "rb") as f:
@@ -101,11 +113,12 @@ def write_commit_record(path: str, size: Optional[int] = None,
                 crc32 = zlib.crc32(chunk, crc32)
                 size += len(chunk)
     cp = commit_path(path)
-    with open(cp + ".tmp", "w") as f:
+    tmp = f"{cp}.{os.getpid()}.tmp" if unique_tmp else cp + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"size": int(size), "crc32": int(crc32)}, f)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(cp + ".tmp", cp)
+    os.replace(tmp, cp)
     return cp
 
 

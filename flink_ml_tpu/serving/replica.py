@@ -522,6 +522,23 @@ def _package_root() -> str:
         os.path.abspath(flink_ml_tpu.__file__)))
 
 
+def _cache_env(env: Dict[str, str]) -> None:
+    """Hand the parent's RESOLVED compile-cache and warm-artifact dirs to
+    a child replica, the way the trace sink dirs ride (the runtime may
+    have picked a directory that is in neither os.environ nor the child's
+    defaults) — otherwise a kill -9 -> respawn replica silently points at
+    a different ``~/.cache`` and recompiles the whole ladder."""
+    from flink_ml_tpu.serving import warmstart
+    from flink_ml_tpu.utils import compile_cache
+
+    d = compile_cache.cache_dir()
+    if d:
+        env["FMT_COMPILE_CACHE"] = d
+    store = warmstart.active()
+    if store is not None:
+        env.setdefault("FMT_WARM_DIR", store.root)
+
+
 class ReplicaProcess:
     """One supervised replica child: spawn, handshake, watch, stop.
 
@@ -576,6 +593,7 @@ class ReplicaProcess:
             env["FMT_TRACE_DIR"] = trace.trace_dir()
             env.setdefault("FMT_TRACE_SAMPLE", str(trace.sample_rate()))
             env.setdefault("FMT_TRACE_TAIL", ",".join(trace.tail_modes()))
+        _cache_env(env)
         env["PYTHONPATH"] = _package_root() + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )

@@ -994,8 +994,21 @@ class ReplicaRouter:
                     self._slots[index] = replacement
                 self._tally("router.respawns")
                 obs.counter_add("router.respawns")
+                # cold-start resilience (ISSUE 18): stamp how much of the
+                # warm-artifact ladder the replacement inherits — a 0 here
+                # on a fleet that should be warm is the first thing an
+                # operator chasing a post-crash latency spike needs to see
+                from flink_ml_tpu.serving import warmstart
+
+                with self._rep_lock:
+                    source_path = self._source_path
+                warm = warmstart.inherited_manifest_entries(source_path)
+                if warm:
+                    self._tally("router.respawns_warm")
+                    obs.counter_add("router.respawns_warm")
                 obs.flight.record("router.respawn", slot=index,
-                                  replica=replacement.name)
+                                  replica=replacement.name,
+                                  warm_entries=warm)
                 return
         finally:
             with self._rep_lock:

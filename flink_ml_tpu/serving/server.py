@@ -157,6 +157,21 @@ def _breaker_in_scope(name: str, scope: frozenset) -> bool:
     return False
 
 
+def _warmstart_status() -> dict:
+    """The /statusz warmstart section: the active warm-artifact store (or
+    None when the layer is inert) and its sealed-manifest coverage."""
+    from flink_ml_tpu.serving import warmstart
+
+    store = warmstart.active()
+    if store is None:
+        return {"store": None}
+    return {
+        "store": store.root,
+        "fingerprint": store.fingerprint,
+        "manifest_entries": len(store.manifest().get("entries", {})),
+    }
+
+
 class ModelServer:
     """Request-level model server over a deployed pipeline.
 
@@ -543,6 +558,11 @@ class ModelServer:
             # scores needs to see a precision split before anything else
             "precision": serve_precision(),
             "pallas": serve_pallas_enabled(),
+            # cold-start resilience (ISSUE 18): which warm-artifact store
+            # this replica serves from, and how much of the ladder its
+            # manifest says is already warm — the router's rollup makes a
+            # cold respawn visible before its first slow request would
+            "warmstart": _warmstart_status(),
             "stats": self.stats(),
         }
 

@@ -40,8 +40,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from flink_ml_tpu import obs
+from flink_ml_tpu.serving import warmstart
 from flink_ml_tpu.table.table import Table
+from flink_ml_tpu.utils import knobs
+from flink_ml_tpu.utils.compile_cache import bucket_batch_rows
 
 __all__ = ["ModelVersion", "VersionManager"]
 
@@ -151,9 +156,15 @@ class VersionManager:
                 if isinstance(model_or_path, (str, os.PathLike)) else None
             )
             candidate = ModelVersion(version, model, source)
+            if source is not None:
+                # path-deploys get the warm-artifact store beside the
+                # artifact (or FMT_WARM_DIR): executables this warmup
+                # compiles persist for respawned/rolling replicas
+                warmstart.activate_for(source)
             if warmup is not None and warmup.num_rows() > 0:
                 with obs.phase("serving.warmup"):
                     candidate.transform(warmup)
+                    self._warm_ladder(candidate, warmup)
             else:
                 obs.counter_add("serving.cold_deploys")
         except BaseException as exc:
@@ -188,7 +199,51 @@ class VersionManager:
         if swapped:
             obs.counter_add("serving.swaps")
         obs.gauge_set("serving.versions_deployed", deploys)
+        store = warmstart.active()
+        if store is not None:
+            # seal what this deploy warmed so an inheriting replica (kill
+            # -9 respawn, rolling deploy) can see the ladder is covered
+            store.seal_manifest()
         return candidate
+
+    @staticmethod
+    def _warm_ladder(candidate: ModelVersion, warmup: Table) -> None:
+        """Walk the first ``FMT_WARM_LADDER_MAX`` bucket rungs with tiled
+        warmup rows so the first odd-sized live request after the swap
+        finds its executable already compiled (and, with a warm-artifact
+        store active, already persisted).  Only runs when a store is
+        active — an in-memory deploy keeps today's single-shape warmup.
+        Per-rung failures degrade (counter + flight event): the live-
+        sample warmup above already proved the model serves."""
+        if warmstart.active() is None:
+            return
+        from flink_ml_tpu.utils.compile_cache import BATCH_BUCKET_LADDER
+
+        max_rungs = knobs.knob_int("FMT_WARM_LADDER_MAX")
+        if max_rungs <= 0:
+            return
+        n = warmup.num_rows()
+        cols = {
+            name: np.asarray(warmup.col(name))
+            for name in warmup.schema.field_names
+        }
+        for rung in BATCH_BUCKET_LADDER[:max_rungs]:
+            if rung == bucket_batch_rows(n):
+                continue  # the live-sample warmup above covered this rung
+            idx = np.arange(rung) % n
+            try:
+                tiled = Table.from_columns(
+                    warmup.schema,
+                    {name: v[idx] for name, v in cols.items()},
+                )
+                candidate.transform(tiled)
+                obs.counter_add("serving.warm_ladder_rungs")
+            except Exception as exc:
+                obs.counter_add("serving.warm_ladder_failures")
+                obs.flight.record(
+                    "serving.warm_ladder_failure", rung=int(rung),
+                    error=type(exc).__name__, detail=str(exc)[:200],
+                )
 
     @property
     def previous_version(self) -> Optional[str]:

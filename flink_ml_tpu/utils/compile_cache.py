@@ -15,9 +15,10 @@ Enabled automatically for non-CPU backends — at package import when
 ``jax_platforms`` names one explicitly, else deferred to the first mesh
 construction (where the backend initializes anyway):
 
-* cache directory: ``$FLINK_ML_TPU_COMPILE_CACHE`` if set, else
+* cache directory: ``$FMT_COMPILE_CACHE`` if set (legacy name
+  ``FLINK_ML_TPU_COMPILE_CACHE`` honored as a fallback), else
   ``~/.cache/flink_ml_tpu/xla`` (created on first use);
-* opt out with ``FLINK_ML_TPU_COMPILE_CACHE=off``; CPU backends are
+* opt out with ``FMT_COMPILE_CACHE=off``; CPU backends are
   opt-in only (set the env var to a directory) — see
   :func:`enable_compilation_cache` for why;
 * thresholds are set to cache everything (min entry size / min compile
@@ -38,6 +39,22 @@ import warnings
 from pathlib import Path
 
 _enabled_dir: str | None = None
+
+
+def _env_setting() -> str:
+    """The cache knob value: ``FMT_COMPILE_CACHE`` via the registry, with
+    the pre-registry ``FLINK_ML_TPU_COMPILE_CACHE`` name as a fallback so
+    existing deployments keep working through the rename."""
+    from flink_ml_tpu.utils import knobs
+
+    return (knobs.knob_str("FMT_COMPILE_CACHE")
+            or os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", ""))
+
+
+def cache_dir() -> str | None:
+    """The directory the persistent cache is currently enabled at (None
+    when disabled/deferred) — what replica spawn propagates to children."""
+    return _enabled_dir
 
 
 # -- batch-shape bucketing ----------------------------------------------------
@@ -114,7 +131,7 @@ def enable_compilation_cache(directory: str | None = None, *,
     """Point JAX's persistent compilation cache at ``directory`` (idempotent).
 
     Returns the cache directory in use, or ``None`` when disabled via
-    ``FLINK_ML_TPU_COMPILE_CACHE=off`` — or deferred: default-on applies
+    ``FMT_COMPILE_CACHE=off`` — or deferred: default-on applies
     only off the CPU backend (XLA:CPU AOT replay checks host machine
     features and logs SIGILL-risk errors when the compile-time feature set
     disagrees, observed with jax 0.9.0's +prefer-no-scatter
@@ -125,11 +142,10 @@ def enable_compilation_cache(directory: str | None = None, *,
     :func:`ensure_compilation_cache_for_backend`, which the mesh layer
     calls once the backend is actually being brought up
     (``backend_known=True`` skips the platform-string heuristic).  CPU
-    users opt in by pointing ``FLINK_ML_TPU_COMPILE_CACHE`` at a
-    directory.
+    users opt in by pointing ``FMT_COMPILE_CACHE`` at a directory.
     """
     global _enabled_dir
-    env = os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", "")
+    env = _env_setting()
     if env.lower() in ("off", "0", "disable", "disabled"):
         return None
 
@@ -170,7 +186,7 @@ def enable_compilation_cache(directory: str | None = None, *,
         # must never make the package unimportable — fall back to no cache
         warnings.warn(
             f"persistent compilation cache disabled: cannot use "
-            f"{directory!r} ({e}); set FLINK_ML_TPU_COMPILE_CACHE to a "
+            f"{directory!r} ({e}); set FMT_COMPILE_CACHE to a "
             "writable directory or to 'off' to silence this",
             stacklevel=2,
         )
@@ -189,7 +205,7 @@ def ensure_compilation_cache_for_backend() -> str | None:
     """
     if _enabled_dir is not None:
         return _enabled_dir
-    env = os.environ.get("FLINK_ML_TPU_COMPILE_CACHE", "")
+    env = _env_setting()
     if env.lower() in ("off", "0", "disable", "disabled"):
         return None
 

@@ -224,6 +224,22 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Row-tile size for the Pallas serving kernel grid."),
     Knob("FMT_SERVE_PRECISION", "f32", "str",
          "Serving numeric precision: f32 (default), bf16, or int8."),
+    # -- cold-start resilience --------------------------------------------
+    Knob("FMT_COMPILE_CACHE", "", "str",
+         "Persistent XLA compile-cache dir, or 'off' (legacy name "
+         "FLINK_ML_TPU_COMPILE_CACHE still honored as a fallback)."),
+    Knob("FMT_WARMSTART", "1", "bool",
+         "Warm-artifact layer: persist AOT-serialized fused executables "
+         "next to the model and load them before compiling."),
+    Knob("FMT_WARM_DIR", "", "str",
+         "Explicit warm-artifact store directory (default: warm_aot/ "
+         "beside the deployed model artifact)."),
+    Knob("FMT_WARM_LADDER_MAX", "4", "int",
+         "Bucket-ladder rungs deploy() pre-warms off the hot path when a "
+         "warm-artifact store is active (0 = live-sample shape only)."),
+    Knob("FMT_WARM_CACHE_MB", "512", "int",
+         "On-disk budget for the warm-artifact store; GC evicts stale "
+         "fingerprints first, then oldest entries."),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in DECLARATIONS}

@@ -2105,6 +2105,170 @@ def multichip_main() -> int:
     return 0
 
 
+def coldstart_main() -> int:
+    """The cold-start resilience chaos matrix (``--coldstart``, ISSUE 18).
+
+    1. **cold seed** — a path-deploy with a warmup sample must walk the
+       bucket ladder, serialize every compiled executable into the
+       model-adjacent warm-artifact store, and seal its manifest;
+    2. **warm replay** — a fresh model load in the same store must serve
+       its first request entirely off warm hits (zero fresh compile-ledger
+       keys for the warmed rung) with predictions EXACTLY equal;
+    3. **corrupt artifact** — a bit-flipped warm entry must degrade with
+       the reason-coded ``warmstart.degraded.corrupt`` counter + a flight
+       event, recompile, self-heal the entry, and serve bit-identical
+       results (never a wrong answer, never a crash) — with the transform
+       RunReport flagged by ``warmstart_degraded_runs`` (the
+       ``obs --check`` WARMSTART-DEGRADED line);
+    4. **kill -9 under load** — one replica of a 3-replica fleet is
+       SIGKILLed mid-traffic; the router must respawn it with ZERO
+       caller-visible failures and stamp the respawn ``warm`` (the child
+       inherits the sealed manifest and replays instead of recompiling).
+    """
+    import glob
+    import threading
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_coldstart_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ.pop("FMT_WARM_DIR", None)  # store lands beside the model
+    os.environ["FMT_WARMSTART"] = "1"
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline, PipelineModel
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.obs import flight
+    from flink_ml_tpu.obs.report import load_reports, warmstart_degraded_runs
+    from flink_ml_tpu.serving import ReplicaRouter, VersionManager, warmstart
+
+    table = dense_table()
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+    v1_dir = os.path.join(tempfile.mkdtemp(prefix="chaos_coldstart_"), "v1")
+    model.save(v1_dir)
+    (solo_out,) = model.transform(table)
+    solo_full = np.asarray(solo_out.col("p"))
+    solo = solo_full[:128]
+
+    # -- leg 1: cold seed — ladder walked, store populated, manifest sealed --
+    obs.reset()
+    flight.reset()
+    vm = VersionManager()
+    vm.deploy(v1_dir, "v1", warmup=table.slice_rows(0, 8))
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("warmstart.saves", 0) >= 1, c
+    assert c.get("serving.warm_ladder_rungs", 0) >= 1, c
+    inherited = warmstart.inherited_manifest_entries(v1_dir)
+    assert inherited >= 1, "deploy did not seal a warm-artifact manifest"
+    print(f"  cold seed: {c.get('warmstart.saves'):g} executables "
+          f"serialized across {c.get('serving.warm_ladder_rungs'):g} "
+          f"ladder rungs, manifest sealed ({inherited} entries)")
+
+    # -- leg 2: warm replay — fresh load serves off hits, results exact ------
+    obs.reset()
+    (out,) = PipelineModel.load(v1_dir).transform(table.slice_rows(0, 128))
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("warmstart.hits", 0) >= 1, c
+    assert c.get("warmstart.compile_skips", 0) >= 1, c
+    assert c.get("warmstart.degraded", 0) == 0, c
+    np.testing.assert_array_equal(np.asarray(out.col("p")), solo)
+    print(f"  warm replay: first request off {c.get('warmstart.hits'):g} "
+          "warm hit(s), zero fresh compiles, predictions exact")
+
+    # -- leg 3: corrupt artifact -> reason-coded degrade, self-heal, exact ---
+    store = warmstart.active()
+    assert store is not None
+    entries = glob.glob(os.path.join(store.root, "*", "*.aot"))
+    assert entries, store.root
+    for path in entries:  # every rung: the replayed one must be among them
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+    obs.reset()
+    flight.reset()
+    (out,) = PipelineModel.load(v1_dir).transform(table.slice_rows(0, 128))
+    c = obs.registry().snapshot()["counters"]
+    assert c.get("warmstart.degraded.corrupt", 0) >= 1, c
+    assert c.get("warmstart.degraded", 0) >= 1, c
+    assert c.get("warmstart.saves", 0) >= 1, c  # the entry self-healed
+    kinds = {e.get("kind") for e in flight.events()}
+    assert "warmstart.degraded" in kinds, kinds
+    np.testing.assert_array_equal(np.asarray(out.col("p")), solo)
+    flagged = warmstart_degraded_runs(load_reports(reports_dir))
+    assert flagged, "no transform RunReport flagged the degraded load"
+    print(f"  corrupt artifact: degraded.corrupt={c.get('warmstart.degraded.corrupt'):g} "
+          f"(flight event recorded, RunReport flagged), recompiled + "
+          f"re-serialized, predictions exact")
+
+    # -- leg 4: kill -9 under load -> warm respawn, zero failed requests -----
+    obs.reset()
+    n_replicas = 3
+    router = ReplicaRouter(v1_dir, version="v1", replicas=n_replicas,
+                           poll_ms=30)
+    assert router.ready_count() == n_replicas, router.replicas
+    failures, results = [], []
+    stop = threading.Event()
+
+    def load_loop():
+        i = 0
+        while not stop.is_set():
+            lo = (i * 4) % (N - 4)
+            try:
+                res = router.predict(table.slice_rows(lo, lo + 4),
+                                     timeout=120)
+                results.append((lo, res))
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                failures.append(exc)
+            i += 1
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+    while len(results) < 10:
+        time.sleep(0.005)
+    victim = router.replicas[0]["pid"]
+    t_kill = time.monotonic()
+    os.kill(victim, signal.SIGKILL)
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        stats = router.stats()
+        if (stats.get("router.respawns", 0) >= 1
+                and router.ready_count() >= n_replicas):
+            break
+        time.sleep(0.05)
+    recovery_s = time.monotonic() - t_kill
+    stop.set()
+    loader.join(60)
+    stats = router.stats()
+    try:
+        assert stats.get("router.respawns", 0) >= 1, stats
+        assert stats.get("router.respawns_warm", 0) >= 1, (
+            "the respawned replica booted cold — no sealed manifest "
+            f"inherited: {stats}")
+        assert router.ready_count() == n_replicas, router.replicas
+        assert not failures, (
+            f"{len(failures)} requests failed across the kill: "
+            f"{failures[0]!r}")
+        for lo, res in results:
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("p")), solo_full[lo:lo + 4],
+                err_msg=f"rows {lo}..{lo + 4} diverge from solo")
+        print(f"  kill -9 pid {victim}: {len(results)} requests served, "
+              f"zero failures, warm respawn in {recovery_s:.2f}s "
+              f"(respawns_warm={stats.get('router.respawns_warm'):g}, "
+              f"manifest entries inherited: "
+              f"{warmstart.inherited_manifest_entries(v1_dir)})")
+    finally:
+        router.shutdown()
+    print("coldstart chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -2129,6 +2293,8 @@ def main() -> int:
         return online_main()
     if "--multichip" in sys.argv:
         return multichip_main()
+    if "--coldstart" in sys.argv:
+        return coldstart_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

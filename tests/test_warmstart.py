@@ -1,0 +1,374 @@
+"""Cold-start resilience: compile-cache knob + warm-artifact store (ISSUE 18).
+
+Covers the satellite-4 checklist for ``enable_compilation_cache`` /
+``ensure_compilation_cache_for_backend`` (idempotency, ``=off`` opt-out,
+CPU-defer heuristic, legacy-name fallback) and the tentpole warm-artifact
+layer: AOT save/load round trip, torn-write / corrupt-entry / fingerprint
+mismatch -> detected degrade to recompile (counter + flight event, never a
+raise), bounded GC, fault-injection points, the fused lookup-before-compile
+path, the ladder warmup in ``VersionManager.deploy``, and the replica spawn
+env propagation.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from flink_ml_tpu import obs  # noqa: E402
+from flink_ml_tpu.serve import integrity  # noqa: E402
+from flink_ml_tpu.serving import warmstart  # noqa: E402
+from flink_ml_tpu.utils import compile_cache, knobs  # noqa: E402
+
+
+def _counters():
+    return obs.registry().snapshot().get("counters", {})
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    obs.enable()
+    obs.reset()
+    obs.flight.reset()
+    yield
+
+
+# -- compile-cache knob migration (satellite 1 + 4) ---------------------------
+
+
+@pytest.fixture
+def cache_state(monkeypatch):
+    """Isolate the module-global idempotency latch and both env names."""
+    old = compile_cache._enabled_dir
+    compile_cache._enabled_dir = None
+    monkeypatch.delenv("FMT_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("FLINK_ML_TPU_COMPILE_CACHE", raising=False)
+    yield monkeypatch
+    compile_cache._enabled_dir = old
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+
+
+class TestCompileCacheKnob:
+    def test_knob_declared(self):
+        names = {k.name for k in knobs.DECLARATIONS}
+        assert "FMT_COMPILE_CACHE" in names
+        assert "FMT_WARM_LADDER_MAX" in names
+        assert "FMT_WARMSTART" in names
+        assert "FMT_WARM_DIR" in names
+        assert "FMT_WARM_CACHE_MB" in names
+
+    def test_off_opt_out(self, cache_state):
+        cache_state.setenv("FMT_COMPILE_CACHE", "off")
+        assert compile_cache.enable_compilation_cache(backend_known=True) is None
+        assert compile_cache.cache_dir() is None
+
+    def test_legacy_name_fallback(self, cache_state, tmp_path):
+        d = str(tmp_path / "xla_legacy")
+        cache_state.setenv("FLINK_ML_TPU_COMPILE_CACHE", d)
+        assert compile_cache.enable_compilation_cache(backend_known=True) == d
+
+    def test_legacy_off_still_honored(self, cache_state):
+        cache_state.setenv("FLINK_ML_TPU_COMPILE_CACHE", "off")
+        assert compile_cache.enable_compilation_cache(backend_known=True) is None
+
+    def test_fmt_name_wins_over_legacy(self, cache_state, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        cache_state.setenv("FMT_COMPILE_CACHE", a)
+        cache_state.setenv("FLINK_ML_TPU_COMPILE_CACHE", b)
+        assert compile_cache.enable_compilation_cache(backend_known=True) == a
+
+    def test_cpu_defer_without_env(self, cache_state):
+        # jax_platforms is cpu under the test harness: default-on defers
+        assert compile_cache.enable_compilation_cache() is None
+        assert compile_cache.cache_dir() is None
+
+    def test_env_dir_enables_even_on_cpu(self, cache_state, tmp_path):
+        d = str(tmp_path / "xla_cpu_optin")
+        cache_state.setenv("FMT_COMPILE_CACHE", d)
+        assert compile_cache.enable_compilation_cache() == d
+
+    def test_idempotent(self, cache_state, tmp_path):
+        d = str(tmp_path / "xla")
+        assert compile_cache.enable_compilation_cache(d, backend_known=True) == d
+        # second call with the same dir is a no-op returning the same dir
+        assert compile_cache.enable_compilation_cache(d, backend_known=True) == d
+        assert compile_cache.cache_dir() == d
+
+    def test_ensure_for_backend_cpu_noop(self, cache_state):
+        assert compile_cache.ensure_compilation_cache_for_backend() is None
+
+    def test_ensure_for_backend_off(self, cache_state):
+        cache_state.setenv("FMT_COMPILE_CACHE", "off")
+        assert compile_cache.ensure_compilation_cache_for_backend() is None
+
+
+# -- warm-artifact store (tentpole) -------------------------------------------
+
+
+def _tiny_compiled():
+    x = jnp.arange(8, dtype=jnp.float32)
+    s = jnp.float32(2.0)
+    f = jax.jit(lambda a, b: a * b + 1.0)
+    return f.lower(x, s).compile(), (x, s)
+
+
+@pytest.fixture
+def store(tmp_path):
+    st = warmstart.WarmstartStore(str(tmp_path / "warm_aot"))
+    yield st
+
+
+class TestWarmstartStore:
+    def test_save_load_roundtrip(self, store):
+        compiled, args = _tiny_compiled()
+        key = store.entry_key("scaler", 8, 1, "float32", extra="t0")
+        assert store.save(key, compiled)
+        loaded = store.load(key)
+        assert loaded is not None
+        np.testing.assert_array_equal(
+            np.asarray(loaded(*args)), np.asarray(compiled(*args))
+        )
+        c = _counters()
+        assert c.get("warmstart.saves", 0) >= 1
+        assert c.get("warmstart.hits", 0) >= 1
+        # entry file + CRC sidecar both on disk
+        p = store.entry_path(key)
+        assert os.path.exists(p)
+        assert os.path.exists(integrity.commit_path(p))
+
+    def test_missing_entry_is_miss(self, store):
+        assert store.load(store.entry_key("nope", 1, 1, "float32")) is None
+        c = _counters()
+        assert c.get("warmstart.misses", 0) >= 1
+        assert c.get("warmstart.degraded", 0) == 0
+
+    def test_corrupt_entry_degrades_not_raises(self, store):
+        compiled, _ = _tiny_compiled()
+        key = store.entry_key("k", 8, 1, "float32")
+        assert store.save(key, compiled)
+        p = store.entry_path(key)
+        raw = bytearray(open(p, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(bytes(raw))
+        assert store.load(key) is None  # degrade, never a wrong answer
+        c = _counters()
+        assert c.get("warmstart.degraded", 0) >= 1
+        assert c.get("warmstart.degraded.corrupt", 0) >= 1
+        kinds = [e.get("kind") for e in obs.flight.events()]
+        assert "warmstart.degraded" in kinds
+
+    def test_torn_write_detected(self, store):
+        compiled, _ = _tiny_compiled()
+        key = store.entry_key("k", 8, 1, "float32")
+        assert store.save(key, compiled)
+        p = store.entry_path(key)
+        # simulate a torn write: entry landed but the commit record did not
+        os.remove(integrity.commit_path(p))
+        assert store.load(key) is None
+        assert _counters().get("warmstart.degraded.torn", 0) >= 1
+
+    def test_truncated_entry_detected(self, store):
+        compiled, _ = _tiny_compiled()
+        key = store.entry_key("k", 8, 1, "float32")
+        assert store.save(key, compiled)
+        p = store.entry_path(key)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        assert store.load(key) is None
+        assert _counters().get("warmstart.degraded.corrupt", 0) >= 1
+
+    def test_fingerprint_mismatch_degrades(self, store):
+        key = store.entry_key("k", 8, 1, "float32")
+        blob = pickle.dumps({
+            "fmt": warmstart.ENTRY_FORMAT,
+            "fingerprint": "0" * 12,
+            "key": key,
+            "payload": b"",
+            "in_tree": None,
+            "out_tree": None,
+        })
+        with integrity.AtomicFile(store.entry_path(key)) as f:
+            f.write(blob)
+        assert store.load(key) is None
+        assert _counters().get("warmstart.degraded.fingerprint", 0) >= 1
+
+    def test_gc_evicts_stale_fingerprints(self, store):
+        compiled, _ = _tiny_compiled()
+        key = store.entry_key("k", 8, 1, "float32")
+        assert store.save(key, compiled)
+        stale = os.path.join(store.root, "deadbeef0000")
+        os.makedirs(stale, exist_ok=True)
+        with open(os.path.join(stale, "old.aot"), "wb") as f:
+            f.write(b"x" * 4096)
+        evicted = store.gc(max_bytes=1024)
+        assert evicted >= 1
+        assert not os.path.exists(stale)  # stale fingerprints go first
+        assert _counters().get("warmstart.gc_evictions", 0) >= 1
+
+    def test_fault_injection_points(self, store):
+        from flink_ml_tpu.fault import injection
+
+        compiled, _ = _tiny_compiled()
+        key = store.entry_key("k", 8, 1, "float32")
+        injection.configure("warmstart.save@1")
+        try:
+            assert store.save(key, compiled) is False  # degraded, no raise
+        finally:
+            injection.reset()
+        assert _counters().get("fault.injected.warmstart.save", 0) == 1
+
+        assert store.save(key, compiled)
+        injection.configure("warmstart.load@1")
+        try:
+            assert store.load(key) is None  # falls back to recompile
+        finally:
+            injection.reset()
+        assert _counters().get("fault.injected.warmstart.load", 0) == 1
+
+    def test_manifest_seal(self, store):
+        compiled, _ = _tiny_compiled()
+        k1 = store.entry_key("a", 8, 1, "float32")
+        k2 = store.entry_key("b", 32, 1, "float32")
+        store.save(k1, compiled)
+        store.save(k2, compiled)
+        mp = store.seal_manifest()
+        assert mp and os.path.exists(mp)
+        man = store.manifest()
+        assert man["fingerprint"] == store.fingerprint
+        assert set(man["entries"]) == {k1, k2}
+
+    def test_concurrent_writer_tmp_is_unique(self, tmp_path):
+        # last-writer-wins coordination relies on per-writer tmp names
+        p = str(tmp_path / "e.aot")
+        af = integrity.AtomicFile(p, unique_tmp=True)
+        assert str(os.getpid()) in af._tmp
+
+
+# -- fused lookup-before-compile ----------------------------------------------
+
+
+def _fit_scaler_model(tmp_path):
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 5).astype(np.float32)
+    t = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR)), {"features": X}
+    )
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        MinMaxScaler().set_selected_col("features"),
+    ]).fit(t)
+    return model, t
+
+
+class TestLookupBeforeCompile:
+    def test_second_plan_hits_warm_artifact(self, tmp_path):
+        model, t = _fit_scaler_model(tmp_path)
+        warmstart.configure(str(tmp_path / "warm_aot"))
+        try:
+            out1 = model.transform(t)[0]
+            assert _counters().get("warmstart.saves", 0) >= 1
+            # a fresh plan (fresh FusedRun, as a respawned replica builds)
+            # must load the persisted executable instead of compiling
+            d = str(tmp_path / "m")
+            model.save(d)
+            from flink_ml_tpu.api.pipeline import PipelineModel
+
+            obs.reset()
+            m2 = PipelineModel.load(d)
+            out2 = m2.transform(t)[0]
+            c = _counters()
+            assert c.get("warmstart.hits", 0) >= 1
+            assert c.get("warmstart.compile_skips", 0) >= 1
+            np.testing.assert_array_equal(
+                np.asarray(out1.col("features"), dtype=np.float64),
+                np.asarray(out2.col("features"), dtype=np.float64),
+            )
+        finally:
+            warmstart.configure(None)
+
+    def test_inactive_store_means_no_counters(self, tmp_path):
+        model, t = _fit_scaler_model(tmp_path)
+        assert warmstart.active() is None
+        model.transform(t)[0].col("features")
+        c = _counters()
+        assert c.get("warmstart.saves", 0) == 0
+        assert c.get("warmstart.hits", 0) == 0
+
+
+# -- ladder warmup in deploy (satellite 3) ------------------------------------
+
+
+class TestLadderWarmup:
+    def test_deploy_walks_bounded_ladder(self, tmp_path, monkeypatch):
+        from flink_ml_tpu.serving.versioning import VersionManager
+
+        monkeypatch.setenv("FMT_WARM_LADDER_MAX", "3")
+        model, t = _fit_scaler_model(tmp_path)
+        warm = t.slice_rows(0, 8)
+        warmstart.configure(str(tmp_path / "warm_aot"))
+        try:
+            vm = VersionManager()
+            vm.deploy(model, "v1", warmup=warm)
+            c = _counters()
+            # rungs 1 and 32 beyond the 8-row live sample, bounded at 3
+            assert c.get("serving.warm_ladder_rungs", 0) == 2
+            # the sealed manifest is on disk after the swap
+            assert warmstart.active().manifest()["entries"]
+        finally:
+            warmstart.configure(None)
+
+    def test_ladder_disabled_at_zero(self, tmp_path, monkeypatch):
+        from flink_ml_tpu.serving.versioning import VersionManager
+
+        monkeypatch.setenv("FMT_WARM_LADDER_MAX", "0")
+        model, t = _fit_scaler_model(tmp_path)
+        warmstart.configure(str(tmp_path / "warm_aot"))
+        try:
+            vm = VersionManager()
+            vm.deploy(model, "v1", warmup=t.slice_rows(0, 8))
+            assert _counters().get("serving.warm_ladder_rungs", 0) == 0
+        finally:
+            warmstart.configure(None)
+
+
+# -- replica spawn env propagation (satellite 2) ------------------------------
+
+
+class TestSpawnEnvPropagation:
+    def test_cache_dirs_ride_to_children(self, tmp_path, monkeypatch):
+        from flink_ml_tpu.serving import replica as replica_mod
+
+        monkeypatch.setattr(
+            compile_cache, "_enabled_dir", str(tmp_path / "xla")
+        )
+        warmstart.configure(str(tmp_path / "warm_aot"))
+        try:
+            env = {}
+            replica_mod._cache_env(env)
+            assert env["FMT_COMPILE_CACHE"] == str(tmp_path / "xla")
+            assert env["FMT_WARM_DIR"] == str(tmp_path / "warm_aot")
+        finally:
+            warmstart.configure(None)
+
+    def test_noop_when_nothing_enabled(self, monkeypatch):
+        from flink_ml_tpu.serving import replica as replica_mod
+
+        monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+        assert warmstart.active() is None
+        env = {}
+        replica_mod._cache_env(env)
+        assert "FMT_COMPILE_CACHE" not in env
+        assert "FMT_WARM_DIR" not in env
