@@ -2760,6 +2760,114 @@ def bench_router(n_train=8192, n_features=256, n_requests=32,
     })
 
 
+def bench_autoscale(n_train=8192, n_features=256, n_requests=32,
+                    req_rows=128, sweeps=3, k=5):
+    """Autoscaler idle-controller overhead (ISSUE 19).
+
+    The elastic control loop must be FREE when the fleet is stable: a
+    ``FleetAutoscaler`` pinned to ``min == max == 1`` observes every
+    tick (one ``fleet_health`` sample — the liveness sweep + replica
+    snapshots + door tallies) but can never act, so any throughput
+    delta against the identical detached router IS the control loop's
+    cost.  Same compute-bound Knn request load as ``bench_router``, on
+    ONE router instance with the arms interleaved (off, on, off, on...)
+    so host drift hits both equally; min-of-sweeps per arm.
+
+    Emits ``autoscale_on_over_off`` (attached wall / detached wall,
+    lower is better) — the BASELINE.json <= 1.05 contract gate.
+    Asserted inside the bench: the stable fleet saw ZERO scale events
+    (a controller that flaps a pinned fleet is broken regardless of
+    overhead), and every routed prediction is bit-identical to a solo
+    transform on both arms.
+    """
+    from flink_ml_tpu.lib import Knn
+    from flink_ml_tpu.serving import FleetAutoscaler, ReplicaRouter
+    from flink_ml_tpu.table.schema import DataTypes, Schema
+    from flink_ml_tpu.table.table import Table
+
+    rng = np.random.RandomState(41)
+    Xtr = rng.randn(n_train, n_features).astype(np.float32)
+    ytr = rng.randint(0, 10, size=n_train).astype(np.float64)
+    train = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double")),
+        {"features": Xtr, "label": ytr},
+    )
+    Xq = rng.randn(n_requests * req_rows, n_features).astype(np.float32)
+    queries = Table.from_columns(
+        Schema.of(("features", DataTypes.DENSE_VECTOR)), {"features": Xq}
+    )
+    model = (
+        Knn().set_vector_col("features").set_label_col("label")
+        .set_k(k).set_prediction_col("pred").fit(train)
+    )
+    model_dir = os.path.join(
+        tempfile.mkdtemp(prefix="bench_autoscale_"), "knn")
+    model.save(model_dir)
+    requests = [queries.slice_rows(i * req_rows, (i + 1) * req_rows)
+                for i in range(n_requests)]
+    solo = []
+    for req in requests:
+        (out,) = model.transform(req)
+        solo.append(np.asarray(out.col("pred")))
+
+    def sweep_wall(router):
+        t0 = time.perf_counter()
+        futures = [router.submit(req) for req in requests]
+        results = [f.result(300) for f in futures]
+        wall = time.perf_counter() - t0
+        for i, res in enumerate(results):
+            np.testing.assert_array_equal(
+                np.asarray(res.table.col("pred")), solo[i],
+                err_msg=f"request {i}: routed prediction diverges from "
+                        "solo transform",
+            )
+        return wall
+
+    router = ReplicaRouter(model_dir, version="v1", replicas=1,
+                           poll_ms=500.0, dispatch_threads=8)
+    off_walls, on_walls = [], []
+    try:
+        for fut in [router.submit(r) for r in requests[:2]]:
+            fut.result(300)  # warm the serving path + ladder buckets
+        for _ in range(sweeps):
+            off_walls.append(sweep_wall(router))
+            scaler = FleetAutoscaler(
+                router, min_replicas=1, max_replicas=1, window_s=1.0,
+                idle_windows=3, cooldown_s=60.0, tick_s=0.05,
+            ).start()
+            try:
+                on_walls.append(sweep_wall(router))
+                sstats = scaler.stats()
+                assert (sstats["scale_ups"] == 0
+                        and sstats["scale_downs"] == 0), (
+                    f"the pinned fleet flapped: {sstats}")
+            finally:
+                scaler.stop()
+        assert router.fleet_size() == 1, router.replicas
+        stats = router.stats()
+        assert not stats.get("router.failed_requests"), stats
+    finally:
+        router.shutdown()
+
+    total_rows = n_requests * req_rows
+    off_s, on_s = min(off_walls), min(on_walls)
+    ratio = on_s / off_s
+    return _emit({
+        "metric": "ReplicaRouter.serve autoscale_on_over_off",
+        "value": round(ratio, 4),
+        "unit": "ratio (lower is better)",
+        "off_ms": round(off_s * 1e3, 1),
+        "on_ms": round(on_s * 1e3, 1),
+        "off_rows_per_sec": round(total_rows / off_s, 1),
+        "on_rows_per_sec": round(total_rows / on_s, 1),
+        "scale_events": 0,  # asserted per on-arm sweep above
+        "pred_parity": True,  # asserted in every sweep on both arms
+        "shape": f"{n_requests} x {req_rows}-row Knn requests "
+                 f"({n_train} refs x {n_features} dims, k={k}), "
+                 f"1 replica, 20 Hz control ticks, min of {sweeps}",
+    })
+
+
 def _multichip_tables(n_rows: int, n_features: int):
     """Deterministic serving tables shared by the parent (model fitting)
     and every serve_multichip worker (identical bytes per device count)."""
@@ -3168,6 +3276,7 @@ WORKLOADS = {
     "drift": bench_drift,
     "online_loop": bench_online_loop,
     "router": bench_router,
+    "autoscale": bench_autoscale,
     "serve_multichip": bench_serve_multichip,
     "coldstart": bench_coldstart,
 }

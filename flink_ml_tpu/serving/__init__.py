@@ -39,14 +39,28 @@ auto-deploys passing candidates through the swap contract, and watches a
 post-swap probation window that rolls back automatically on an SLO or
 drift burn (``ModelServer.rollback`` / ``VersionManager.rollback``).
 
+Elastic fleet (ISSUE 19): :class:`~flink_ml_tpu.serving.autoscaler.
+FleetAutoscaler` closes the observe→decide→act loop over the router —
+SLO-burn/queue-growth/shed scale-up before the p99 burns, sustained-idle
+drain-safe scale-down through the rolling-deploy drain contract,
+hysteresis + cooldown flap protection, and a preemption-aware
+warm-spares mode (``FMT_SCALE_WARM_SPARES``) so SIGTERM storms never
+drop serving capacity below target.
+
 Entry points: ``bench_all.py serving`` (the >=3x dynamic-batching gate),
 ``bench_all.py router`` (the <=1.25x router-overhead gate),
-``python scripts/chaos_smoke.py --serving`` / ``--router`` (shed /
-hot-swap / corrupt-deploy / replica-kill legs),
-``examples/online_serving.py``, ``examples/router_serving.py``.
+``bench_all.py autoscale`` (the <=1.05x idle-controller gate),
+``python scripts/chaos_smoke.py --serving`` / ``--router`` /
+``--autoscale`` (shed / hot-swap / corrupt-deploy / replica-kill /
+elastic-ramp legs), ``examples/online_serving.py``,
+``examples/router_serving.py``.
 """
 
 from flink_ml_tpu.serving.admission import ServingConfig  # noqa: F401
+from flink_ml_tpu.serving.autoscaler import (  # noqa: F401
+    FleetAutoscaler,
+    ScalerConfig,
+)
 from flink_ml_tpu.serving.batcher import (  # noqa: F401
     ServeRequest,
     ServeResult,
@@ -78,6 +92,7 @@ from flink_ml_tpu.serving.versioning import (  # noqa: F401
 
 __all__ = [
     "ContinuousLearningController",
+    "FleetAutoscaler",
     "ModelServer",
     "ModelVersion",
     "ReplicaClient",
@@ -87,6 +102,7 @@ __all__ = [
     "ReplicaUnreachableError",
     "RollingDeployError",
     "RouterConfig",
+    "ScalerConfig",
     "ServeRequest",
     "ServeResult",
     "ServerClosedError",

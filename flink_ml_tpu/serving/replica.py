@@ -442,7 +442,8 @@ class ReplicaClient:
 
     def probe(self, timeout_s: float = 2.0, depth: bool = True) -> dict:
         """One health-poll sample off the replica's telemetry plane:
-        ``{"ready": bool, "reasons": [str, ...], "queue_depth": float}``.
+        ``{"ready": bool, "reasons": [str, ...], "queue_depth": float,
+        "burn_rates": {slo_name: rate}}``.
 
         ``/readyz`` gives the reason-coded verdict (``breaker_open``,
         ``memory_pressure``, ``slo_burning``, ``drift``,
@@ -506,6 +507,15 @@ class ReplicaClient:
         if samples is not None:
             out["queue_depth"] = float(
                 samples.get("fmt_serving_queue_depth", 0.0))
+            # per-SLO burn rates off the same strict scrape: the gauge
+            # family ``slo.burn_rate.<name>`` renders as
+            # ``fmt_slo_burn_rate_<name>`` — the autoscaler's scale-up
+            # signal rides the probe the router already pays for
+            prefix = "fmt_slo_burn_rate_"
+            out["burn_rates"] = {
+                k[len(prefix):]: float(v)
+                for k, v in samples.items() if k.startswith(prefix)
+            }
         return out
 
 

@@ -53,10 +53,30 @@ Telemetry: ``router.replicas_ready`` / ``router.queue_depth`` gauges;
 shutdown.  Chaos levers: injection points ``router.dispatch`` (before
 each forward) and ``router.spawn`` (replica boot).
 
+**Elastic membership (round 22).**  The fleet is no longer fixed at
+boot: :meth:`add_replica` grows it through the standard spawn path (the
+child inherits the sealed warmstart manifest, so its first request
+stays warm) and :meth:`remove_replica` shrinks it drain-aware by
+reusing the rolling-deploy drain contract via :meth:`_drain_replica` —
+stop routing, wait out in-flight work, terminate, tombstone the slot so
+every index stays stable.  Membership changes serialize with rolling
+deploys.  :class:`~flink_ml_tpu.serving.autoscaler.FleetAutoscaler`
+closes the observe→decide→act loop over :meth:`fleet_health`.  Two
+supervision refinements ride along: a live replica only leaves rotation
+after ``FMT_ROUTER_SCRAPE_STRIKES`` consecutive failed scrapes (with
+jittered re-probes between strikes — one blackholed scrape must not
+read like a dead socket; waitpid-confirmed death stays immediate), and
+a slot whose replica dies ``FMT_ROUTER_CRASHLOOP_MAX`` times inside
+``FMT_ROUTER_CRASHLOOP_WINDOW_S`` is quarantined with exponential
+backoff (a ``router.crashloop`` flight dump names the slot and exit
+status) instead of hot-loop respawning.
+
 Knobs (BASELINE.md round-16 table): ``FMT_ROUTER_REPLICAS``,
 ``FMT_ROUTER_POLL_MS``, ``FMT_ROUTER_QUEUE_CAP``,
 ``FMT_ROUTER_DISPATCH_THREADS``, ``FMT_ROUTER_RETRIES``,
-``FMT_ROUTER_SPAWN_TIMEOUT_S``, ``FMT_ROUTER_DRAIN_TIMEOUT_S``.
+``FMT_ROUTER_SPAWN_TIMEOUT_S``, ``FMT_ROUTER_DRAIN_TIMEOUT_S``; the
+round-22 table adds ``FMT_ROUTER_SCRAPE_STRIKES``,
+``FMT_ROUTER_CRASHLOOP_MAX`` and ``FMT_ROUTER_CRASHLOOP_WINDOW_S``.
 """
 
 from __future__ import annotations
@@ -111,6 +131,12 @@ _MAX_SPAWN_ATTEMPTS = 3
 #: deadline is the real latency contract, this only bounds a wedged peer
 _DISPATCH_TIMEOUT_S = 120.0
 
+#: first crash-loop quarantine parks the slot this long, doubling per
+#: consecutive episode up to the cap — long enough to break a hot loop,
+#: short enough that a recovered dependency re-admits the slot soon
+_CRASHLOOP_BACKOFF_S = 2.0
+_CRASHLOOP_BACKOFF_CAP_S = 60.0
+
 
 @dataclass(frozen=True)
 class RouterConfig:
@@ -123,6 +149,9 @@ class RouterConfig:
     retries: int = 2
     spawn_timeout_s: float = 120.0
     drain_timeout_s: float = 30.0
+    scrape_strikes: int = 3
+    crashloop_max: int = 3
+    crashloop_window_s: float = 30.0
 
     @classmethod
     def from_env(cls, replicas: Optional[int] = None,
@@ -131,7 +160,11 @@ class RouterConfig:
                  dispatch_threads: Optional[int] = None,
                  retries: Optional[int] = None,
                  spawn_timeout_s: Optional[float] = None,
-                 drain_timeout_s: Optional[float] = None) -> "RouterConfig":
+                 drain_timeout_s: Optional[float] = None,
+                 scrape_strikes: Optional[int] = None,
+                 crashloop_max: Optional[int] = None,
+                 crashloop_window_s: Optional[float] = None
+                 ) -> "RouterConfig":
         cfg = cls(
             replicas=int(replicas if replicas is not None
                          else knobs.knob_int("FMT_ROUTER_REPLICAS")),
@@ -150,6 +183,15 @@ class RouterConfig:
             drain_timeout_s=float(
                 drain_timeout_s if drain_timeout_s is not None
                 else knobs.knob_float("FMT_ROUTER_DRAIN_TIMEOUT_S")),
+            scrape_strikes=max(int(
+                scrape_strikes if scrape_strikes is not None
+                else knobs.knob_int("FMT_ROUTER_SCRAPE_STRIKES")), 1),
+            crashloop_max=int(
+                crashloop_max if crashloop_max is not None
+                else knobs.knob_int("FMT_ROUTER_CRASHLOOP_MAX")),
+            crashloop_window_s=float(
+                crashloop_window_s if crashloop_window_s is not None
+                else knobs.knob_float("FMT_ROUTER_CRASHLOOP_WINDOW_S")),
         )
         if cfg.replicas < 1 or cfg.dispatch_threads < 1 or cfg.queue_cap < 1:
             raise ValueError(
@@ -206,7 +248,7 @@ class _Replica:
 
     def __init__(self, name: str, client: ReplicaClient,
                  process: Optional[ReplicaProcess] = None,
-                 version: str = ""):
+                 version: str = "", scrape_strikes: int = 1):
         self.name = name
         self.client = client
         self.process = process
@@ -214,11 +256,13 @@ class _Replica:
         self._ready = False
         self._reasons: List[str] = ["booting"]
         self._queue_depth = 0.0
+        self._burn_rates: Dict[str, float] = {}
         self._in_flight = 0
         self._draining = False
         self._dead = False
         self._probe_failures = 0
         self._probe_inflight = False
+        self._scrape_strikes = max(int(scrape_strikes), 1)
         self._version = version
 
     # -- health (poll loop) --------------------------------------------------
@@ -231,15 +275,21 @@ class _Replica:
                 # readiness refreshes every beat; depth only on scrape
                 # beats (absent key = keep the last observation)
                 self._queue_depth = float(probe["queue_depth"])
+            if "burn_rates" in probe:
+                self._burn_rates = dict(probe["burn_rates"])
             self._probe_failures = 0
 
     def note_probe_failure(self) -> int:
-        """One unreachable probe; returns the consecutive-failure count
-        (the poll loop's debounce for process-less backends)."""
+        """One unreachable probe; returns the consecutive-failure count.
+        Transient-vs-dead discrimination: a live replica only leaves
+        rotation after ``scrape_strikes`` consecutive failures — one
+        blackholed scrape must not read like a dead socket (a dead
+        socket is waitpid's verdict, which needs no debounce)."""
         with self._lock:
             self._probe_failures += 1
-            self._ready = False
-            self._reasons = ["unreachable"]
+            if self._probe_failures >= self._scrape_strikes:
+                self._ready = False
+                self._reasons = ["unreachable"]
             return self._probe_failures
 
     def try_begin_probe(self) -> bool:
@@ -328,6 +378,7 @@ class _Replica:
                 "ready": self._ready,
                 "reasons": list(self._reasons),
                 "queue_depth": self._queue_depth,
+                "burn_rates": dict(self._burn_rates),
                 "in_flight": self._in_flight,
                 "draining": self._draining,
                 "dead": self._dead,
@@ -362,6 +413,9 @@ class ReplicaRouter:
                  retries: Optional[int] = None,
                  spawn_timeout_s: Optional[float] = None,
                  drain_timeout_s: Optional[float] = None,
+                 scrape_strikes: Optional[int] = None,
+                 crashloop_max: Optional[int] = None,
+                 crashloop_window_s: Optional[float] = None,
                  replica_env: Optional[Dict[str, str]] = None,
                  replica_factory=None,
                  start: bool = True):
@@ -370,6 +424,9 @@ class ReplicaRouter:
             dispatch_threads=dispatch_threads, retries=retries,
             spawn_timeout_s=spawn_timeout_s,
             drain_timeout_s=drain_timeout_s,
+            scrape_strikes=scrape_strikes,
+            crashloop_max=crashloop_max,
+            crashloop_window_s=crashloop_window_s,
         )
         self._replica_env = dict(replica_env or {})
         self._factory = replica_factory or self._spawn_backend
@@ -382,6 +439,10 @@ class ReplicaRouter:
         self._slots: List[Optional[_Replica]] = []
         self._generation = 0
         self._respawning: set = set()
+        #: per-slot recent death stamps + quarantine episodes (crash-loop
+        #: detection, round 22) — both under ``_rep_lock``
+        self._death_times: Dict[int, Deque[float]] = {}
+        self._quarantine: Dict[int, dict] = {}
         self._source_path = str(path)
         self._source_version = str(version)
         self._deploy_status: Optional[dict] = None
@@ -442,7 +503,8 @@ class ReplicaRouter:
             path, version = self._source_path, self._source_version
         name = f"replica-{index}-g{generation}"
         client, process = self._factory(name, path, version)
-        replica = _Replica(name, client, process, version=version)
+        replica = _Replica(name, client, process, version=version,
+                           scrape_strikes=self.config.scrape_strikes)
         # first health sample inline: a fresh replica is routable the
         # moment it answers, not one poll interval later
         try:
@@ -885,18 +947,34 @@ class ReplicaRouter:
     def _probe_replica(self, index: int, replica: _Replica,
                        depth: bool) -> None:
         try:
-            try:
-                replica.mark_probe(replica.client.probe(depth=depth))
-            except Exception:  # noqa: BLE001 - the probe must not escape
-                # ANY probe failure (unreachable, torn response, a
-                # future probe bug) reads as "not ready", never as a
-                # dead probe thread — a silent supervisor is the one
-                # failure mode a supervisor must not have
-                failures = replica.note_probe_failure()
-                if (replica.process is None
-                        and failures >= _PROBE_FAILURE_DEBOUNCE):
-                    self._on_replica_death(index, replica,
-                                           "probe unreachable")
+            while True:
+                try:
+                    replica.mark_probe(replica.client.probe(depth=depth))
+                    return
+                except Exception:  # noqa: BLE001 - the probe must not escape
+                    # ANY probe failure (unreachable, torn response, a
+                    # future probe bug) reads as a strike, never as a
+                    # dead probe thread — a silent supervisor is the one
+                    # failure mode a supervisor must not have
+                    failures = replica.note_probe_failure()
+                    if (replica.process is None
+                            and failures >= _PROBE_FAILURE_DEBOUNCE):
+                        self._on_replica_death(index, replica,
+                                               "probe unreachable")
+                        return
+                    if (failures >= self.config.scrape_strikes
+                            or replica.is_dead()):
+                        return  # struck out: out of rotation until a
+                        # probe succeeds again
+                    # below the strike count the replica KEPT its slot
+                    # in rotation — re-probe after a short jittered
+                    # delay instead of spending a full poll interval
+                    # per strike (a blackholed scrape should cost
+                    # milliseconds of uncertainty, not seconds)
+                    delay = min(max(self.config.poll_ms, 1.0) / 1e3, 0.25)
+                    if self._poll_stop.wait(
+                            timeout=delay * random.uniform(0.5, 1.5)):
+                        return
         finally:
             replica.end_probe()
 
@@ -947,21 +1025,75 @@ class ReplicaRouter:
             if index in self._respawning or self._slots[index] is not replica:
                 return  # another thread already claimed this death
             self._respawning.add(index)
+            self._death_times.setdefault(
+                index, deque(maxlen=32)).append(time.monotonic())
         replica.mark_dead(why)
+        exit_status = (replica.process.poll_dead()
+                       if replica.process is not None else None)
         self._tally("router.replica_deaths")
         obs.counter_add("router.replica_deaths")
         obs.flight.record("router.replica_death", replica=replica.name,
                           why=why)
         if replica.process is not None:
             replica.process.stop(grace_s=0.1)  # reap the zombie
-        threading.Thread(target=self._respawn, args=(index,),
+        threading.Thread(target=self._respawn, args=(index, exit_status),
                          name=f"fmt-router-respawn-{index}",
                          daemon=True).start()
 
-    def _respawn(self, index: int) -> None:
+    def _crashloop_backoff(self, index: int,
+                           exit_status) -> Optional[float]:
+        """Crash-loop gate for one slot's respawn: ``None`` = spawn
+        immediately; a float = the slot just entered quarantine — the
+        respawn must sit out that many seconds first.  A slot whose
+        replica died ``FMT_ROUTER_CRASHLOOP_MAX`` times inside the
+        window is looping on something a hot respawn cannot fix (bad
+        artifact, dead dependency, OOM killer) — parking it with
+        exponential backoff keeps the survivors' poll loop and the
+        spawn path from burning on a doomed slot.  Quarantines are
+        observable: ``router.crashloops`` counter, quarantine state in
+        :meth:`stats`, and a ``router.crashloop`` flight dump naming
+        the slot and exit status."""
+        window = self.config.crashloop_window_s
+        limit = self.config.crashloop_max
+        now = time.monotonic()
+        with self._rep_lock:
+            deaths = self._death_times.setdefault(index, deque(maxlen=32))
+            while deaths and now - deaths[0] > window:
+                deaths.popleft()
+            if limit < 1 or len(deaths) < limit:
+                # below the threshold (or detection disabled): a prior
+                # quarantine episode ended in a replica that outlived
+                # the window, so the slot's slate is clean again
+                self._quarantine.pop(index, None)
+                return None
+            episodes = self._quarantine.get(index, {}).get("episodes", 0) + 1
+            backoff = min(_CRASHLOOP_BACKOFF_S * (2 ** (episodes - 1)),
+                          _CRASHLOOP_BACKOFF_CAP_S)
+            self._quarantine[index] = {
+                "episodes": episodes,
+                "backoff_s": backoff,
+                "until": now + backoff,
+            }
+            deaths_in_window = len(deaths)
+        self._tally("router.crashloops")
+        obs.counter_add("router.crashloops")
+        obs.flight.record("router.crashloop", slot=index,
+                          exit_status=exit_status,
+                          deaths_in_window=deaths_in_window,
+                          backoff_s=backoff)
+        obs.flight.dump("router_crashloop", extra={
+            "slot": index, "exit_status": exit_status,
+            "deaths_in_window": deaths_in_window, "backoff_s": backoff,
+        })
+        return backoff
+
+    def _respawn(self, index: int, exit_status=None) -> None:
         import warnings
 
         try:
+            backoff = self._crashloop_backoff(index, exit_status)
+            if backoff is not None and self._poll_stop.wait(timeout=backoff):
+                return  # shutdown interrupted the quarantine sleep
             for attempt in range(1, _MAX_SPAWN_ATTEMPTS + 1):
                 try:
                     replacement = self._make_replica(index)
@@ -1014,6 +1146,176 @@ class ReplicaRouter:
             with self._rep_lock:
                 self._respawning.discard(index)
 
+    # -- elastic membership (round 22) ---------------------------------------
+
+    def _drain_replica(self, replica: _Replica) -> bool:
+        """The drain contract a rolling deploy and a scale-down share:
+        stop routing to the replica, then wait out its router-originated
+        in-flight work, bounded by ``FMT_ROUTER_DRAIN_TIMEOUT_S``.
+        False on timeout — the replica is LEFT DRAINING; the caller
+        either re-admits it (``set_draining(False)``) or terminates it."""
+        replica.set_draining(True)
+        return replica.wait_drained(self.config.drain_timeout_s)
+
+    def add_replica(self) -> Optional[str]:
+        """Grow the fleet by one replica through the standard spawn path
+        (the child inherits the sealed warmstart manifest, so its first
+        request stays warm).  Returns the new replica's name, or None
+        when membership can't change right now (router stopping, or a
+        rolling deploy holds the fleet — a roll iterates a fleet
+        snapshot and must not race a slot appearing mid-roll).  Raises
+        on spawn failure; the fleet is unchanged either way (the
+        reserved slot stays a tombstone every iterator already skips)."""
+        if not self._deploy_lock.acquire(blocking=False):
+            return None
+        try:
+            with self._cond:
+                if self._closed or self._stopping:
+                    return None
+            with self._rep_lock:
+                index = len(self._slots)
+                self._slots.append(None)     # reserve the slot index...
+                self._respawning.add(index)  # ...and claim it (shutdown
+                # waits out every claimed slot before its final sweep)
+            try:
+                replica = self._make_replica(index)
+            except BaseException:
+                with self._rep_lock:
+                    self._respawning.discard(index)
+                self._tally("router.spawn_failures")
+                obs.counter_add("router.spawn_failures")
+                raise
+            with self._cond:
+                stopping = self._stopping
+            with self._rep_lock:
+                self._respawning.discard(index)
+                if not stopping:
+                    self._slots[index] = replica
+            if stopping:
+                # shut down while the child booted: installing it would
+                # orphan a live process nobody supervises
+                self._stop_backend(replica)
+                return None
+            self._tally("router.replicas_added")
+            obs.counter_add("router.replicas_added")
+            obs.gauge_set("router.replicas", float(self.fleet_size()))
+            obs.flight.record("router.replica_added", slot=index,
+                              replica=replica.name)
+            return replica.name
+        finally:
+            self._deploy_lock.release()
+
+    def remove_replica(self) -> Optional[str]:
+        """Shrink the fleet by one replica, drain-aware: the least
+        loaded routable replica stops taking new traffic, its in-flight
+        requests finish (the same :meth:`_drain_replica` contract a
+        rolling deploy uses — zero caller-visible failures), then it is
+        terminated (SIGTERM: the replica drains its own queue and exits
+        0) and its slot tombstoned so every index stays stable.
+        Returns the removed replica's name; None when nothing is
+        removable — a lone routable replica is never removed, a busy
+        replica whose drain timed out is re-admitted, and a rolling
+        deploy holds the fleet."""
+        if not self._deploy_lock.acquire(blocking=False):
+            return None
+        try:
+            with self._cond:
+                if self._closed or self._stopping:
+                    return None
+            candidates = [r for r in self._replicas_snapshot()
+                          if r is not None and r.routable()]
+            if len(candidates) <= 1:
+                return None
+            victim = min(candidates, key=lambda r: r.load())
+            if not self._drain_replica(victim):
+                victim.set_draining(False)  # busy is not removable
+                self._tally("router.remove_drain_timeouts")
+                obs.counter_add("router.remove_drain_timeouts")
+                return None
+            index = self._index_of(victim)
+            with self._rep_lock:
+                removable = (index is not None
+                             and index not in self._respawning
+                             and self._slots[index] is victim)
+                if removable:
+                    self._slots[index] = None
+            if not removable:
+                # the victim died mid-drain and its death was claimed,
+                # or the fleet changed under us: re-admit and report
+                # nothing removed (the supervisor owns the slot now)
+                victim.set_draining(False)
+                return None
+            victim.mark_dead("removed")
+            self._stop_backend(victim)
+            self._tally("router.replicas_removed")
+            obs.counter_add("router.replicas_removed")
+            obs.gauge_set("router.replicas", float(self.fleet_size()))
+            obs.flight.record("router.replica_removed", slot=index,
+                              replica=victim.name)
+            return victim.name
+        finally:
+            self._deploy_lock.release()
+
+    def fleet_size(self) -> int:
+        """Occupied slots (live, booting, or awaiting respawn) — the
+        membership count scale decisions measure against; tombstoned
+        (removed/abandoned) slots don't count."""
+        with self._rep_lock:
+            return sum(1 for r in self._slots if r is not None)
+
+    def quarantined_count(self) -> int:
+        """Slots currently parked by the crash-loop quarantine — the
+        autoscaler reads these as capacity loss and compensates."""
+        now = time.monotonic()
+        with self._rep_lock:
+            return sum(1 for q in self._quarantine.values()
+                       if q.get("until", 0.0) > now)
+
+    def fleet_health(self) -> dict:
+        """One autoscaler observation off state the router already
+        maintains — the poll loop's probes and the door tallies, no
+        extra scrape.  ``burn_seen`` distinguishes "no SLO burning"
+        from "no burn data at all" (a replica with no judged SLO window
+        reports an empty ``burn_rates``), and ``probe_suspect`` counts
+        replicas whose unreadiness is a failed/unreachable probe rather
+        than a reason-coded verdict — fail-closed inputs a scale-down
+        decision must treat as vetoes, never as idleness."""
+        self._sweep_liveness()
+        snaps = [r.snapshot() for r in self._replicas_snapshot()
+                 if r is not None]
+        with self._cond:
+            queued = self._queued_rows
+        with self._counts_lock:
+            requests = float(self._counts.get("router.requests", 0))
+            shed = float(self._counts.get("router.shed", 0))
+        ready = live = probe_suspect = 0
+        max_burn, burn_seen = 0.0, False
+        for snap in snaps:
+            if not snap["dead"]:
+                live += 1
+            if snap["ready"] and not snap["draining"] and not snap["dead"]:
+                ready += 1
+            rates = snap.get("burn_rates") or {}
+            if rates:
+                burn_seen = True
+                max_burn = max(max_burn, max(rates.values()))
+            if not snap["ready"] and any(
+                    r in ("unreachable", "probe_error")
+                    for r in snap["reasons"]):
+                probe_suspect += 1
+        return {
+            "size": len(snaps),
+            "live": live,
+            "ready": ready,
+            "quarantined": self.quarantined_count(),
+            "queued_rows": int(queued),
+            "requests": requests,
+            "shed": shed,
+            "max_burn_rate": max_burn,
+            "burn_seen": burn_seen,
+            "probe_suspect": probe_suspect,
+        }
+
     # -- rolling deploy ------------------------------------------------------
 
     def deploy(self, path: str, version: str) -> dict:
@@ -1057,10 +1359,8 @@ class ReplicaRouter:
                         })
                         continue
                     entry = {"replica": replica.name}
-                    replica.set_draining(True)
                     try:
-                        if not replica.wait_drained(
-                                self.config.drain_timeout_s):
+                        if not self._drain_replica(replica):
                             entry["outcome"] = "drain_timeout"
                             status["replicas"].append(entry)
                             raise RollingDeployError(status)
@@ -1200,6 +1500,17 @@ class ReplicaRouter:
         delta["active_version"] = self.active_version
         delta["replicas_ready"] = self.ready_count()
         delta["replicas"] = self.replicas
+        quarantined = self.quarantined_count()
+        if quarantined:
+            now = time.monotonic()
+            with self._rep_lock:
+                delta["quarantined_slots"] = {
+                    str(i): {"episodes": q["episodes"],
+                             "backoff_s": q["backoff_s"],
+                             "remaining_s": round(q["until"] - now, 3)}
+                    for i, q in self._quarantine.items()
+                    if q.get("until", 0.0) > now
+                }
         return delta
 
     def _write_report(self) -> None:

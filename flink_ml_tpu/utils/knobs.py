@@ -188,6 +188,29 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Seconds a replica subprocess gets to bind its endpoints at boot."),
     Knob("FMT_ROUTER_DRAIN_TIMEOUT_S", "30", "float",
          "Seconds a rolling deploy waits for one replica's in-flight work."),
+    Knob("FMT_ROUTER_SCRAPE_STRIKES", "3", "int",
+         "Consecutive failed scrapes before a live replica leaves rotation."),
+    Knob("FMT_ROUTER_CRASHLOOP_MAX", "3", "int",
+         "Replica deaths inside the crash-loop window that quarantine a slot."),
+    Knob("FMT_ROUTER_CRASHLOOP_WINDOW_S", "30", "float",
+         "Sliding window over one slot's deaths for crash-loop detection."),
+    # -- fleet autoscaler -------------------------------------------------
+    Knob("FMT_SCALE_MIN", "1", "int",
+         "Lower fleet bound the autoscaler never shrinks below."),
+    Knob("FMT_SCALE_MAX", "8", "int",
+         "Upper fleet bound the autoscaler never grows past."),
+    Knob("FMT_SCALE_UP_BURN", "1.0", "float",
+         "Replica SLO burn rate at or above which the fleet scales up."),
+    Knob("FMT_SCALE_DOWN_BURN", "0.5", "float",
+         "Burn rate every replica must sit below before a scale-down."),
+    Knob("FMT_SCALE_WINDOW_S", "30", "float",
+         "Observation window for queue-growth and shed-rate up triggers."),
+    Knob("FMT_SCALE_IDLE_WINDOWS", "3", "int",
+         "Consecutive idle observation windows before one scale-down step."),
+    Knob("FMT_SCALE_COOLDOWN_S", "60", "float",
+         "Post-action cooldown before the autoscaler acts again."),
+    Knob("FMT_SCALE_WARM_SPARES", "0", "int",
+         "Warm spare replicas kept above target (preemption-aware mode)."),
     # -- continuous learning ----------------------------------------------
     Knob("FMT_LIFECYCLE_EVERY_WINDOWS", "8", "int",
          "Effective training windows between candidate checkpoints."),

@@ -2269,6 +2269,199 @@ def coldstart_main() -> int:
     return 0
 
 
+def autoscale_main() -> int:
+    """The elastic-fleet chaos matrix (``--autoscale``, ISSUE 19).
+
+    1. **ramp up** — a sustained traffic ramp against a 1-replica fleet
+       must grow it through the autoscaler (queue-growth/burn trigger,
+       standard spawn path) with ZERO failed requests and the
+       driver-computed p99 inside the declared bound;
+    2. **ramp down** — when the ramp ends, sustained idle must shrink
+       the fleet back to min through the drain contract — zero
+       caller-visible failures, every removal drain-safe;
+    3. **SIGTERM storm with warm spares** — with ``warm_spares=1`` the
+       fleet carries one replica above target; SIGTERMing two replicas
+       under load must lose zero requests while the router self-heals
+       with warm replacements (``router.respawns_warm`` stamped — the
+       sealed manifest inherited, not recompiled).
+    """
+    import threading
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_autoscale_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ.pop("FMT_WARM_DIR", None)  # store lands beside the model
+    os.environ["FMT_WARMSTART"] = "1"
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.serving import (
+        FleetAutoscaler,
+        ReplicaRouter,
+        VersionManager,
+        warmstart,
+    )
+
+    table = dense_table()
+    model = Pipeline([
+        StandardScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("p")
+        .set_learning_rate(0.5).set_max_iter(3),
+    ]).fit(table)
+    v1_dir = os.path.join(tempfile.mkdtemp(prefix="chaos_autoscale_"), "v1")
+    model.save(v1_dir)
+    (solo_out,) = model.transform(table)
+    solo = np.asarray(solo_out.col("p"))
+
+    # seal the warm-artifact manifest (ISSUE 18) so every autoscaler
+    # spawn and every respawn inherits it — leg 3 asserts the stamp
+    VersionManager().deploy(v1_dir, "v1", warmup=table.slice_rows(0, 8))
+    assert warmstart.inherited_manifest_entries(v1_dir) >= 1
+
+    p99_bound_ms = 30_000.0  # the declared driver-side latency SLO
+    obs.reset()
+    router = ReplicaRouter(v1_dir, version="v1", replicas=1, poll_ms=30)
+    scaler = FleetAutoscaler(router, min_replicas=1, max_replicas=3,
+                             window_s=1.0, idle_windows=3,
+                             cooldown_s=2.0, tick_s=0.25).start()
+    failures, latencies = [], []
+    lat_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client_loop(seed):
+        i = seed
+        while not stop.is_set():
+            lo = (i * 4) % (N - 4)
+            t0 = time.monotonic()
+            try:
+                res = router.predict(table.slice_rows(lo, lo + 4),
+                                     timeout=120)
+                np.testing.assert_array_equal(
+                    np.asarray(res.table.col("p")), solo[lo:lo + 4])
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                failures.append(exc)
+            with lat_lock:
+                latencies.append((time.monotonic() - t0) * 1e3)
+            i += 1
+            time.sleep(0.001)
+
+    try:
+        # -- leg 1: traffic ramp -> the fleet grows from min -----------------
+        clients = [threading.Thread(target=client_loop, args=(s,),
+                                    daemon=True) for s in range(12)]
+        for t in clients:
+            t.start()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if (router.fleet_size() >= 2
+                    and scaler.stats()["scale_ups"] >= 1):
+                break
+            time.sleep(0.05)
+        assert router.fleet_size() >= 2, (
+            f"the ramp never grew the fleet: {scaler.stats()}, "
+            f"{router.fleet_health()}")
+        grown_to = router.fleet_size()
+        sstats = scaler.stats()
+        assert sstats["scale_ups"] >= 1, sstats
+        assert router.stats().get("router.replicas_added", 0) >= 1
+        print(f"  ramp up: fleet 1 -> {grown_to} "
+              f"(scale_ups={sstats['scale_ups']}, "
+              f"requests so far={len(latencies)})")
+
+        # -- leg 2: ramp ends -> sustained idle shrinks it back, drain-safe --
+        stop.set()
+        for t in clients:
+            t.join(60)
+        assert not failures, (
+            f"{len(failures)} requests failed during the ramp: "
+            f"{failures[0]!r}")
+        with lat_lock:
+            p99_ms = float(np.percentile(latencies, 99))
+        assert p99_ms <= p99_bound_ms, (
+            f"driver p99 {p99_ms:.0f} ms breached the declared "
+            f"{p99_bound_ms:.0f} ms bound")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            # the scaler's own tally too: the router tombstones the slot
+            # BEFORE the (seconds-long) child stop, so size alone races
+            # the decision bookkeeping
+            if (router.fleet_size() == 1
+                    and scaler.stats()["scale_downs"] >= 1):
+                break
+            time.sleep(0.1)
+        assert router.fleet_size() == 1, (
+            f"sustained idle never shrank the fleet: {scaler.stats()}, "
+            f"{router.fleet_health()}")
+        sstats = scaler.stats()
+        assert sstats["scale_downs"] >= 1, sstats
+        assert router.stats().get("router.replicas_removed", 0) >= 1
+        print(f"  ramp down: fleet {grown_to} -> 1 on sustained idle "
+              f"(scale_downs={sstats['scale_downs']}, "
+              f"{len(latencies)} requests, zero failures, "
+              f"p99 {p99_ms:.1f} ms <= {p99_bound_ms:.0f} ms)")
+        scaler.stop()
+
+        # -- leg 3: SIGTERM two replicas under load -> warm spares absorb ----
+        scaler = FleetAutoscaler(router, min_replicas=2, max_replicas=4,
+                                 warm_spares=1, window_s=1.0,
+                                 idle_windows=8, cooldown_s=2.0,
+                                 tick_s=0.25).start()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if router.fleet_size() >= 3 and router.ready_count() >= 3:
+                break
+            time.sleep(0.05)
+        assert router.ready_count() >= 3, (
+            f"warm spares never provisioned: {scaler.stats()}, "
+            f"{router.fleet_health()}")
+        print(f"  warm spares: fleet at {router.fleet_size()} "
+              f"(target 2 + 1 spare)")
+        failures.clear()
+        stop.clear()
+        respawns_before = router.stats().get("router.respawns", 0)
+        clients = [threading.Thread(target=client_loop, args=(s,),
+                                    daemon=True) for s in range(8)]
+        for t in clients:
+            t.start()
+        time.sleep(0.5)  # traffic is flowing before the storm
+        victims = [r["pid"] for r in router.replicas[:2]
+                   if r.get("pid")]
+        assert len(victims) == 2, router.replicas
+        for pid in victims:
+            os.kill(pid, signal.SIGTERM)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            stats = router.stats()
+            if (stats.get("router.respawns", 0) >= respawns_before + 2
+                    and router.ready_count() >= 3):
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in clients:
+            t.join(60)
+        stats = router.stats()
+        assert stats.get("router.respawns", 0) >= respawns_before + 2, stats
+        assert stats.get("router.respawns_warm", 0) >= 2, (
+            "the storm's replacements booted cold — no sealed manifest "
+            f"inherited: {stats}")
+        assert router.ready_count() >= 3, router.replicas
+        assert not failures, (
+            f"{len(failures)} requests failed across the SIGTERM storm: "
+            f"{failures[0]!r}")
+        print(f"  SIGTERM storm: pids {victims} killed under load, "
+              f"zero failures, self-healed to "
+              f"{router.ready_count()} ready with warm replacements "
+              f"(respawns_warm={stats.get('router.respawns_warm'):g})")
+    finally:
+        stop.set()
+        scaler.stop()
+        router.shutdown()
+    print("autoscale chaos smoke OK")
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2], sys.argv[3])
@@ -2295,6 +2488,8 @@ def main() -> int:
         return multichip_main()
     if "--coldstart" in sys.argv:
         return coldstart_main()
+    if "--autoscale" in sys.argv:
+        return autoscale_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

@@ -582,6 +582,326 @@ class TestRollingDeploy:
                 s.shutdown()
 
 
+# -- elastic membership + crash supervision (round 22) ------------------------
+
+
+class _FakeProc:
+    """A scriptable ReplicaProcess stand-in: ``exit_code`` is waitpid's
+    verdict (None = alive)."""
+
+    def __init__(self, exit_code=None):
+        self.pid = 4242
+        self.serve_address = "127.0.0.1:1"
+        self.telemetry_address = "127.0.0.1:2"
+        self.exit_code = exit_code
+        self.stopped = False
+
+    def poll_dead(self):
+        return self.exit_code
+
+    def alive(self):
+        return self.exit_code is None
+
+    def stop(self, grace_s=None):
+        self.stopped = True
+        if self.exit_code is None:
+            self.exit_code = 0
+
+
+class _FlakyProbeClient(_FakeClient):
+    """A _FakeClient whose next ``fail_probes`` probes raise — a replica
+    that blackholes scrapes while its process stays alive."""
+
+    def __init__(self, name, fail_probes=0, **kw):
+        super().__init__(name, **kw)
+        self.fail_probes = fail_probes
+        self.probes = 0
+
+    def probe(self, timeout_s=2.0, depth=True):
+        self.probes += 1
+        if self.fail_probes > 0:
+            self.fail_probes -= 1
+            raise ReplicaUnreachableError("blackholed scrape")
+        return super().probe(timeout_s=timeout_s, depth=depth)
+
+
+def _proc_router(make_replica, n=1, **kw):
+    """Router over process-backed fakes; the factory serves boots AND
+    respawns.  ``make_replica(name) -> (client, proc)``."""
+
+    def factory(name, path, version):
+        return make_replica(name)
+
+    kw.setdefault("poll_ms", 600_000.0)
+    return ReplicaRouter("/nonexistent", replicas=n,
+                         replica_factory=factory, **kw)
+
+
+class TestScrapeStrikes:
+    def test_config_knobs(self, monkeypatch):
+        cfg = RouterConfig.from_env()
+        assert cfg.scrape_strikes == 3
+        assert cfg.crashloop_max == 3
+        assert cfg.crashloop_window_s == 30.0
+        monkeypatch.setenv("FMT_ROUTER_SCRAPE_STRIKES", "5")
+        assert RouterConfig.from_env().scrape_strikes == 5
+        assert RouterConfig.from_env(scrape_strikes=2).scrape_strikes == 2
+
+    def test_strikes_accumulate_before_eviction(self):
+        """The debounce unit contract: below the strike count the
+        replica keeps its rotation slot; at the count it leaves with the
+        ``unreachable`` reason; one good probe clears the tally."""
+        from flink_ml_tpu.serving.router import _Replica
+
+        replica = _Replica("r", _FakeClient("r"), scrape_strikes=3)
+        replica.mark_probe({"ready": True, "reasons": []})
+        assert replica.note_probe_failure() == 1
+        assert replica.routable() is True
+        assert replica.note_probe_failure() == 2
+        assert replica.routable() is True
+        assert replica.note_probe_failure() == 3
+        assert replica.routable() is False
+        assert replica.snapshot()["reasons"] == ["unreachable"]
+        replica.mark_probe({"ready": True, "reasons": []})
+        assert replica.routable() is True
+        assert replica.note_probe_failure() == 1  # tally was reset
+
+    def test_one_blackholed_scrape_keeps_the_replica_routable(self):
+        """The red test this satellite exists for: a live replica that
+        drops ONE scrape then recovers must never leave rotation — the
+        probe pass itself re-probes (jittered) and comes back green."""
+        client = _FlakyProbeClient("a")
+        router = _proc_router(lambda name: (client, _FakeProc()))
+        try:
+            replica = router._replicas_snapshot()[0]
+            assert replica.routable() is True
+            probes_before = client.probes
+            client.fail_probes = 1  # blackhole exactly the next scrape
+            router._probe_replica(0, replica, depth=True)
+            # the failed scrape was retried within the SAME probe pass
+            assert client.probes >= probes_before + 2
+            assert replica.routable() is True
+            assert replica.is_dead() is False
+        finally:
+            router.shutdown()
+
+    def test_sustained_blackhole_routes_away_after_strikes(self):
+        client = _FlakyProbeClient("a", fail_probes=0)
+        router = _proc_router(lambda name: (client, _FakeProc()))
+        try:
+            replica = router._replicas_snapshot()[0]
+            probes_before = client.probes
+            client.fail_probes = 50  # a real blackhole, not a blip
+            router._probe_replica(0, replica, depth=True)
+            # struck out at exactly the configured count — no more
+            assert client.probes == probes_before + 3
+            assert replica.routable() is False
+            assert replica.snapshot()["reasons"] == ["unreachable"]
+            # the process is alive: routed away, NOT declared dead
+            assert replica.is_dead() is False
+        finally:
+            router.shutdown()
+
+    def test_waitpid_death_is_immediate_despite_strikes(self):
+        """Strikes debounce SCRAPES only: a reaped child is dead on the
+        very next liveness sweep, zero probe failures required."""
+        procs = []
+
+        def make(name):
+            proc = _FakeProc()
+            procs.append(proc)
+            return _FakeClient(name), proc
+
+        router = _proc_router(make)
+        try:
+            replica = router._replicas_snapshot()[0]
+            assert replica.routable() is True
+            procs[0].exit_code = 9  # SIGKILLed out from under us
+            router._sweep_liveness()
+            assert replica.is_dead() is True
+            deadline = time.monotonic() + WAIT
+            while time.monotonic() < deadline:
+                if router.stats().get("router.respawns", 0) >= 1:
+                    break
+                time.sleep(0.01)
+            assert router.stats().get("router.respawns", 0) >= 1
+        finally:
+            router.shutdown()
+
+
+class TestCrashLoopQuarantine:
+    def test_crashloop_quarantines_instead_of_hot_respawn(self):
+        """A slot whose replacements die on arrival must stop burning
+        the spawn path: after ``crashloop_max`` deaths in the window the
+        slot is quarantined with backoff, observably."""
+        spawned = []
+
+        def make(name):
+            # first boot lives; every replacement is born dead
+            proc = _FakeProc(exit_code=None if not spawned else 1)
+            spawned.append(name)
+            return _FakeClient(name), proc
+
+        router = _proc_router(make, crashloop_max=2,
+                              crashloop_window_s=30.0)
+        try:
+            first = router._replicas_snapshot()[0]
+            first.process.exit_code = 1  # kill the original
+            deadline = time.monotonic() + WAIT
+            while time.monotonic() < deadline:
+                router._sweep_liveness()
+                if router.quarantined_count() == 1:
+                    break
+                time.sleep(0.01)
+            assert router.quarantined_count() == 1
+            stats = router.stats()
+            assert stats.get("router.crashloops", 0) >= 1
+            assert "0" in stats["quarantined_slots"]
+            assert stats["quarantined_slots"]["0"]["episodes"] >= 1
+            # no hot loop: during the backoff the spawn count is frozen
+            spawns_at_quarantine = len(spawned)
+            time.sleep(0.5)
+            router._sweep_liveness()
+            assert len(spawned) == spawns_at_quarantine
+        finally:
+            router.shutdown()
+
+    def test_crashloop_flight_event_names_slot_and_status(self):
+        from flink_ml_tpu.obs import flight
+
+        def make(name):
+            return _FakeClient(name), _FakeProc(exit_code=None)
+
+        router = _proc_router(make, crashloop_max=1,
+                              crashloop_window_s=30.0)
+        try:
+            router._replicas_snapshot()[0].process.exit_code = 7
+            deadline = time.monotonic() + WAIT
+            while time.monotonic() < deadline:
+                router._sweep_liveness()
+                if router.quarantined_count() == 1:
+                    break
+                time.sleep(0.01)
+            events = [e for e in flight.events()
+                      if e.get("kind") == "router.crashloop"]
+            assert events, "no router.crashloop flight event recorded"
+            assert events[-1]["slot"] == 0
+            assert events[-1]["exit_status"] == 7
+            assert events[-1]["backoff_s"] > 0
+        finally:
+            router.shutdown()
+
+
+class TestElasticMembership:
+    def test_add_replica_grows_the_fleet(self, dense_table):
+        clients = {}
+
+        def factory(name, path, version):
+            clients[name] = _FakeClient(name)
+            return clients[name], None
+
+        router = ReplicaRouter("/nonexistent", replicas=1,
+                               replica_factory=factory, poll_ms=600_000.0)
+        try:
+            assert router.fleet_size() == 1
+            name = router.add_replica()
+            assert name is not None and name in clients
+            assert router.fleet_size() == 2
+            assert router.ready_count() == 2
+            res = router.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            assert res.num_rows == 4
+            assert router.stats().get("router.replicas_added", 0) == 1
+        finally:
+            router.shutdown()
+
+    def test_remove_replica_drains_before_terminating(self):
+        # replica 0 carries scraped depth, so the idle replica 1 is the
+        # least-loaded victim
+        a = _FakeClient("a", queue_depth=5.0)
+        b = _FakeClient("b")
+        router = _fake_router([a, b])
+        try:
+            victim = router._replicas_snapshot()[1]
+            victim.begin_dispatch()  # one request in flight on it
+            threading.Timer(0.3, victim.end_dispatch).start()
+            t0 = time.monotonic()
+            removed = router.remove_replica()
+            assert removed == victim.name
+            assert time.monotonic() - t0 >= 0.25  # it WAITED for drain
+            assert router.fleet_size() == 1
+            # the slot is tombstoned, not reindexed
+            slots = router._replicas_snapshot()
+            assert len(slots) == 2 and slots[1] is None
+            assert router.stats().get("router.replicas_removed", 0) == 1
+        finally:
+            router.shutdown()
+
+    def test_remove_drain_timeout_readmits_the_replica(self):
+        a = _FakeClient("a", queue_depth=5.0)
+        b = _FakeClient("b")
+        router = _fake_router([a, b], drain_timeout_s=0.2)
+        victim = router._replicas_snapshot()[1]
+        victim.begin_dispatch()  # never finishes inside the budget
+        try:
+            assert router.remove_replica() is None
+            assert victim.routable() is True  # re-admitted, not wedged
+            assert router.fleet_size() == 2
+            assert router.stats().get(
+                "router.remove_drain_timeouts", 0) == 1
+        finally:
+            victim.end_dispatch()
+            router.shutdown()
+
+    def test_never_removes_the_last_routable_replica(self):
+        router = _fake_router([_FakeClient("a")])
+        try:
+            assert router.remove_replica() is None
+            assert router.fleet_size() == 1
+        finally:
+            router.shutdown()
+
+    def test_membership_blocked_while_deploy_holds_the_fleet(self):
+        router = _fake_router([_FakeClient("a"), _FakeClient("b")])
+        try:
+            assert router._deploy_lock.acquire(blocking=False)
+            try:
+                assert router.add_replica() is None
+                assert router.remove_replica() is None
+            finally:
+                router._deploy_lock.release()
+        finally:
+            router.shutdown()
+
+    def test_fleet_health_aggregates_burn_and_probe_state(self):
+        class _BurnClient(_FakeClient):
+            def probe(self, timeout_s=2.0, depth=True):
+                out = super().probe(timeout_s=timeout_s, depth=depth)
+                if depth:
+                    out["burn_rates"] = {"serving_p99_ms": 2.0}
+                return out
+
+        router = _fake_router([_BurnClient("a"), _FakeClient("b")])
+        try:
+            health = router.fleet_health()
+            assert health["size"] == 2
+            assert health["ready"] == 2
+            assert health["quarantined"] == 0
+            assert health["probe_suspect"] == 0
+            # one replica exposes judged burn data; the fleet max rides up
+            assert health["burn_seen"] is True
+            assert health["max_burn_rate"] == 2.0
+            # a struck-out replica reads as probe_suspect (a fail-closed
+            # input for the autoscaler), not as idleness
+            replica = router._replicas_snapshot()[1]
+            for _ in range(router.config.scrape_strikes):
+                replica.note_probe_failure()
+            health = router.fleet_health()
+            assert health["probe_suspect"] == 1
+            assert health["ready"] == 1
+        finally:
+            router.shutdown()
+
+
 # -- the real subprocess substrate --------------------------------------------
 
 
