@@ -120,6 +120,7 @@ __all__ = [
     "fusion_enabled",
     "mesh_status",
     "reset_compile_keys",
+    "reset_family_fns",
     "reset_mesh_stats",
     "serve_mesh_enabled",
     "serve_pallas_enabled",
@@ -232,6 +233,44 @@ def serve_mesh_enabled() -> bool:
     (default 1).  Off pins every fused dispatch to one logical device —
     the pre-ISSUE-15 single-device behavior, kept as an escape hatch."""
     return knobs.knob_bool("FMT_SERVE_MESH")
+
+
+# -- family-shared executables (ISSUE 20) -------------------------------------
+#
+# Two same-family models (identical pipeline structure, different fitted
+# params) build structurally identical fused programs: the jitted fn closes
+# over stage wiring only — params arrive as call arguments.  Keying the
+# compiled program per FusedRun instance made every tenant of a family pay
+# its own trace+compile; sharing it across instances by the plan's
+# structural token makes tenant N+1's first dispatch a cache hit.  Correct
+# by the same contract the warm-artifact entry key already relies on:
+# everything a program's lowering depends on beyond argument shapes is in
+# the plan token (stage classes, wiring, declared cache_token constants).
+
+_FAMILY_FNS_CAPACITY = 64
+_FAMILY_FNS: "OrderedDict[tuple, object]" = OrderedDict()
+_FAMILY_FNS_LOCK = threading.Lock()
+
+
+def _family_fn_get(key):
+    with _FAMILY_FNS_LOCK:
+        fn = _FAMILY_FNS.get(key)
+        if fn is not None:
+            _FAMILY_FNS.move_to_end(key)
+        return fn
+
+
+def _family_fn_put(key, fn) -> None:
+    with _FAMILY_FNS_LOCK:
+        _FAMILY_FNS[key] = fn
+        while len(_FAMILY_FNS) > _FAMILY_FNS_CAPACITY:
+            _FAMILY_FNS.popitem(last=False)
+
+
+def reset_family_fns() -> None:
+    """Drop the family-shared executable cache (tests)."""
+    with _FAMILY_FNS_LOCK:
+        _FAMILY_FNS.clear()
 
 
 # -- per-device row-share accounting (ISSUE 15) -------------------------------
@@ -556,6 +595,15 @@ class FusedRun:
         fn = self._apply_fns.get(key)
         if fn is not None:
             return fn
+        # family-shared hit (ISSUE 20): another same-family run (a sibling
+        # tenant's model) already built this structural program — reuse it,
+        # params pass as call args so the math is the other model's own
+        family_key = (self._plan_cache_token(),) + key
+        fn = _family_fn_get(family_key)
+        if fn is not None:
+            self._apply_fns[key] = fn
+            obs.counter_add("fused.family_fn_hits")
+            return fn
         import jax
 
         if pallas is None:
@@ -587,6 +635,7 @@ class FusedRun:
                 check_vma=False,
             ), donate_argnums=donate)
         self._apply_fns[key] = fn
+        _family_fn_put(family_key, fn)
         return fn
 
     def _plan_cache_token(self) -> str:
@@ -1270,15 +1319,18 @@ def _try_place(a, mesh, row_multiple: int):
 
 
 def _build_run(stages, start: int, schema: Schema,
-               batch_size) -> Tuple[Optional[FusedRun], tuple]:
+               batch_size,
+               min_stages: int = 2) -> Tuple[Optional[FusedRun], tuple]:
     """Assemble the maximal fused run starting at ``start``.
 
-    Returns ``(run, cache_key)``; ``run`` is None when fewer than two
-    stages fuse or no device kernel joins (a one-stage "run" is exactly
-    the staged path already).  The key captures every mapper's identity
-    (``mapper_uid`` — a reloaded model rebuilds its mapper and thereby the
-    plan) plus the schema/batch signature, so callers can reuse a
-    previously compiled run."""
+    Returns ``(run, cache_key)``; ``run`` is None when fewer than
+    ``min_stages`` stages fuse or no device kernel joins (for the default
+    transform path a one-stage "run" is exactly the staged path already;
+    the multi-tenant mux passes ``min_stages=1`` because even a
+    single-stage family still amortizes its dispatch across tenants).
+    The key captures every mapper's identity (``mapper_uid`` — a reloaded
+    model rebuilds its mapper and thereby the plan) plus the schema/batch
+    signature, so callers can reuse a previously compiled run."""
     infos = _stage_infos(stages, start, schema)
     # host pre-kernels: only a PREFIX joins (a host lookup downstream of a
     # device kernel would force a mid-run fetch — the plan splits instead)
@@ -1395,7 +1447,7 @@ def _build_run(stages, start: int, schema: Schema,
                 new_avail[low] = avail[low]
         avail = new_avail
 
-    if not device_stages or len(host_stages) + len(device_stages) < 2:
+    if not device_stages or len(host_stages) + len(device_stages) < min_stages:
         return None, ()
 
     exit_schema = sch

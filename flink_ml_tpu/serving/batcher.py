@@ -60,6 +60,11 @@ class ServeRequest:
     enqueued_at: float
     deadline_at: Optional[float] = None  # absolute monotonic; None = none
     trace: Optional[object] = None
+    #: the routing key of multi-tenant serving (ISSUE 20): which
+    #: registered model serves these rows.  The default tenant is the
+    #: server's deployed model — old callers never set this and observe
+    #: the exact single-model behavior
+    tenant: str = "default"
     n_rows: int = field(init=False)
 
     def __post_init__(self):

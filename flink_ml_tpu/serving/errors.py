@@ -17,6 +17,7 @@ __all__ = [
     "SHED_NO_REPLICA",
     "SHED_QUEUE_FULL",
     "SHED_SHUTDOWN",
+    "SHED_TENANT_QUOTA",
     "ServerClosedError",
     "ServerOverloadedError",
     "shed_policy",
@@ -36,6 +37,10 @@ SHED_MEMORY_PRESSURE = "memory_pressure"
 #: every replica is dead, draining, or reason-coded unready — the
 #: scale-out analog of ``shutdown``, and like it, terminal for the caller
 SHED_NO_REPLICA = "no_replica"
+#: one tenant's queued rows hit ``FMT_TENANT_QUOTA_ROWS`` (ISSUE 20): the
+#: multi-tenant admission door sheds THAT tenant's overflow so a single
+#: hot tenant cannot starve its batch-mates out of the shared queue
+SHED_TENANT_QUOTA = "tenant_quota"
 
 
 # -- shed-reason retryability (ISSUE 13) --------------------------------------
@@ -62,6 +67,10 @@ _SHED_POLICIES = {
     SHED_DEADLINE: POLICY_RETRY,
     SHED_SHUTDOWN: POLICY_ROUTE_AWAY,
     SHED_BREAKER_OPEN: POLICY_ROUTE_AWAY,
+    # a tenant over its own quota is over it on EVERY replica (the quota
+    # follows the tenant, not the server) — retrying elsewhere turns one
+    # rejection into N, so hand the shed to the caller
+    SHED_TENANT_QUOTA: POLICY_FAIL,
 }
 
 

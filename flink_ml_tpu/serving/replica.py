@@ -202,6 +202,7 @@ class _DataHandler(BaseHTTPRequestHandler):
                     table,
                     deadline_ms=payload.get("deadline_ms"),
                     timeout=payload.get("timeout_s", 120.0),
+                    tenant=payload.get("tenant"),
                 )
         except ServerOverloadedError as exc:
             # the shed travels as DATA, reason code intact: the router's
@@ -375,7 +376,8 @@ class ReplicaClient:
 
     def submit(self, table, deadline_ms: Optional[float] = None,
                timeout_s: float = 120.0,
-               trace_ctx: Optional[tuple] = None) -> ServeResult:
+               trace_ctx: Optional[tuple] = None,
+               tenant: Optional[str] = None) -> ServeResult:
         """Forward one request; returns the replica's
         :class:`ServeResult` (tables bit-identical to an in-process
         serve) or raises the replica's reason-coded shed /
@@ -383,11 +385,16 @@ class ReplicaClient:
 
         ``trace_ctx`` is an optional ``(trace_id, parent_span_id)`` pair
         shipped in the payload so the replica records its spans inside
-        the ROUTER's trace (``trace.adopt`` on the far side)."""
+        the ROUTER's trace (``trace.adopt`` on the far side).
+        ``tenant`` is the multi-tenant routing key (ISSUE 20) — omitted
+        from the payload when None, so the wire format stays readable by
+        pre-tenant replicas."""
         payload = {
             "table": encode_table(table), "deadline_ms": deadline_ms,
             "timeout_s": timeout_s,
         }
+        if tenant is not None:
+            payload["tenant"] = tenant
         if trace_ctx:
             payload["trace"] = {"trace_id": trace_ctx[0],
                                 "parent_span_id": trace_ctx[1]}

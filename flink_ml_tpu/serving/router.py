@@ -231,6 +231,9 @@ class _RouterRequest:
     #: tracing is off/sampled out) — every dispatch attempt parents under
     #: it, and its context ships to the replica over the wire
     trace: Optional[object] = None
+    #: multi-tenant routing key (ISSUE 20); None = the default tenant,
+    #: kept off the wire so pre-tenant replicas still parse the payload
+    tenant: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_at is not None and now > self.deadline_at
@@ -603,21 +606,30 @@ class ReplicaRouter:
 
     # -- the request path ----------------------------------------------------
 
-    def submit(self, table, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, table, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request for the fleet; returns a Future resolving
         to a :class:`ServeResult`.  Sheds reason-coded at the door when
-        the router queue is at ``FMT_ROUTER_QUEUE_CAP`` rows."""
+        the router queue is at ``FMT_ROUTER_QUEUE_CAP`` rows.
+
+        ``tenant`` (ISSUE 20) names the registered model that serves the
+        rows; None routes to each replica's default deployed model, and
+        the key is validated/resolved at the REPLICA door (the router
+        holds no model state)."""
         n = table.num_rows()
         if n == 0:
             raise ValueError("empty request: submit at least one row")
         now = now_s()
         deadline_at = (now + float(deadline_ms) / 1e3
                        if deadline_ms and deadline_ms > 0 else None)
-        req_trace = obs.trace.start_request("router.request", {"rows": n})
+        trace_attrs = {"rows": n}
+        if tenant is not None:
+            trace_attrs["tenant"] = tenant
+        req_trace = obs.trace.start_request("router.request", trace_attrs)
         t_submit = time.perf_counter()
         request = _RouterRequest(table=table, future=Future(),
                                  enqueued_at=now, deadline_at=deadline_at,
-                                 n_rows=n, trace=req_trace)
+                                 n_rows=n, trace=req_trace, tenant=tenant)
         rejected = None
         with self._cond:
             if self._closed or self._stopping:
@@ -653,9 +665,11 @@ class ReplicaRouter:
         return request.future
 
     def predict(self, table, deadline_ms: Optional[float] = None,
-                timeout: Optional[float] = None) -> ServeResult:
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None) -> ServeResult:
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(table, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(table, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -757,6 +771,11 @@ class ReplicaRouter:
                                 # replica budget the caller no longer has
                                 deadline_ms=request.remaining_ms(now_s()),
                                 timeout_s=_DISPATCH_TIMEOUT_S,
+                                # kwarg only when keyed: default-tenant
+                                # traffic must reach clients (and fakes)
+                                # that predate the tenant parameter
+                                **({"tenant": request.tenant}
+                                   if request.tenant is not None else {}),
                                 **({"trace_ctx": (ctx[0].trace_id,
                                                   ctx[0].span_id)}
                                    if ctx else {}),

@@ -85,6 +85,7 @@ Knobs (BASELINE.md round-10/12/13/14 tables): ``FMT_SERVING_MAX_BATCH``,
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import Counter, deque
@@ -111,7 +112,13 @@ from flink_ml_tpu.serving.errors import (
     SHED_MEMORY_PRESSURE,
     SHED_QUEUE_FULL,
     SHED_SHUTDOWN,
+    SHED_TENANT_QUOTA,
     ServerClosedError,
+)
+from flink_ml_tpu.serving.tenants import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    validate_tenant_key,
 )
 from flink_ml_tpu.serving.versioning import VersionManager
 from flink_ml_tpu.table.table import Table
@@ -157,6 +164,15 @@ def _breaker_in_scope(name: str, scope: frozenset) -> bool:
     return False
 
 
+def _transform_one(model, table: Table) -> Table:
+    """One model's 1-in/1-out serving transform (the ``ModelVersion.
+    transform`` tuple-unwrap, for tenant models that carry no version
+    wrapper)."""
+    out = model.transform(table)
+    (result,) = out if isinstance(out, tuple) else (out,)
+    return result
+
+
 def _warmstart_status() -> dict:
     """The /statusz warmstart section: the active warm-artifact store (or
     None when the layer is inert) and its sealed-manifest coverage."""
@@ -192,6 +208,7 @@ class ModelServer:
                  shed_on_breaker: Optional[bool] = None,
                  telemetry_port: Optional[int] = None,
                  drift: Optional[bool] = None,
+                 tenants: Optional[str] = None,
                  start: bool = True):
         if (model is None) == (path is None):
             raise ValueError("pass exactly one of model / path")
@@ -253,6 +270,23 @@ class ModelServer:
         self._counts: Counter = Counter()
         self._counts_lock = threading.Lock()
         self._latencies: Deque[float] = deque(maxlen=512)
+        # multi-tenant serving (ISSUE 20): the tenant-keyed model
+        # registry (LRU-resident over the slab pool) plus per-tenant
+        # queued-row accounting for the FMT_TENANT_QUOTA_ROWS admission
+        # quota (guarded by self._cond like every other queue stat).  A
+        # path deploy auto-registers every subdirectory of
+        # <path>/tenants/ (or the explicit ``tenants`` directory) — the
+        # replica convention: lay models out next to the default one.
+        self._tenants = TenantRegistry(tally=self._tally)
+        self._tenant_queued: Counter = Counter()
+        tenant_dir = tenants if tenants is not None else (
+            os.path.join(path, "tenants") if path is not None else None
+        )
+        if tenant_dir is not None and os.path.isdir(tenant_dir):
+            for name in sorted(os.listdir(tenant_dir)):
+                p = os.path.join(tenant_dir, name)
+                if os.path.isdir(p):
+                    self._tenants.register(name, p)
         # open-breaker admission memo (the scan locks every breaker in
         # the process): revalidated on any breaker state TRANSITION (the
         # generation counter — an opening breaker sheds immediately) or
@@ -352,10 +386,12 @@ class ModelServer:
                 self._queue.clear()
                 self._queued_rows = 0
                 self._queued_bytes = 0
+                self._tenant_queued.clear()
             thread = self._thread  # join OUTSIDE the lock, on a stable ref
             self._cond.notify_all()
         for r in dropped:  # complete futures outside the lock
             self._shed(r, SHED_SHUTDOWN, "server shut down without draining")
+        self._tenants.close()  # detach the pool eviction listener
         if thread is not None:
             thread.join(timeout=timeout)
         elif drain:
@@ -563,15 +599,28 @@ class ModelServer:
             # manifest says is already warm — the router's rollup makes a
             # cold respawn visible before its first slow request would
             "warmstart": _warmstart_status(),
+            # multi-tenant plane (ISSUE 20): registered/resident tenant
+            # counts, the residency cap and quota, and the top-N-by-
+            # traffic tenant table (requests/rows/sheds/cold-loads/
+            # evictions per tenant)
+            "tenants": self._tenants.status(),
             "stats": self.stats(),
         }
 
     # -- the request path ----------------------------------------------------
 
     def submit(self, table: Table,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns a Future resolving to a
         :class:`~flink_ml_tpu.serving.batcher.ServeResult`.
+
+        ``tenant`` routes the rows to a registered tenant model (ISSUE
+        20); None — the wire-compatible default — serves the deployed
+        version exactly as before.  A malformed or unregistered tenant
+        key raises ``ValueError`` at the door (a caller bug, never a
+        shed); a tenant past its ``FMT_TENANT_QUOTA_ROWS`` queued-row
+        quota sheds reason-coded ``tenant_quota``.
 
         Raises :class:`ServerClosedError` when the server is shut down and
         :class:`ServerOverloadedError` (reason-coded) when the request is
@@ -582,6 +631,15 @@ class ModelServer:
         n = table.num_rows()
         if n == 0:
             raise ValueError("empty request: submit at least one row")
+        if tenant is None:
+            tenant = DEFAULT_TENANT
+        else:
+            validate_tenant_key(tenant)
+            if tenant != DEFAULT_TENANT and not self._tenants.known(tenant):
+                raise ValueError(
+                    f"unknown tenant {tenant!r}: register_tenant() it "
+                    "before submitting its traffic"
+                )
         limit = self._single_batch_rows()
         if limit and n > limit:
             raise ValueError(
@@ -593,7 +651,9 @@ class ModelServer:
         # out): minted HERE so even a synchronous admission shed carries
         # a trace_id, and every downstream hop parents under one context
         t_submit = time.perf_counter()
-        req_trace = obs.trace.start_request("serving.request", {"rows": n})
+        req_trace = obs.trace.start_request(
+            "serving.request", {"rows": n, "tenant": tenant}
+        )
         trace_id = req_trace.trace_id if req_trace is not None else None
         # breaker admission reads no queue state: check it OUTSIDE the
         # condition lock so every submit doesn't serialize a scan of all
@@ -605,6 +665,7 @@ class ModelServer:
             if open_names:
                 self._tally("serving.shed")
                 self._tally(f"serving.shed.{SHED_BREAKER_OPEN}")
+                self._tenants.note_shed(tenant)
                 if req_trace is not None:
                     req_trace.end(status="shed", attrs={
                         "shed_reason": SHED_BREAKER_OPEN,
@@ -620,8 +681,9 @@ class ModelServer:
         request = ServeRequest(
             table=table, future=Future(), enqueued_at=now,
             deadline_at=self.config.deadline_at(now, deadline_ms),
-            trace=req_trace,
+            trace=req_trace, tenant=tenant,
         )
+        quota = self._tenants.quota_rows()
         cap_bytes = self.config.queue_cap_bytes
         expired: List[ServeRequest] = []
         rejected = None
@@ -654,9 +716,19 @@ class ModelServer:
                         f"against a cap of {cap_bytes} (request adds "
                         f"{request.n_bytes})"
                     ))
+                elif quota and self._tenant_queued[tenant] + n > quota:
+                    # per-tenant fair-share door (ISSUE 20): ONE hot
+                    # tenant's backlog sheds against its own quota, not
+                    # against its batch-mates' shared queue cap
+                    rejected = (SHED_TENANT_QUOTA, (
+                        f"tenant {tenant!r} has "
+                        f"{self._tenant_queued[tenant]} rows queued "
+                        f"against a quota of {quota} (request adds {n})"
+                    ))
                 else:
                     self._queue.append(request)
                     self._queued_rows += n
+                    self._tenant_queued[tenant] += n
                     obs.gauge_set("serving.queue_depth", self._queued_rows)
                     if cap_bytes:
                         self._queued_bytes += request.n_bytes
@@ -672,9 +744,11 @@ class ModelServer:
             reason, detail = rejected
             self._tally("serving.shed")
             self._tally(f"serving.shed.{reason}")
+            self._tenants.note_shed(tenant)
             if req_trace is not None:
                 req_trace.end(status="shed",
-                              attrs={"shed_reason": reason})
+                              attrs={"shed_reason": reason,
+                                     "tenant": tenant})
             raise overloaded(reason, detail, trace_id=trace_id)
         if req_trace is not None:
             # the admission + enqueue window, on the caller thread
@@ -686,12 +760,30 @@ class ModelServer:
         self._tally("serving.request_rows", n)
         obs.counter_add("serving.requests")
         obs.counter_add("serving.request_rows", n)
+        self._tenants.note_request(tenant, n)
         return request.future
 
     def predict(self, table: Table, deadline_ms: Optional[float] = None,
-                timeout: Optional[float] = None) -> ServeResult:
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None) -> ServeResult:
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(table, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(
+            table, deadline_ms=deadline_ms, tenant=tenant
+        ).result(timeout)
+
+    def register_tenant(self, tenant: str, source,
+                        version: str = "v1") -> None:
+        """Bind ``tenant`` to a saved-model directory (or an in-memory
+        model).  Registration is metadata-only — the model faults in on
+        the tenant's first request (LRU-resident over the slab pool,
+        evicted under pressure, re-faulted in milliseconds off the
+        warm-artifact store).  See :mod:`flink_ml_tpu.serving.tenants`."""
+        self._tenants.register(tenant, source, version=version)
+
+    @property
+    def tenants(self) -> List[str]:
+        """Registered tenant keys (the default tenant not included)."""
+        return [t for t in self._tenants.tenants() if t != DEFAULT_TENANT]
 
     def _open_scoped_breakers(self) -> List[str]:
         """Open breakers on THIS server's dispatch surfaces, memoized:
@@ -875,9 +967,13 @@ class ModelServer:
             if taken and (
                 rows + r.n_rows > max_rows
                 or r.table.schema != schema
+                or not self._tenant_compat(taken[0].tenant, r.tenant)
             ):
                 break
             self._queue.popleft()
+            self._tenant_queued[r.tenant] = max(
+                self._tenant_queued[r.tenant] - r.n_rows, 0
+            )
             if track_bytes:
                 bytes_out += r.n_bytes
             if not r.future.set_running_or_notify_cancel():
@@ -898,6 +994,46 @@ class ModelServer:
             obs.counter_add("serving.cancelled_rows", dropped)
         return taken
 
+    def _tenant_compat(self, a: str, b: str) -> bool:
+        """May requests of tenants ``a`` and ``b`` share one coalesced
+        batch?  Same tenant always; different tenants only when the mux
+        is on and BOTH tenants' models are known same-family (their
+        structural plan tokens, recorded at each tenant's first serve,
+        compare equal) — so the first-ever request of a tenant serves
+        solo once and coalesces ever after."""
+        if a == b:
+            return True
+        from flink_ml_tpu.serving.mux import mux_enabled
+
+        if not mux_enabled():
+            return False
+        ta = self._tenants.family_token(a)
+        return ta is not None and ta == self._tenants.family_token(b)
+
+    def _resolve_tenant(self, tenant: str, version):
+        """One tenant's (model, version label) for a dispatch: the
+        default tenant is the snapshotted active version; a registered
+        tenant faults in through the registry (slab-pool resident)."""
+        if tenant == DEFAULT_TENANT:
+            return version.model, version.version
+        return self._tenants.resolve(tenant)
+
+    def _note_tenant_family(self, tenant: str, model, schema) -> None:
+        """Record (once per tenant) the family token under which this
+        tenant's model is mux-eligible — the compat check
+        :meth:`_take_locked` runs at every batch cut.  A model whose
+        chain cannot mux records nothing: its tenant simply keeps
+        serving solo batches."""
+        if self._tenants.family_token(tenant) is not None:
+            return
+        from flink_ml_tpu.serving import mux as mux_mod
+
+        run = mux_mod.mux_run_for(
+            model, schema, self._single_batch_rows() or None
+        )
+        if run is not None:
+            self._tenants.note_family(tenant, mux_mod.family_token(run))
+
     def _collect_expired_locked(self, now: float) -> List[ServeRequest]:
         """Remove every expired request from the queue and return them
         for the CALLER to shed once the lock is released (completing a
@@ -910,6 +1046,9 @@ class ModelServer:
         for r in self._queue:
             if r.expired(now):
                 self._queued_rows -= r.n_rows
+                self._tenant_queued[r.tenant] = max(
+                    self._tenant_queued[r.tenant] - r.n_rows, 0
+                )
                 if track_bytes:
                     self._queued_bytes = max(
                         self._queued_bytes - r.n_bytes, 0
@@ -974,11 +1113,17 @@ class ModelServer:
         shared timestamps: every caller's waterfall is complete on its
         own, and a racing sibling's spans can never cross over."""
         from flink_ml_tpu.obs import trace
-        from flink_ml_tpu.serve import quarantine
         from flink_ml_tpu.serve.quarantine import QUARANTINE_REASON_COL
 
         if not requests:
             return  # every taken request was cancelled while queued
+        if any(r.tenant != requests[0].tenant for r in requests):
+            # multi-tenant batch (ISSUE 20): per-tenant-contiguous span
+            # order — the mux stacks params per contiguous tenant span
+            # and finalize runs per tenant slice.  The sort is stable,
+            # so FIFO order holds WITHIN each tenant, and demux/futures
+            # walk this same reordered list end to end.
+            requests = sorted(requests, key=lambda r: r.tenant)
         version = self._versions.active()  # in-flight pins the old version
         traced = [r.trace for r in requests if r.trace is not None]
         now0 = now_s()
@@ -997,27 +1142,19 @@ class ModelServer:
             n_rows = table.num_rows()
             try:
                 with obs.phase("serving.batch"):
-                    with trace.span("transform", {
-                        "rows": n_rows, "version": version.version,
-                    }):
-                        with quarantine.capture() as captured:
-                            out = version.transform(table)
-                with trace.span("demux"):
-                    results = demux(
-                        out, captured, spans, version.version,
-                        trace_ids=[
-                            r.trace.trace_id if r.trace is not None
-                            else None
-                            for r in requests
-                        ],
+                    results, scored = self._serve_spans(
+                        requests, table, spans, version
                     )
-                if self._drift is not None:
+                if self._drift is not None and scored is not None:
                     # the demux-side drift tap (ISSUE 11): produced
                     # score/prediction columns of the whole coalesced
                     # batch into the live (or still-filling reference)
-                    # window, request input columns excluded
+                    # window, request input columns excluded.  Only
+                    # default-tenant batches feed it: the reference
+                    # belongs to the ACTIVE VERSION, and tenant outputs
+                    # would drift it by construction
                     self._drift.observe_scores(
-                        out, exclude=frozenset(table.schema.field_names)
+                        scored, exclude=frozenset(table.schema.field_names)
                     )
             except BaseException as exc:  # noqa: BLE001 - futures carry it
                 if (pressure.enabled() and pressure.is_oom(exc)
@@ -1069,6 +1206,152 @@ class ModelServer:
         self._warmup_sample = table.slice_rows(
             0, min(n_rows, _WARMUP_SAMPLE_ROWS)
         )
+
+    def _serve_spans(self, requests: List[ServeRequest], table: Table,
+                     spans, version):
+        """Transform + demux for one taken batch, tenant-aware.
+
+        Returns ``(results, scored)``: per-request results in span
+        order, plus the combined output table when the whole batch was
+        the default tenant (the drift monitor's feed; None otherwise).
+
+        An all-default batch runs the historical single-model body
+        verbatim.  A multi-tenant batch — only formed when every
+        member's family token matched at the cut — serves as ONE
+        multiplexed dispatch (:mod:`flink_ml_tpu.serving.mux`); mux
+        ineligibility or failure falls back to per-tenant groups, each
+        its own transform under a fresh quarantine capture, so every
+        caller's outputs and side-tables stay bit-identical to solo
+        serving either way."""
+        from flink_ml_tpu.obs import trace
+        from flink_ml_tpu.serve import quarantine
+
+        trace_ids = [
+            r.trace.trace_id if r.trace is not None else None
+            for r in requests
+        ]
+        tenants = [r.tenant for r in requests]
+        if all(t == DEFAULT_TENANT for t in tenants):
+            with trace.span("transform", {
+                "rows": table.num_rows(), "version": version.version,
+            }):
+                with quarantine.capture() as captured:
+                    out = version.transform(table)
+            with trace.span("demux"):
+                results = demux(out, captured, spans, version.version,
+                                trace_ids=trace_ids)
+            self._note_tenant_family(DEFAULT_TENANT, version.model,
+                                     table.schema)
+            return results, out
+        # contiguous per-tenant request groups (take order = span order)
+        groups: List[tuple] = []  # (tenant, first request idx, last+1)
+        for i, t in enumerate(tenants):
+            if groups and groups[-1][0] == t:
+                groups[-1] = (t, groups[-1][1], i + 1)
+            else:
+                groups.append((t, i, i + 1))
+        if len(groups) > 1:
+            results = self._serve_mux(requests, table, spans, groups,
+                                      version, trace_ids)
+            if results is not None:
+                return results, None
+        # per-tenant fallback: each group is exactly the single-tenant
+        # body on its slice of the batch — own capture, own demux, so
+        # offsets never need cross-group surgery
+        from flink_ml_tpu.table import slab_pool
+
+        results = []
+        for tenant, i0, i1 in groups:
+            lo, hi = spans[i0][0], spans[i1 - 1][1]
+            g_table = (table if lo == 0 and hi == table.num_rows()
+                       else table.slice_rows(lo, hi))
+            g_spans = [(a - lo, b - lo) for a, b in spans[i0:i1]]
+            model, label = self._resolve_tenant(tenant, version)
+            with slab_pool.pool().pinned(model):
+                with trace.span("transform", {
+                    "rows": g_table.num_rows(), "version": label,
+                    "tenant": tenant,
+                }):
+                    with quarantine.capture() as captured:
+                        out = _transform_one(model, g_table)
+                with trace.span("demux"):
+                    results.extend(demux(
+                        out, captured, g_spans, label,
+                        trace_ids=trace_ids[i0:i1],
+                    ))
+            self._note_tenant_family(tenant, model, g_table.schema)
+        return results, None
+
+    def _serve_mux(self, requests, table: Table, spans, groups,
+                   version, trace_ids):
+        """One multiplexed dispatch for a multi-tenant batch, or None
+        when a member's plan turns out mux-ineligible (the caller falls
+        back to per-tenant groups).  Every tenant's model is pinned
+        (slab-pool pin invariant) for the duration of the dispatch, so
+        neither budget pressure nor the residency cap can fault a
+        batch-mate out mid-flight.  A dispatch failure degrades to the
+        fallback too — except an allocator OOM, which propagates so the
+        request-boundary pressure split can halve the batch."""
+        import contextlib
+
+        from flink_ml_tpu.obs import trace
+        from flink_ml_tpu.parallel.mesh import inference_mesh
+        from flink_ml_tpu.serve import quarantine
+        from flink_ml_tpu.serving import mux as mux_mod
+        from flink_ml_tpu.table import slab_pool
+        from flink_ml_tpu.utils.environment import MLEnvironmentFactory
+
+        if not mux_mod.mux_enabled():
+            return None
+        batch_size = self._single_batch_rows() or None
+        mux_spans: List = []
+        models: List = []
+        labels = {}
+        token = None
+        for tenant, i0, i1 in groups:
+            model, label = self._resolve_tenant(tenant, version)
+            run = mux_mod.mux_run_for(model, table.schema, batch_size)
+            if run is None:
+                return None
+            tok = mux_mod.family_token(run)
+            if token is None:
+                token = tok
+            elif tok != token:
+                return None
+            lo, hi = spans[i0][0], spans[i1 - 1][1]
+            mux_spans.append(mux_mod.MuxSpan(tenant, run, lo, hi))
+            models.append(model)
+            labels[tenant] = label
+        try:
+            with contextlib.ExitStack() as stack:
+                pool = slab_pool.pool()
+                for m in models:
+                    stack.enter_context(pool.pinned(m))
+                mesh = inference_mesh(
+                    MLEnvironmentFactory.get_default().get_mesh()
+                )
+                with trace.span("transform", {
+                    "rows": table.num_rows(), "mux_tenants": len(groups),
+                }):
+                    with quarantine.capture() as captured:
+                        out = mux_mod.serve_mux(table, mux_spans, mesh)
+                with trace.span("demux"):
+                    results = demux(out, captured, spans, version.version,
+                                    trace_ids=trace_ids)
+        except BaseException as exc:  # noqa: BLE001 - OOM re-raised below
+            if (pressure.enabled() and pressure.is_oom(exc)
+                    and len(requests) > 1):
+                raise
+            obs.counter_add("serving.mux_fallbacks")
+            self._tally("serving.mux_fallbacks")
+            obs.flight.record("serving.mux_fallback",
+                              error=type(exc).__name__,
+                              tenants=len(groups))
+            return None
+        # each caller reads ITS tenant's version label on the result
+        for r, res in zip(requests, results):
+            res.version = labels.get(r.tenant, res.version)
+        return results
 
     # -- accounting ----------------------------------------------------------
 

@@ -246,6 +246,12 @@ class SlabPool:
         #: the pin releases — the documented pin invariant — then the next
         #: drain lets them go
         self._displaced: list = []
+        #: eviction listeners (ISSUE 20: the tenant registry's reason-coded
+        #: fault-out events).  Drops queue ``(key, reason, nbytes)`` under
+        #: the lock; listeners fire OUTSIDE it — one may re-enter the pool
+        #: to fault an entry back in
+        self._listeners: list = []
+        self._events: list = []
         self.bytes = 0
         self.hits = 0
         self.misses = 0
@@ -300,12 +306,27 @@ class SlabPool:
         return out
 
     def _drain_dead_locked(self) -> None:
-        """Reap entries whose source buffers were GC'd (under the lock)."""
+        """Reap entries whose source buffers were GC'd (under the lock).
+
+        A dead entry that is still PINNED cannot drop yet (the pin
+        invariant) — its key goes BACK on the queue so the drain after
+        the pin releases reclaims it.  The old code popped and discarded
+        the key, so a buffer that died mid-pin left a permanently
+        unreapable entry whose bytes squatted the budget alongside its
+        replacement's — the double-count that evicted innocent entries
+        under a tight ``FMT_SLAB_POOL_BUDGET_MB``."""
+        retry: list = []
         while self._dead_keys:
             key = self._dead_keys.pop()
             entry = self._entries.get(key)
-            if entry is not None and not entry.alive() and entry.pins == 0:
-                self._drop_locked(key, entry)
+            if entry is None or entry.alive():
+                continue  # already dropped, or the key was re-inserted
+            if entry.pins > 0:
+                retry.append(key)
+                continue
+            self._drop_locked(key, entry, reason="dead")
+        if retry:
+            self._dead_keys.extend(retry)
         if self._displaced:
             self._displaced = [e for e in self._displaced if e.pins > 0]
 
@@ -320,15 +341,63 @@ class SlabPool:
             # the in-flight device call releases the pin (the pin invariant
             # _drain_dead_locked/_evict_over_budget_locked also honor)
             if entry.pins == 0:
-                self._drop_locked(key, entry)
+                self._drop_locked(key, entry, reason="dead")
             return None
         self._entries.move_to_end(key)
         return entry
 
-    def _drop_locked(self, key, entry: _Entry) -> None:
+    def _drop_locked(self, key, entry: _Entry,
+                     reason: Optional[str] = None) -> None:
         self._entries.pop(key, None)
         self._by_value.pop(id(entry.value), None)
         self.bytes -= entry.nbytes
+        if reason is not None and self._listeners:
+            self._events.append((key, reason, entry.nbytes))
+
+    # -- eviction listeners ---------------------------------------------------
+
+    def add_eviction_listener(self, fn: Callable) -> None:
+        """Register ``fn(key, reason, nbytes)`` to observe entry drops
+        (reasons: ``dead`` / ``budget`` / ``pressure`` / ``replaced`` /
+        ``explicit``).  Listeners fire outside the pool lock."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_eviction_listener(self, fn: Callable) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _notify_evictions(self) -> None:
+        """Deliver queued drop events outside the lock — a listener may
+        re-enter the pool (a tenant registry faulting a model back in),
+        and must never be able to break the drop that notified it."""
+        with self._lock:
+            if not self._events:
+                return
+            events, self._events = self._events, []
+        for fn in list(self._listeners):
+            for key, reason, nbytes in events:
+                try:
+                    fn(key, reason, nbytes)
+                except Exception:  # noqa: BLE001 - advisory telemetry
+                    pass
+
+    def discard(self, key, reason: str = "explicit") -> bool:
+        """Drop ONE entry by key (the tenant registry's resident-cap
+        fault-out).  Honors the pin invariant — a pinned entry stays put
+        and ``False`` comes back."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.pins > 0:
+                return False
+            self._drop_locked(key, entry, reason=reason)
+            self.evictions += 1
+            obs.counter_add("slab_pool.evictions")
+            self._record_gauges_locked()
+        self._notify_evictions()
+        return True
 
     def get_or_build(self, key, builder: Callable, refs=(),
                      nbytes: Optional[int] = None, agreed: bool = True):
@@ -419,7 +488,7 @@ class SlabPool:
                 self._entries.pop(key, None)
                 self.bytes -= old.nbytes
             elif old is not None:
-                self._drop_locked(key, old)
+                self._drop_locked(key, old, reason="replaced")
             self._entries[key] = _Entry(
                 value, nbytes, self._guarded_refs(key, refs)
             )
@@ -430,6 +499,7 @@ class SlabPool:
             obs.counter_add("slab_pool.misses")
             obs.counter_add("slab_pool.bytes_placed", nbytes)
             self._record_gauges_locked()
+        self._notify_evictions()
         return value
 
     def _evict_over_budget_locked(self, keep=None, collective_ok: bool = True) -> None:
@@ -444,7 +514,7 @@ class SlabPool:
         # until budget pressure
         for key, entry in list(self._entries.items()):
             if not entry.alive() and entry.pins == 0:
-                self._drop_locked(key, entry)
+                self._drop_locked(key, entry, reason="dead")
         budget = self.budget_bytes(collective_ok)
         if self.bytes <= budget:
             return
@@ -454,7 +524,7 @@ class SlabPool:
             entry = self._entries[key]
             if key == keep or entry.pins > 0:
                 continue
-            self._drop_locked(key, entry)
+            self._drop_locked(key, entry, reason="budget")
             self.evictions += 1
             obs.counter_add("slab_pool.evictions")
 
@@ -494,12 +564,13 @@ class SlabPool:
                 if entry.pins > 0:
                     continue
                 dropped += entry.nbytes
-                self._drop_locked(key, entry)
+                self._drop_locked(key, entry, reason="pressure")
                 self.evictions += 1
             if dropped:
                 obs.counter_add("slab_pool.pressure_evictions")
                 obs.counter_add("slab_pool.pressure_evicted_bytes", dropped)
                 self._record_gauges_locked()
+        self._notify_evictions()
         return dropped
 
     def reap(self) -> None:
@@ -511,6 +582,7 @@ class SlabPool:
         because no later fit happened to run."""
         with self._lock:
             self._drain_dead_locked()
+        self._notify_evictions()
 
     def clear(self) -> None:
         with self._lock:

@@ -173,6 +173,13 @@ DECLARATIONS: Tuple[Knob, ...] = (
          "Default per-request serving deadline in ms (0 = none)."),
     Knob("FMT_SERVING_SHED_ON_BREAKER", "1", "bool",
          "Refuse requests at the door while a circuit breaker is open."),
+    # -- multi-tenant serving ---------------------------------------------
+    Knob("FMT_TENANT_MAX_RESIDENT", "64", "int",
+         "Max tenant models resident per server before LRU fault-out."),
+    Knob("FMT_TENANT_QUOTA_ROWS", "0", "int",
+         "Per-tenant queued-row quota before a tenant_quota shed (0=off)."),
+    Knob("FMT_TENANT_MUX", "1", "bool",
+         "Coalesce same-family tenants into one multiplexed fused dispatch."),
     # -- replica router ---------------------------------------------------
     Knob("FMT_ROUTER_REPLICAS", "2", "int",
          "Replica processes a ReplicaRouter spawns by default."),

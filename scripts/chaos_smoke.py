@@ -2269,6 +2269,199 @@ def coldstart_main() -> int:
     return 0
 
 
+def multitenant_main() -> int:
+    """The multi-tenant serving chaos matrix (``--multitenant``, ISSUE 20).
+
+    200 tenants — symlinked artifact dirs over TWO distinct fitted
+    models, interleaved, so any cross-tenant routing mistake serves
+    visibly wrong predictions — under Zipf-skewed traffic:
+
+    1. **eviction churn in-process** — one ModelServer with a residency
+       cap of 8 models over the 200 tenants: the Zipf tail forces
+       constant evict/fault-in cycles, and every response must match
+       that tenant's underlying model bit-for-bit (an evicted model that
+       comes back wrong, or a mux that gathers another tenant's params,
+       fails here);
+    2. **kill -9 under multi-tenant load** — a 3-replica router fleet
+       (each replica auto-registers ``<model>/tenants/``) serves the
+       same Zipf stream while one replica is SIGKILLed mid-traffic:
+       zero caller-visible failures, zero cross-tenant leakage across
+       the respawn.
+    """
+    import shutil
+    import threading
+    import time
+
+    reports_dir = tempfile.mkdtemp(prefix="chaos_multitenant_reports_")
+    os.environ["FMT_OBS_REPORTS"] = reports_dir
+    os.environ["FMT_TENANT_MAX_RESIDENT"] = "8"  # churn: 200 tenants, 8 slots
+    from flink_ml_tpu import obs
+    from flink_ml_tpu.api.pipeline import Pipeline
+    from flink_ml_tpu.lib import LogisticRegression
+    from flink_ml_tpu.lib.feature import StandardScaler
+    from flink_ml_tpu.serving import ModelServer, ReplicaRouter
+
+    N_TENANTS, REQ_ROWS = 200, 4
+    table = dense_table()
+
+    def fit_variant(flip: bool):
+        _X, _y = make_xy()
+        if flip:
+            _y = 1.0 - _y  # opposite decision surface: leakage flips preds
+        from flink_ml_tpu.table.schema import DataTypes, Schema
+        from flink_ml_tpu.table.table import Table
+
+        t = Table.from_columns(
+            Schema.of(("features", DataTypes.DENSE_VECTOR),
+                      ("label", "double")),
+            {"features": _X.astype(np.float32), "label": _y},
+        )
+        return Pipeline([
+            StandardScaler().set_selected_col("features"),
+            LogisticRegression().set_vector_col("features")
+            .set_label_col("label").set_prediction_col("p")
+            .set_learning_rate(0.5).set_max_iter(3),
+        ]).fit(t)
+
+    work = tempfile.mkdtemp(prefix="chaos_multitenant_")
+    try:
+        model_a, model_b = fit_variant(False), fit_variant(True)
+        v1_dir = os.path.join(work, "v1")
+        model_a.save(v1_dir)
+        a_dir = os.path.join(work, "model_a")
+        b_dir = os.path.join(work, "model_b")
+        model_a.save(a_dir)
+        model_b.save(b_dir)
+        # 200 tenants as symlinks into the two artifacts, interleaved —
+        # the replica convention: <model>/tenants/<name>/ auto-registers
+        tenants_dir = os.path.join(v1_dir, "tenants")
+        os.makedirs(tenants_dir)
+        names = [f"t{i:03d}" for i in range(N_TENANTS)]
+        for i, name in enumerate(names):
+            os.symlink(a_dir if i % 2 == 0 else b_dir,
+                       os.path.join(tenants_dir, name))
+        (out_a,) = model_a.transform(table)
+        (out_b,) = model_b.transform(table)
+        preds = {n: np.asarray((out_a if i % 2 == 0 else out_b).col("p"))
+                 for i, n in enumerate(names)}
+        assert not np.array_equal(preds["t000"], preds["t001"]), (
+            "the two model variants agree everywhere — leakage would be "
+            "invisible; the chaos leg needs distinguishable tenants")
+
+        rng = np.random.RandomState(11)
+
+        def zipf_stream(n):
+            """(tenant, row_lo) pairs, Zipf-skewed over the 200 tenants."""
+            out = []
+            for v in rng.zipf(1.3, size=n):
+                idx = int(v - 1) % N_TENANTS
+                lo = int(rng.randint(0, N - REQ_ROWS))
+                out.append((names[idx], lo))
+            return out
+
+        # -- leg 1: eviction churn in-process, parity on every response --
+        obs.reset()
+        server = ModelServer(path=v1_dir, version="v1", max_wait_ms=5)
+        try:
+            stream = zipf_stream(400)
+            for burst_lo in range(0, len(stream), 40):
+                burst = stream[burst_lo:burst_lo + 40]
+                futs = [
+                    (name, lo,
+                     server.submit(table.slice_rows(lo, lo + REQ_ROWS),
+                                   tenant=name))
+                    for name, lo in burst
+                ]
+                for name, lo, f in futs:
+                    res = f.result(120)
+                    np.testing.assert_array_equal(
+                        np.asarray(res.table.col("p")),
+                        preds[name][lo:lo + REQ_ROWS],
+                        err_msg=f"tenant {name} rows {lo}.. diverge — "
+                                "cross-tenant leakage or a bad fault-in")
+        finally:
+            server.shutdown()
+        c = obs.registry().snapshot()["counters"]
+        distinct = len({n for n, _ in stream})
+        assert c.get("serving.tenant.evictions", 0) >= 1, c
+        assert c.get("serving.tenant.cold_loads", 0) > distinct, (
+            "no refault churn: every tenant loaded at most once under an "
+            f"8-slot cap over {distinct} distinct tenants: {c}")
+        print(f"  eviction churn: 400 Zipf requests over {distinct} "
+              f"distinct tenants, cap 8 — "
+              f"{c.get('serving.tenant.cold_loads'):g} cold loads, "
+              f"{c.get('serving.tenant.evictions'):g} evictions, "
+              f"{c.get('serving.mux.dispatches', 0):g} mux dispatches, "
+              "every response bit-exact")
+
+        # -- leg 2: kill -9 one replica under multi-tenant load ----------
+        obs.reset()
+        n_replicas = 3
+        router = ReplicaRouter(v1_dir, version="v1", replicas=n_replicas,
+                               poll_ms=30)
+        failures, results = [], []
+        stop = threading.Event()
+
+        def load_loop():
+            i = 0
+            stream = zipf_stream(10_000)
+            while not stop.is_set() and i < len(stream):
+                name, lo = stream[i]
+                try:
+                    res = router.predict(
+                        table.slice_rows(lo, lo + REQ_ROWS),
+                        tenant=name, timeout=120)
+                    results.append((name, lo, res))
+                except BaseException as exc:  # noqa: BLE001 - asserted
+                    failures.append(exc)
+                i += 1
+                time.sleep(0.002)
+
+        loader = threading.Thread(target=load_loop, daemon=True)
+        loader.start()
+        while len(results) < 20:
+            time.sleep(0.005)
+        victim = router.replicas[0]["pid"]
+        t_kill = time.monotonic()
+        os.kill(victim, signal.SIGKILL)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            stats = router.stats()
+            if (stats.get("router.respawns", 0) >= 1
+                    and router.ready_count() >= n_replicas):
+                break
+            time.sleep(0.05)
+        recovery_s = time.monotonic() - t_kill
+        while len(results) < 60:  # traffic ACROSS the respawn boundary
+            time.sleep(0.01)
+        stop.set()
+        loader.join(60)
+        stats = router.stats()
+        try:
+            assert stats.get("router.respawns", 0) >= 1, stats
+            assert router.ready_count() == n_replicas, router.replicas
+            assert not failures, (
+                f"{len(failures)} requests failed across the kill: "
+                f"{failures[0]!r}")
+            for name, lo, res in results:
+                np.testing.assert_array_equal(
+                    np.asarray(res.table.col("p")),
+                    preds[name][lo:lo + REQ_ROWS],
+                    err_msg=f"tenant {name} rows {lo}.. diverge across "
+                            "the respawn — cross-tenant leakage")
+            served_tenants = len({n for n, _, _ in results})
+            print(f"  kill -9 pid {victim}: {len(results)} requests over "
+                  f"{served_tenants} tenants served, zero failures, "
+                  f"respawn in {recovery_s:.2f}s, zero leakage")
+        finally:
+            router.shutdown()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        os.environ.pop("FMT_TENANT_MAX_RESIDENT", None)
+    print("multitenant chaos smoke OK")
+    return 0
+
+
 def autoscale_main() -> int:
     """The elastic-fleet chaos matrix (``--autoscale``, ISSUE 19).
 
@@ -2490,6 +2683,8 @@ def main() -> int:
         return coldstart_main()
     if "--autoscale" in sys.argv:
         return autoscale_main()
+    if "--multitenant" in sys.argv:
+        return multitenant_main()
 
     reports_dir = tempfile.mkdtemp(prefix="chaos_reports_")
     os.environ["FMT_OBS_REPORTS"] = reports_dir

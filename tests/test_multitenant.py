@@ -1,0 +1,450 @@
+"""Multi-tenant model multiplexing (ISSUE 20) — tenant-keyed registry,
+same-family mux coalescing, per-tenant quota, LRU residency, and the
+cross-tenant isolation contract.
+
+The contract under test: every tenant's served rows are BIT-IDENTICAL to
+serving that tenant's model solo — coalescing across tenants, the
+stacked-param mux dispatch, eviction and fault-in are all invisible to
+the caller — while per-tenant accounting (requests/sheds/evictions/
+cold-loads) makes noisy neighbors visible and ``FMT_TENANT_QUOTA_ROWS``
+makes them sheddable.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import obs
+from flink_ml_tpu.api.pipeline import Pipeline
+from flink_ml_tpu.common import fused
+from flink_ml_tpu.lib import LogisticRegression
+from flink_ml_tpu.lib.feature import MinMaxScaler, StandardScaler
+from flink_ml_tpu.serve import quarantine
+from flink_ml_tpu.serving import ModelServer, ServerOverloadedError
+from flink_ml_tpu.serving.errors import SHED_TENANT_QUOTA
+from flink_ml_tpu.serving.tenants import (
+    DEFAULT_TENANT,
+    TENANT_KEY_MAX,
+    validate_tenant_key,
+)
+from flink_ml_tpu.table import slab_pool
+from flink_ml_tpu.table.schema import DataTypes, Schema
+from flink_ml_tpu.table.table import Table
+
+N, D = 256, 5
+SCHEMA = Schema.of(("features", DataTypes.DENSE_VECTOR), ("label", "double"))
+WAIT = 30
+
+rng = np.random.RandomState(7)
+_X = (2.0 * rng.randn(N, D) + 1.0).astype(np.float32)
+_W = rng.randn(D).astype(np.float32)
+_Y = ((_X - 1.0) @ _W > 0).astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def dense_table():
+    return Table.from_columns(SCHEMA, {"features": _X, "label": _Y})
+
+
+def _fit(seed):
+    """One family member: same pipeline structure, different params."""
+    r = np.random.RandomState(seed)
+    X = (2.0 * r.randn(N, D) + 1.0).astype(np.float32)
+    y = ((X - 1.0) @ _W > 0).astype(np.float64)
+    t = Table.from_columns(SCHEMA, {"features": X, "label": y})
+    return Pipeline([
+        StandardScaler().set_selected_col("features"),
+        MinMaxScaler().set_selected_col("features"),
+        LogisticRegression().set_vector_col("features")
+        .set_label_col("label").set_prediction_col("pred")
+        .set_prediction_detail_col("proba").set_max_iter(3)
+        .set_learning_rate(0.5),
+    ]).fit(t)
+
+
+@pytest.fixture(scope="module")
+def default_model():
+    return _fit(1)
+
+
+@pytest.fixture(scope="module")
+def tenant_models():
+    return {f"t{i}": _fit(10 + i) for i in range(4)}
+
+
+@pytest.fixture
+def obs_on():
+    obs.enable()
+    obs.reset()
+    obs.flight.reset()
+    yield
+    obs.reset()
+    obs.flight.reset()
+    obs.disable()
+
+
+def _solo(model, table):
+    out = model.transform(table)
+    (out,) = out if isinstance(out, tuple) else (out,)
+    return out
+
+
+def _assert_served_equal(got: Table, want: Table):
+    np.testing.assert_array_equal(
+        np.asarray(got.col("pred"), dtype=np.float64),
+        np.asarray(want.col("pred"), dtype=np.float64), err_msg="pred")
+    # float scores: accumulation tolerance (the mux gathers stacked
+    # params, which reassociates the dot product), discrete outputs exact
+    np.testing.assert_allclose(
+        np.asarray(got.col("proba"), dtype=np.float64),
+        np.asarray(want.col("proba"), dtype=np.float64),
+        rtol=1e-5, atol=1e-6, err_msg="proba")
+
+
+# -- tenant key admission (satellite: malformed-key red test) -----------------
+
+
+class TestTenantKeys:
+    @pytest.mark.parametrize("bad", [
+        "", "-leading-dash", ".hidden", "has space", "slash/key",
+        "semi;colon", "a" * (TENANT_KEY_MAX + 1), "\x00nul", "é-accent",
+    ])
+    def test_malformed_keys_raise_value_error(self, bad):
+        with pytest.raises(ValueError):
+            validate_tenant_key(bad)
+
+    @pytest.mark.parametrize("bad", [None, 7, b"bytes"])
+    def test_non_string_keys_raise_value_error(self, bad):
+        with pytest.raises(ValueError):
+            validate_tenant_key(bad)
+
+    @pytest.mark.parametrize("ok", [
+        "t0", "Tenant-1", "acme.prod", "a", "0", "x" * TENANT_KEY_MAX,
+    ])
+    def test_well_formed_keys_pass(self, ok):
+        assert validate_tenant_key(ok) == ok
+
+    def test_malformed_key_rejected_at_the_submit_door(self, default_model,
+                                                       dense_table):
+        server = ModelServer(default_model, start=False)
+        try:
+            with pytest.raises(ValueError, match="malformed tenant key"):
+                server.submit(dense_table.slice_rows(0, 2),
+                              tenant="no/slashes")
+        finally:
+            server.shutdown(drain=False)
+
+    def test_unknown_tenant_rejected_at_the_submit_door(self, default_model,
+                                                        dense_table):
+        server = ModelServer(default_model, start=False)
+        try:
+            with pytest.raises(ValueError, match="unknown tenant"):
+                server.submit(dense_table.slice_rows(0, 2), tenant="ghost")
+        finally:
+            server.shutdown(drain=False)
+
+    def test_default_tenant_cannot_be_registered(self, default_model):
+        server = ModelServer(default_model, start=False)
+        try:
+            with pytest.raises(ValueError, match="deploy"):
+                server.register_tenant(DEFAULT_TENANT, default_model)
+        finally:
+            server.shutdown(drain=False)
+
+
+# -- cross-tenant isolation: parity vs solo serving ---------------------------
+
+
+class TestTenantParity:
+    def test_coalesced_tenants_match_solo_bit_for_bit(self, default_model,
+                                                      tenant_models,
+                                                      dense_table, obs_on):
+        """Interleaved traffic from 4 same-family tenants in one burst:
+        every response must equal a solo transform of that tenant's model
+        over exactly the caller's rows."""
+        solo = {t: _solo(m, dense_table)
+                for t, m in tenant_models.items()}
+        with ModelServer(default_model, max_batch=1024,
+                         max_wait_ms=50) as server:
+            for t, m in tenant_models.items():
+                server.register_tenant(t, m)
+            futs, lo = [], 0
+            for rep in range(4):
+                for t in tenant_models:
+                    futs.append((t, lo, server.submit(
+                        dense_table.slice_rows(lo, lo + 16), tenant=t)))
+                    lo += 16
+            for t, lo_, f in futs:
+                res = f.result(WAIT)
+                _assert_served_equal(
+                    res.table, solo[t].slice_rows(lo_, lo_ + 16))
+                assert res.version.startswith(t + ":")
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.tenant.requests", 0) == 16
+
+    def test_mux_quarantine_offsets_stay_request_local(self, default_model,
+                                                       tenant_models,
+                                                       dense_table, obs_on):
+        """Two tenants coalesced, tenant B ships a NaN row: B sees
+        ``nan_inf`` at ITS local offset, A sees clean rows — exactly the
+        solo-serving side-tables."""
+        t_a, t_b = "t0", "t1"
+        a_req = dense_table.slice_rows(0, 3)
+        Xb = np.asarray(dense_table.features_dense("features")[3:6]).copy()
+        Xb[1, 0] = np.nan
+        b_req = Table.from_columns(SCHEMA, {
+            "features": Xb, "label": dense_table.col("label")[3:6]})
+        quarantine.reset()
+        server = ModelServer(default_model, max_batch=64, max_wait_ms=20,
+                             start=False)
+        try:
+            server.register_tenant(t_a, tenant_models[t_a])
+            server.register_tenant(t_b, tenant_models[t_b])
+            # warm the family tokens so the NEXT batch coalesces the two
+            # tenants (a tenant's first serve runs solo by design)
+            server.start()
+            server.predict(dense_table.slice_rows(0, 2), tenant=t_a,
+                           timeout=WAIT)
+            server.predict(dense_table.slice_rows(0, 2), tenant=t_b,
+                           timeout=WAIT)
+            fa = server.submit(a_req, tenant=t_a)
+            fb = server.submit(b_req, tenant=t_b)
+            ra, rb = fa.result(WAIT), fb.result(WAIT)
+        finally:
+            server.shutdown()
+        assert ra.num_rows == 3 and ra.num_quarantined == 0
+        assert rb.num_rows == 2 and rb.num_quarantined == 1
+        (q,) = rb.quarantine.values()
+        assert list(q.col(quarantine.QUARANTINE_REASON_COL)) == ["nan_inf"]
+        assert [int(r) for r in q.col(quarantine.QUARANTINE_ROW_COL)] == [1]
+        quarantine.reset()
+        solo_b = _solo(tenant_models[t_b], b_req)
+        quarantine.reset()
+        _assert_served_equal(ra.table, _solo(tenant_models[t_a], a_req))
+        _assert_served_equal(rb.table, solo_b)
+
+    def test_eviction_then_fault_in_preserves_parity(self, default_model,
+                                                     dense_table, tmp_path,
+                                                     monkeypatch, obs_on):
+        """A tenant evicted by the residency cap must serve IDENTICALLY
+        after faulting back in from its artifact."""
+        monkeypatch.setenv("FMT_TENANT_MAX_RESIDENT", "1")
+        models = {f"p{i}": _fit(30 + i) for i in range(3)}
+        for t, m in models.items():
+            m.save(str(tmp_path / t))
+        solo = {t: _solo(m, dense_table) for t, m in models.items()}
+        slab_pool.reset_pool()
+        try:
+            with ModelServer(default_model, max_wait_ms=10) as server:
+                for t in models:
+                    server.register_tenant(t, str(tmp_path / t))
+                for round_ in range(2):
+                    for t in models:  # each resolve evicts the previous
+                        res = server.predict(dense_table.slice_rows(0, 8),
+                                             tenant=t, timeout=WAIT)
+                        _assert_served_equal(
+                            res.table, solo[t].slice_rows(0, 8))
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("serving.tenant.evictions", 0) >= 2
+            # round 2 re-faulted models the cap displaced in round 1
+            assert c.get("serving.tenant.cold_loads", 0) >= 4
+        finally:
+            slab_pool.reset_pool()
+
+
+# -- family-shared compile economics (satellite 1) ----------------------------
+
+
+class TestCompileFlatness:
+    def test_compile_ledger_flat_across_50_tenants_of_one_family(
+            self, default_model, dense_table, obs_on, monkeypatch):
+        """50 tenants of ONE pipeline family serve through one server:
+        the compile ledger must grow by at most a handful of shape rungs
+        — never per tenant."""
+        # a warm-artifact store left active by an earlier path-deploy
+        # test would satisfy solo dispatches from disk and bypass the
+        # family-fn cache whose economics this test asserts
+        monkeypatch.setenv("FMT_WARMSTART", "0")
+        tenants = {f"f{i}": _fit(100 + i) for i in range(50)}
+        with ModelServer(default_model, max_batch=1024,
+                         max_wait_ms=20) as server:
+            for t, m in tenants.items():
+                server.register_tenant(t, m)
+            # warm round: each tenant's first serve learns its family
+            # token (and may compile the family's shape rungs once)
+            for t in tenants:
+                server.predict(dense_table.slice_rows(0, 4), tenant=t,
+                               timeout=WAIT)
+            seen_after_warm = len(fused._COMPILE_SEEN)
+            futs = [server.submit(dense_table.slice_rows(0, 4), tenant=t)
+                    for t in tenants]
+            for f in futs:
+                f.result(WAIT)
+            growth = len(fused._COMPILE_SEEN) - seen_after_warm
+        # the coalesced round may mint a few NEW tenant-count rungs
+        # (mux:plan@t2, @t4, ...) but NOTHING proportional to 50 tenants
+        assert growth <= 8, growth
+        c = obs.registry().snapshot()["counters"]
+        # tenants shared jit executables through the family cache
+        assert c.get("fused.family_fn_hits", 0) > 0
+
+    def test_mux_coalesces_many_tenants_into_few_dispatches(
+            self, default_model, tenant_models, dense_table, obs_on):
+        with ModelServer(default_model, max_batch=1024,
+                         max_wait_ms=50) as server:
+            for t, m in tenant_models.items():
+                server.register_tenant(t, m)
+            for t in tenant_models:  # warm family tokens
+                server.predict(dense_table.slice_rows(0, 4), tenant=t,
+                               timeout=WAIT)
+            futs, lo = [], 0
+            for rep in range(4):
+                for t in tenant_models:
+                    futs.append(server.submit(
+                        dense_table.slice_rows(lo, lo + 8), tenant=t))
+                    lo += 8
+            for f in futs:
+                f.result(WAIT)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.mux.dispatches", 0) >= 1
+        # strictly more tenants coalesced than dispatches = real fusion
+        assert (c.get("serving.mux.tenants_coalesced", 0)
+                > c.get("serving.mux.dispatches", 0))
+        assert c.get("serving.mux_fallbacks", 0) == 0
+
+
+# -- per-tenant quota at the admission door -----------------------------------
+
+
+class TestTenantQuota:
+    def test_quota_sheds_reason_coded_and_spares_other_tenants(
+            self, default_model, tenant_models, dense_table, monkeypatch,
+            obs_on):
+        monkeypatch.setenv("FMT_TENANT_QUOTA_ROWS", "8")
+        server = ModelServer(default_model, start=False)
+        try:
+            server.register_tenant("t0", tenant_models["t0"])
+            server.register_tenant("t1", tenant_models["t1"])
+            server.submit(dense_table.slice_rows(0, 8), tenant="t0")
+            with pytest.raises(ServerOverloadedError) as err:
+                server.submit(dense_table.slice_rows(0, 4), tenant="t0")
+            assert err.value.reason == SHED_TENANT_QUOTA
+            # the noisy neighbor's quota is NOT the quiet one's problem
+            server.submit(dense_table.slice_rows(0, 8), tenant="t1")
+            server.submit(dense_table.slice_rows(0, 4))  # default tenant
+        finally:
+            server.shutdown(drain=False)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.tenant.sheds", 0) == 1
+
+    def test_quota_releases_as_the_queue_drains(self, default_model,
+                                                tenant_models, dense_table,
+                                                monkeypatch, obs_on):
+        monkeypatch.setenv("FMT_TENANT_QUOTA_ROWS", "8")
+        with ModelServer(default_model, max_wait_ms=5) as server:
+            server.register_tenant("t0", tenant_models["t0"])
+            for _ in range(3):  # served sequentially: quota never trips
+                server.predict(dense_table.slice_rows(0, 8), tenant="t0",
+                               timeout=WAIT)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.tenant.sheds", 0) == 0
+
+
+# -- per-tenant observability (satellite 3) -----------------------------------
+
+
+class TestTenantObservability:
+    def test_statusz_tenant_table_and_counters(self, default_model,
+                                               tenant_models, dense_table,
+                                               obs_on):
+        with ModelServer(default_model, max_wait_ms=5) as server:
+            server.register_tenant("t0", tenant_models["t0"])
+            server.register_tenant("t1", tenant_models["t1"])
+            for _ in range(3):
+                server.predict(dense_table.slice_rows(0, 4), tenant="t0",
+                               timeout=WAIT)
+            server.predict(dense_table.slice_rows(0, 4), tenant="t1",
+                           timeout=WAIT)
+            server.predict(dense_table.slice_rows(0, 4), timeout=WAIT)
+            status = server._telemetry_status()
+        tenants = status["tenants"]
+        assert tenants["tenants"] >= 3  # t0, t1, the implicit default
+        top = {row["tenant"]: row for row in tenants["top"]}
+        assert top["t0"]["requests"] == 3
+        assert top["t1"]["requests"] == 1
+        assert top[DEFAULT_TENANT]["requests"] == 1
+        assert top["t0"]["cold_loads"] == 1
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serving.tenant.requests", 0) == 5
+        assert c.get("serving.tenant.cold_loads", 0) == 2
+
+    def test_flight_events_carry_tenant_and_reason(self, default_model,
+                                                   dense_table, tmp_path,
+                                                   monkeypatch, obs_on):
+        monkeypatch.setenv("FMT_TENANT_MAX_RESIDENT", "1")
+        m0, m1 = _fit(60), _fit(61)
+        m0.save(str(tmp_path / "e0"))
+        m1.save(str(tmp_path / "e1"))
+        slab_pool.reset_pool()
+        try:
+            with ModelServer(default_model, max_wait_ms=5) as server:
+                server.register_tenant("e0", str(tmp_path / "e0"))
+                server.register_tenant("e1", str(tmp_path / "e1"))
+                server.predict(dense_table.slice_rows(0, 4), tenant="e0",
+                               timeout=WAIT)
+                server.predict(dense_table.slice_rows(0, 4), tenant="e1",
+                               timeout=WAIT)
+            events = [e for e in obs.flight.events()
+                      if e.get("kind") == "serving.tenant.evicted"]
+            assert events, "no eviction flight events recorded"
+            assert events[0]["tenant"] == "e0"
+            assert events[0]["reason"] == "resident_cap"
+            loads = [e for e in obs.flight.events()
+                     if e.get("kind") == "serving.tenant.cold_load"]
+            assert {e["tenant"] for e in loads} == {"e0", "e1"}
+        finally:
+            slab_pool.reset_pool()
+
+
+# -- the slab-pool pin invariant at the eviction boundary (satellite 2) -------
+
+
+class TestPoolPinInvariantAtEviction:
+    def test_budget_displacement_skips_pinned_without_double_count(self):
+        """LRU displacement under a tight budget must SKIP a pinned slab
+        — and once the pin releases, the pool's byte accounting must show
+        no trace of the displaced-entry bookkeeping (no double count)."""
+        pool = slab_pool.SlabPool(budget_bytes=100)
+        v = pool.get_or_build("pinned", lambda: np.zeros(10, np.float32))
+        with pool.pinned(v):
+            pool.get_or_build("a", lambda: np.zeros(10, np.float32))
+            pool.get_or_build("b", lambda: np.zeros(10, np.float32))
+            # budget is 100 B with 120 B live: the pinned slab stays
+            assert pool.get_or_build("pinned", lambda: "rebuilt") is v
+        pool.get_or_build("c", lambda: np.zeros(10, np.float32))
+        assert pool.bytes <= 100  # accounting converged after release
+
+    def test_dead_while_pinned_is_reaped_after_release(self):
+        """RED test for the double-count: a source buffer GC'd while its
+        entry is pinned must NOT leave a permanently unreapable entry
+        squatting the budget — the drain after the pin releases reclaims
+        it and the bytes."""
+        pool = slab_pool.SlabPool(budget_bytes=1 << 20)
+        X = np.zeros(100, np.float32)
+        refs: list = []
+        key = ("t", slab_pool.array_token(X, refs))
+        v = pool.get_or_build(key, lambda: np.zeros(100, np.float32),
+                              refs=refs)
+        base = pool.bytes
+        with pool.pinned(v):
+            del X
+            gc.collect()
+            # a drain while pinned must honor the pin invariant
+            pool.get_or_build("other", lambda: np.zeros(100, np.float32))
+            assert pool.bytes == base + 400  # dead entry still counted
+        # first pool touch after release: the dead entry reaps
+        pool.get_or_build("probe", lambda: np.zeros(100, np.float32))
+        assert pool.bytes == base + 400  # dead 400 left, probe 400 in
+        assert pool.get_or_build(key, lambda: "rebuilt",
+                                 refs=[]) == "rebuilt"
